@@ -1,0 +1,121 @@
+"""mpi4jax_tpu — MPI-style communication primitives, TPU-native.
+
+A brand-new framework with the capabilities of mpi4jax (reference:
+Silv3S/mpi4jax): the 12 MPI communication primitives usable inside
+``jax.jit``, with explicit token-chaining *and* implicit ordering, and
+autodiff (JVP + transpose) through the communication — re-designed for TPU:
+
+- every primitive lowers to **native XLA collective HLO** (AllReduce,
+  AllGather, AllToAll, CollectivePermute) scheduled over ICI/DCN — no libmpi,
+  no custom calls, no Cython bridge (replaces ref mpi4jax/_src/xla_bridge/*);
+- processes are replaced by the **SPMD device mesh**: a ``Comm`` is a set of
+  mesh axes, a rank is a device coordinate, and one traced program serves all
+  ranks (replaces ref's ``mpirun`` + per-process programs);
+- launched with plain ``python`` — multi-host pods via
+  ``init_distributed()`` (replaces ref _src/__init__.py:1-3 MPI_Init).
+
+Public API parity with ref mpi4jax/__init__.py:9-41 (12 ops + capability
+probes), plus the mesh/comm/region surface that replaces mpi4py.
+"""
+
+from .ops import (  # noqa: F401
+    BAND,
+    BOR,
+    BXOR,
+    LAND,
+    LOR,
+    LXOR,
+    MAX,
+    MIN,
+    PROD,
+    SUM,
+    Op,
+    Status,
+    Token,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    bcast,
+    create_token,
+    gather,
+    recv,
+    reduce,
+    scan,
+    scatter,
+    send,
+    sendrecv,
+)
+from .parallel import (  # noqa: F401
+    Comm,
+    get_default_comm,
+    get_default_mesh,
+    init_distributed,
+    make_world_mesh,
+    run,
+    set_default_mesh,
+    shift,
+    spmd,
+)
+from .utils import (  # noqa: F401
+    flush,
+    has_cuda_support,
+    has_sycl_support,
+    has_tpu_support,
+)
+
+# Exit-time flush: keep the reference's guarantee that pending async
+# communication completes before interpreter teardown
+# (ref mpi4jax/_src/__init__.py:13-17).
+import atexit as _atexit
+
+_atexit.register(flush)
+del _atexit
+
+__all__ = [
+    # ops (ref mpi4jax/__init__.py:26-41)
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "gather",
+    "recv",
+    "reduce",
+    "scan",
+    "scatter",
+    "send",
+    "sendrecv",
+    "has_cuda_support",
+    "has_sycl_support",
+    "has_tpu_support",
+    # reductions
+    "Op",
+    "SUM",
+    "PROD",
+    "MIN",
+    "MAX",
+    "LAND",
+    "LOR",
+    "LXOR",
+    "BAND",
+    "BOR",
+    "BXOR",
+    # tokens / status
+    "Token",
+    "create_token",
+    "Status",
+    # runtime
+    "Comm",
+    "get_default_comm",
+    "get_default_mesh",
+    "set_default_mesh",
+    "make_world_mesh",
+    "init_distributed",
+    "spmd",
+    "run",
+    "shift",
+    "flush",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,35 @@
+"""The 12 communication primitives.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/ — same op set, same
+shape/autodiff contracts (divergences documented per-module), but every op
+lowers to native XLA collective HLO over ICI/DCN instead of custom-calling
+into libmpi.
+"""
+
+from ._base import (  # noqa: F401
+    BAND,
+    BOR,
+    BXOR,
+    LAND,
+    LOR,
+    LXOR,
+    MAX,
+    MIN,
+    PROD,
+    SUM,
+    Op,
+)
+from .allgather import allgather  # noqa: F401
+from .allreduce import allreduce  # noqa: F401
+from .alltoall import alltoall  # noqa: F401
+from .barrier import barrier  # noqa: F401
+from .bcast import bcast  # noqa: F401
+from .gather import gather  # noqa: F401
+from .recv import recv  # noqa: F401
+from .reduce import reduce  # noqa: F401
+from .scan import scan  # noqa: F401
+from .scatter import scatter  # noqa: F401
+from .send import send  # noqa: F401
+from .sendrecv import sendrecv  # noqa: F401
+from .status import Status  # noqa: F401
+from .token import Token, create_token  # noqa: F401

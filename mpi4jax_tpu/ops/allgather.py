@@ -1,0 +1,31 @@
+"""allgather: gather every rank's array to all ranks.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/allgather.py.  Shape
+contract preserved exactly: input ``s`` -> output ``(size, *s)`` on every rank
+(ref allgather.py:229-236 abstract eval).  Lowering: one AllGather HLO.
+"""
+
+from typing import Optional
+
+from jax import lax
+
+from ..parallel.comm import Comm
+from ..utils.debug import log_op
+from ._base import dispatch
+from .token import Token, consume, produce
+
+
+def allgather(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
+    """Gather ``x`` from every rank; all ranks receive ``(size, *x.shape)``.
+
+    Returns ``(result, token)`` (ref API: allgather.py:38-76).
+    """
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        xl = consume(token, xl)
+        log_op("MPI_Allgather", comm.Get_rank(), f"sending {xl.size} items")
+        res = lax.all_gather(xl, comm.axis, axis=0, tiled=False)
+        return res, produce(token, res)
+
+    return dispatch("allgather", comm, body, (x,), token)

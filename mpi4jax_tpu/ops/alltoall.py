@@ -1,0 +1,41 @@
+"""alltoall: transpose data across ranks.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/alltoall.py.  Shape
+contract preserved: input ``(size, *s)`` -> output ``(size, *s)`` where
+``out[i]`` is the slice rank ``i`` addressed to us; the leading-axis == size
+requirement is checked like the reference (ref alltoall.py:71-73).
+Lowering: one AllToAll HLO — the building block for Ulysses-style sequence
+parallelism (head/sequence exchange).
+"""
+
+from typing import Optional
+
+from jax import lax
+
+from ..parallel.comm import Comm
+from ..utils.debug import log_op
+from ._base import dispatch
+from .token import Token, consume, produce
+
+
+def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
+    """Exchange slices: rank ``r`` sends ``x[i]`` to rank ``i`` and receives
+    into ``out[i]`` from rank ``i``.
+
+    Returns ``(result, token)`` (ref API: alltoall.py:39-77).
+    """
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        if xl.ndim == 0 or xl.shape[0] != size:
+            raise ValueError(
+                f"alltoall input must have leading axis == comm size "
+                f"({size}), got shape {xl.shape} (ref alltoall.py:71-73)"
+            )
+        xl = consume(token, xl)
+        log_op("MPI_Alltoall", comm.Get_rank(), f"sending {xl.size} items")
+        res = lax.all_to_all(xl, comm.axis, split_axis=0, concat_axis=0)
+        return res, produce(token, res)
+
+    return dispatch("alltoall", comm, body, (x,), token)

@@ -1,0 +1,45 @@
+"""gather: collect every rank's array at root.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/gather.py.  The
+reference has a *rank-dependent output shape* — ``(size, *s)`` on root, the
+input passed through on other ranks (ref gather.py:92-95, abstract
+:270-284).  SPMD traces one program with one output type for all ranks, so
+the shape is made uniform: **every rank receives the gathered ``(size, *s)``
+array** (root's view is bit-identical to the reference's).  This is the
+documented divergence for the gather family (see docs/sharp_bits.md); on ICI
+the extra fan-out is handled by the AllGather HLO's bandwidth-optimal ring
+schedule, so there is no latency cost over a rooted gather.
+"""
+
+from typing import Optional
+
+from jax import lax
+
+from ..parallel.comm import Comm
+from ..utils.debug import log_op
+from ._base import dispatch
+from .token import Token, consume, produce
+
+
+def gather(x, root: int, *, comm: Optional[Comm] = None,
+           token: Optional[Token] = None):
+    """Gather ``x`` from every rank to ``root`` (all ranks receive a copy —
+    see module docstring).
+
+    Returns ``(result, token)`` (ref API: gather.py:40-96).
+    """
+    if not isinstance(root, int):
+        raise TypeError(f"gather root must be a static int, got {type(root)}")
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        if not 0 <= root < size:
+            raise ValueError(f"gather root {root} out of range for size {size}")
+        xl = consume(token, xl)
+        log_op("MPI_Gather", comm.Get_rank(),
+               f"sending {xl.size} items to root {root}")
+        res = lax.all_gather(xl, comm.axis, axis=0, tiled=False)
+        return res, produce(token, res)
+
+    return dispatch("gather", comm, body, (x,), token)

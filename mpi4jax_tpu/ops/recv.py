@@ -1,0 +1,70 @@
+"""recv: point-to-point receive half.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/recv.py (input array
+is a shape/dtype template only, ref recv.py:43; abstract :246).  Pops the
+matching ``send`` from the region's (comm, tag) queue and emits the fused
+CollectivePermute (see ops/send.py for the matching model).
+
+Wildcard semantics: the reference defaults to ``ANY_SOURCE``/``ANY_TAG``
+(ref recv.py:44-48).  A statically-routed interconnect has no wildcards;
+``recv(source=None)`` instead adopts the queued send's routing — which covers
+the reference's default-argument uses — and an explicit ``source`` spec is
+validated against it.  A ``recv`` with no queued send is a trace-time error
+(the reference would deadlock at run time).
+"""
+
+from typing import Optional
+
+from ..parallel.comm import Comm
+from ..parallel.rankspec import normalize_source
+from ..parallel.region import current_context
+from ..utils.debug import log_op
+from ._base import dispatch
+from .sendrecv import _apply_permute, _fill_status
+from .status import Status
+from .token import Token, consume, produce
+
+
+def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
+         status: Optional[Status] = None, token: Optional[Token] = None):
+    """Receive into ``x``'s shape/dtype from the matching ``send``.
+
+    Returns ``(received, token)`` (ref API: recv.py:43-87).  Ranks outside
+    the routing receive ``x`` back unchanged (MPI_PROC_NULL semantics).
+    """
+    if not isinstance(tag, int):
+        raise TypeError(f"recv tag must be a static int, got {type(tag)}")
+
+    def body(comm, arrays, token):
+        (template,) = arrays
+        size = comm.Get_size()
+        ctx = current_context()
+        q = ctx.queue(comm.uid, tag)
+        if not q:
+            raise RuntimeError(
+                f"recv(tag={tag}): no matching send queued on this comm. "
+                "Under SPMD, the matching send must appear earlier in the "
+                "same parallel region (the reference would deadlock here at "
+                "run time; this framework turns it into a trace error)."
+            )
+        pending = q.popleft()
+        if source is not None:
+            pairs_s = normalize_source(source, size, what="recv")
+            if pairs_s != pending.pairs:
+                raise ValueError(
+                    f"recv: source spec implies routing {pairs_s} but the "
+                    f"matching send declared {pending.pairs}"
+                )
+        if pending.value.shape != template.shape or pending.value.dtype != template.dtype:
+            raise ValueError(
+                f"recv: template shape/dtype {template.shape}/{template.dtype} "
+                f"does not match sent {pending.value.shape}/{pending.value.dtype}"
+            )
+        payload = consume(token, pending.value)
+        log_op("MPI_Recv", comm.Get_rank(),
+               f"{payload.size} items along {list(pending.pairs)} (tag {tag})")
+        res = _apply_permute(payload, template, pending.pairs, comm)
+        _fill_status(status, pending.pairs, comm, payload.size, payload.dtype)
+        return res, produce(token, res)
+
+    return dispatch("recv", comm, body, (x,), token)

@@ -1,0 +1,44 @@
+"""reduce: reduction to root.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/reduce.py.  Contract
+preserved exactly (possible here, unlike gather, because shapes match): root
+receives the reduction, every other rank gets its own input back
+(ref reduce.py:77-80, abstract :240-252).
+
+Lowering: AllReduce + per-rank select on the (traced) rank index.  The select
+is free (fused); XLA's AllReduce is no slower than a rooted Reduce on ICI.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..parallel.comm import Comm
+from ..utils.debug import log_op
+from ._base import Op, OpLike, apply_allreduce, dispatch
+from .token import Token, consume, produce
+
+
+def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None,
+           token: Optional[Token] = None):
+    """Reduce ``x`` with ``op`` to rank ``root``; non-root ranks receive
+    their input unchanged.
+
+    Returns ``(result, token)`` (ref API: reduce.py:41-96).
+    """
+    if not isinstance(root, int):
+        raise TypeError(f"reduce root must be a static int, got {type(root)}")
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        if not 0 <= root < size:
+            raise ValueError(f"reduce root {root} out of range for size {size}")
+        xl = consume(token, xl)
+        rank = comm.Get_rank()
+        log_op("MPI_Reduce", rank, f"{xl.size} items to root {root}")
+        reduced = apply_allreduce(xl, op, comm.axes)
+        res = jnp.where(rank == root, reduced, xl)
+        return res, produce(token, res)
+
+    return dispatch("reduce", comm, body, (x,), token)

@@ -1,0 +1,50 @@
+"""scan: inclusive prefix reduction over rank order.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/scan.py (contract:
+rank ``r`` receives ``reduce(op, [x_0 … x_r])``, ref scan.py:40-78).
+
+Lowering: Hillis–Steele parallel prefix over ``log2(size)`` rounds of
+CollectivePermute — rank ``r`` receives from ``r - d`` and accumulates for
+doubling offsets ``d``.  This is the ICI-native prefix algorithm: log-depth,
+each round one neighbor hop, O(n·log size) total traffic (vs the reference's
+single MPI_Scan whose internals are the library's choice).  Non-participating
+lanes in each round are masked with ``where`` (ppermute delivers zeros to
+ranks with no source, which the mask discards).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.comm import Comm
+from ..utils.debug import log_op
+from ._base import SUM, OpLike, combine_fn, dispatch
+from .token import Token, consume, produce
+
+
+def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
+         token: Optional[Token] = None):
+    """Inclusive prefix reduction: rank ``r`` gets ``x_0 op x_1 op … op x_r``.
+
+    Returns ``(result, token)`` (ref API: scan.py:40-78).
+    """
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        xl = consume(token, xl)
+        rank = comm.Get_rank()
+        log_op("MPI_Scan", rank, f"with {xl.size} items")
+        fn = combine_fn(op)
+        acc = xl
+        d = 1
+        while d < size:
+            # rank r-d sends its accumulator to rank r (for r >= d)
+            perm = [(r - d, r) for r in range(d, size)]
+            recvd = lax.ppermute(acc, comm.axis, perm)
+            acc = jnp.where(rank >= d, fn(acc, recvd), acc)
+            d *= 2
+        return acc, produce(token, acc)
+
+    return dispatch("scan", comm, body, (x,), token)

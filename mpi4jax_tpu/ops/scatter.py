@@ -1,0 +1,50 @@
+"""scatter: distribute slices of root's array to all ranks.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/scatter.py.  The
+reference requires input shape ``(size, *s)`` on root only (ref
+scatter.py:85-89); under SPMD every rank passes the same-shaped buffer (only
+root's contents matter) and receives its slice ``s``.
+
+Lowering: one AllToAll HLO, then a static index selecting the slices that
+originated at ``root`` — each rank ends up with ``root_buffer[rank]``.
+"""
+
+from typing import Optional
+
+from jax import lax
+
+from ..parallel.comm import Comm
+from ..utils.debug import log_op
+from ._base import dispatch
+from .token import Token, consume, produce
+
+
+def scatter(x, root: int, *, comm: Optional[Comm] = None,
+            token: Optional[Token] = None):
+    """Scatter ``x`` (shape ``(size, *s)``, contents significant on root
+    only) so rank ``r`` receives ``x[r]`` as sent by ``root``.
+
+    Returns ``(result, token)`` (ref API: scatter.py:40-96).
+    """
+    if not isinstance(root, int):
+        raise TypeError(f"scatter root must be a static int, got {type(root)}")
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        if not 0 <= root < size:
+            raise ValueError(f"scatter root {root} out of range for size {size}")
+        if xl.ndim == 0 or xl.shape[0] != size:
+            raise ValueError(
+                f"scatter input must have leading axis == comm size ({size}), "
+                f"got shape {xl.shape} (ref scatter.py:85-89)"
+            )
+        xl = consume(token, xl)
+        log_op("MPI_Scatter", comm.Get_rank(),
+               f"receiving {xl.size // size} items from root {root}")
+        # all_to_all: out[i] = rank i's slice addressed to us; keep root's
+        exchanged = lax.all_to_all(xl, comm.axis, split_axis=0, concat_axis=0)
+        res = exchanged[root]
+        return res, produce(token, res)
+
+    return dispatch("scatter", comm, body, (x,), token)

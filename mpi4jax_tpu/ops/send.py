@@ -1,0 +1,62 @@
+"""send: point-to-point send half.
+
+TPU-native re-design of ref mpi4jax/_src/collective_ops/send.py (blocking
+send; returns token only, ref send.py:41, abstract :193-194).
+
+Under SPMD there is no per-process program to block in — a matched
+send/recv pair IS one CollectivePermute.  ``send`` therefore *records* the
+payload and routing in the region's matching queue (keyed by (comm, tag),
+FIFO per key — MPI's non-overtaking rule within a comm/tag channel); the
+matching ``recv`` emits the fused CollectivePermute.  Ordering notes:
+
+- matching is positional per (comm, tag) within one traced program, which is
+  exactly MPI message ordering for deterministic programs;
+- the returned token is tied to the payload, and the *recv side's* token is
+  tied to the actual transfer;
+- a send left unmatched at region end raises (see RegionContext.check_drained)
+  — the SPMD analog of the reference's deadlock-on-unmatched-send, converted
+  from a hang into a trace-time error.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+from ..parallel.comm import Comm
+from ..parallel.rankspec import normalize_dest
+from ..parallel.region import current_context
+from ..utils.debug import log_op
+from ._base import dispatch
+from .token import Token, consume, produce
+
+
+class PendingSend(NamedTuple):
+    value: object
+    pairs: Tuple[Tuple[int, int], ...]
+    token: Optional[Token]
+
+
+def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
+         token: Optional[Token] = None) -> Token:
+    """Send ``x`` along routing ``dest`` (see parallel/rankspec.py).
+
+    Must be matched by a ``recv`` on the same comm and tag later in the same
+    parallel region.  Returns a token (ref API: send.py:41-79).
+    """
+    if not isinstance(tag, int):
+        raise TypeError(f"send tag must be a static int, got {type(tag)}")
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        pairs = normalize_dest(dest, size, what="send")
+        xl = consume(token, xl)
+        log_op("MPI_Send", comm.Get_rank(),
+               f"{xl.size} items along {list(pairs)} (tag {tag})")
+        ctx = current_context()
+        ctx.queue(comm.uid, tag).append(PendingSend(xl, pairs, token))
+        return (produce(token, xl),)
+
+    # NOTE: send cannot run standalone in eager mode (the matching recv would
+    # be in a different one-op program) — dispatch's drained-queue check
+    # raises a clear error; use sendrecv or an spmd region for eager p2p.
+    out = dispatch("send", comm, body, (x,), token)
+    return out[0]

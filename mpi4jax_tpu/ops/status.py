@@ -1,0 +1,32 @@
+"""Message status introspection.
+
+Analog of the reference's optional ``MPI.Status`` out-parameter on ``recv``/
+``sendrecv`` (ref mpi4jax/_src/collective_ops/recv.py:43-48).  On a
+statically-routed interconnect everything a Status reports is known at trace
+time, so fields are filled from the routing spec: ``source`` is a traced
+per-rank value (-1 where the rank received nothing, the MPI_PROC_NULL
+analog), ``count``/``dtype`` are static.
+"""
+
+
+class Status:
+    __slots__ = ("source", "tag", "count", "dtype")
+
+    def __init__(self):
+        self.source = None
+        self.tag = None
+        self.count = None
+        self.dtype = None
+
+    def Get_source(self):
+        return self.source
+
+    def Get_tag(self):
+        return self.tag
+
+    def Get_count(self):
+        return self.count
+
+    def __repr__(self):
+        return (f"Status(source={self.source}, tag={self.tag}, "
+                f"count={self.count}, dtype={self.dtype})")

@@ -1,0 +1,77 @@
+"""Tokens: explicit ordering handles.
+
+The reference threads opaque XLA tokens through every op to force a total
+order on MPI calls — without them, ranks could compile different schedules and
+deadlock (ref: mpi4jax/_src/collective_ops/allreduce.py:63-64 ``create_token``;
+docs/sharp-bits.rst).  Under the SPMD model every rank runs the *same*
+compiled program, so cross-rank schedule divergence is impossible and tokens
+are no longer needed for deadlock-freedom.  They are kept because:
+
+1. API parity — reference code threads ``(result, token)`` pairs;
+2. they still pin the *relative execution order* of collectives inside one
+   program (useful for deterministic overlap/scheduling), implemented as data
+   dependencies through ``lax.optimization_barrier`` — the XLA-native ordering
+   mechanism, replacing the reference's side-effecting custom calls.
+
+A ``Token`` is a pytree wrapping a scalar ``uint32``; ops *consume* a token
+(tying their inputs to it) and *produce* a new one (tied to their outputs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Token:
+    value: jax.Array
+
+    def tree_flatten(self):
+        return (self.value,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+
+def create_token(_arg=None) -> Token:
+    """Create a fresh ordering token (ref: jax.lax.create_token usage at
+    mpi4jax/_src/collective_ops/allreduce.py:63-64).  The optional argument is
+    accepted for drop-in compatibility and ignored."""
+    return Token(jnp.zeros((), jnp.uint32))
+
+
+def _barrier_pair(a, b):
+    """Tie ``a`` and ``b`` together: returned values each depend on both
+    inputs (XLA OptimizationBarrier semantics)."""
+    return lax.optimization_barrier((a, b))
+
+
+def consume(token: Optional[Token], *arrays):
+    """Make ``arrays`` depend on ``token`` (op inputs wait for the token).
+
+    Returns the (possibly rewrapped) arrays.  ``None`` token is a no-op.
+    """
+    if token is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    tied = []
+    tval = token.value
+    for x in arrays:
+        x, tval = _barrier_pair(x, tval)
+        tied.append(x)
+    return tuple(tied) if len(tied) != 1 else tied[0]
+
+
+def produce(token: Optional[Token], *arrays) -> Token:
+    """Produce the op's output token: depends on every output array, so the
+    next token-consuming op is scheduled after this op completes."""
+    tval = token.value if token is not None else jnp.zeros((), jnp.uint32)
+    for x in arrays:
+        _, tval = _barrier_pair(x, tval)
+    return Token(tval)

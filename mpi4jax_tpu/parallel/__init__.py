@@ -1,0 +1,24 @@
+"""Parallel runtime: communicators, meshes, SPMD regions, routing specs.
+
+TPU-native replacement for the reference's MPI runtime layer
+(ref: mpi4jax/_src/comm.py, the mpirun launch model, and the
+communicator-handle plumbing in _src/utils.py:80-96).
+"""
+
+from .comm import Comm  # noqa: F401
+from .mesh import (  # noqa: F401
+    DEFAULT_AXIS,
+    get_default_mesh,
+    init_distributed,
+    make_world_mesh,
+    set_default_mesh,
+)
+from .rankspec import invert_pairs, normalize_dest, normalize_source, shift  # noqa: F401
+from .region import (  # noqa: F401
+    current_context,
+    get_default_comm,
+    in_parallel_region,
+    resolve_comm,
+    run,
+    spmd,
+)

@@ -1,0 +1,170 @@
+"""Communicators as named mesh axes.
+
+TPU-native replacement for the reference's mpi4py communicator handling
+(ref: mpi4jax/_src/comm.py:4-11 default ``COMM_WORLD.Clone()``;
+mpi4jax/_src/utils.py:80-96 handle marshalling).  An MPI communicator is a
+(process group, message-matching namespace); the TPU-native equivalent is a
+(set of mesh axes, point-to-point matching namespace):
+
+- the *process group* is the set of devices along the comm's mesh axes;
+- collectives over the group are XLA HLO collectives over those axes,
+  scheduled on ICI/DCN by the compiler — no channel/tag bookkeeping needed;
+- the *matching namespace* only matters for ``send``/``recv`` pairing, which
+  this framework matches at trace time per (comm, tag) — so ``Clone()``
+  returns a comm with a fresh matching namespace, preserving the reference's
+  isolation guarantee (user traffic on a cloned comm can never collide,
+  ref docs/sharp-bits.rst:82-143).
+
+A ``Comm`` may be *bound* to a concrete ``jax.sharding.Mesh`` (so it knows its
+size statically and can run ops eagerly by auto-wrapping them in
+``jax.shard_map``), or *unbound* (axes only — usable inside any user
+``shard_map`` that defines those axes).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+
+_uid_counter = itertools.count()
+
+
+class Comm:
+    """A communicator over one or more mesh axes.
+
+    Parameters
+    ----------
+    axes:
+        Mesh axis name, or sequence of names.  Multiple axes form one flat
+        group in row-major order (first axis is slowest-varying), like an MPI
+        communicator over a Cartesian grid.
+    mesh:
+        Optional concrete ``jax.sharding.Mesh`` binding.  Required for eager
+        (outside-``shard_map``) execution and for static ``Get_size`` outside
+        a trace.
+    """
+
+    def __init__(self, axes, *, mesh: Optional[jax.sharding.Mesh] = None):
+        if isinstance(axes, str):
+            axes = (axes,)
+        self._axes: Tuple[str, ...] = tuple(axes)
+        if not self._axes:
+            raise ValueError("Comm needs at least one mesh axis name")
+        self._mesh = mesh
+        if mesh is not None:
+            missing = [a for a in self._axes if a not in mesh.shape]
+            if missing:
+                raise ValueError(
+                    f"axes {missing} not present in mesh axes {tuple(mesh.shape)}"
+                )
+        # Unique id = the p2p matching namespace (Clone isolation).
+        self._uid = next(_uid_counter)
+
+    # -- structure ---------------------------------------------------------
+
+    @property
+    def axes(self) -> Tuple[str, ...]:
+        return self._axes
+
+    @property
+    def axis(self) -> str:
+        """The single axis name; raises for multi-axis comms."""
+        if len(self._axes) != 1:
+            raise ValueError(
+                f"operation requires a single-axis communicator, got axes "
+                f"{self._axes}; use comm.sub(axis) to select one axis"
+            )
+        return self._axes[0]
+
+    @property
+    def mesh(self) -> Optional[jax.sharding.Mesh]:
+        return self._mesh
+
+    @property
+    def uid(self) -> int:
+        return self._uid
+
+    def bind(self, mesh: jax.sharding.Mesh) -> "Comm":
+        """Return a copy of this comm bound to ``mesh`` (same namespace)."""
+        new = Comm(self._axes, mesh=mesh)
+        new._uid = self._uid
+        return new
+
+    # -- MPI-style surface -------------------------------------------------
+
+    def Get_size(self) -> int:
+        """Number of ranks (static Python int).
+
+        Works either from the bound mesh or, inside a ``shard_map`` trace,
+        from the axis environment (``lax.axis_size``).
+        """
+        if self._mesh is not None:
+            return int(np.prod([self._mesh.shape[a] for a in self._axes]))
+        try:
+            return int(np.prod([lax.axis_size(a) for a in self._axes]))
+        except NameError:
+            raise RuntimeError(
+                f"Comm({self._axes}) is not bound to a mesh and axis sizes "
+                "are not available outside a shard_map trace. Bind the comm "
+                "(comm.bind(mesh)) or call inside a parallel region."
+            ) from None
+
+    def Get_rank(self):
+        """Linear rank of the calling device (traced value, row-major).
+
+        Unlike the reference (where rank is a Python int per process,
+        ref _src/utils.py:86-90), the TPU SPMD model traces ONE program for
+        all ranks, so the rank is a traced scalar.  Use it for data
+        (coordinates, masks); structural choices (roots, routing) take static
+        Python values.
+        """
+        rank = lax.axis_index(self._axes[0])
+        for a in self._axes[1:]:
+            rank = rank * lax.axis_size(a) + lax.axis_index(a)
+        return rank
+
+    # MPI spells it Get_rank/Get_size; offer pythonic aliases too.
+    rank = Get_rank
+    size = Get_size
+
+    def Clone(self) -> "Comm":
+        """Fresh matching namespace over the same group.
+
+        Ref parity: ``comm.Clone()`` isolates this library's traffic from the
+        user's (ref _src/comm.py:4-11).  Here collectives cannot collide at
+        all (each HLO op is independent), so cloning only isolates
+        send/recv trace-time matching queues.
+        """
+        return Comm(self._axes, mesh=self._mesh)
+
+    Dup = Clone
+
+    def sub(self, *axes: str) -> "Comm":
+        """Communicator over a subset of this comm's axes.
+
+        The TPU-native form of ``MPI_Comm_split`` for Cartesian grids: on a
+        mesh ``("y", "x")``, ``comm.sub("x")`` is the row communicator (one
+        group per y-coordinate), ``comm.sub("y")`` the column communicator.
+        Arbitrary (non-grid) color splits are not supported — XLA's
+        ``axis_index_groups`` is unavailable under shard_map; reshape your
+        mesh instead.
+        """
+        for a in axes:
+            if a not in self._axes:
+                raise ValueError(f"axis {a!r} not in comm axes {self._axes}")
+        return Comm(axes, mesh=self._mesh)
+
+    def Split(self, color_axis: str) -> "Comm":
+        """Alias for ``sub`` with MPI naming; split along remaining axes."""
+        remaining = tuple(a for a in self._axes if a != color_axis)
+        if not remaining:
+            raise ValueError("Split would leave an empty communicator")
+        return Comm(remaining, mesh=self._mesh)
+
+    def __repr__(self):
+        bound = f", mesh={tuple(self._mesh.shape.items())}" if self._mesh else ""
+        return f"Comm(axes={self._axes}{bound}, uid={self._uid})"

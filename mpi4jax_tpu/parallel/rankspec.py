@@ -1,0 +1,139 @@
+"""Static rank-routing specifications for point-to-point ops.
+
+In the reference, each MPI process passes its own ``source``/``dest`` integers
+to ``send``/``recv``/``sendrecv`` (ref: mpi4jax/_src/collective_ops/send.py:41,
+recv.py:43, sendrecv.py:46).  Under SPMD one traced program describes *all*
+ranks at once, so routing must be given as a static description of the whole
+pattern, which lowers to a single ``CollectivePermute``.  A ``RankSpec`` is
+any of:
+
+- ``shift(k)`` / ``shift(k, wrap=False)`` — ring / edge-stopping shift, the
+  halo-exchange workhorse;
+- a dict ``{src_rank: dst_rank}``;
+- a list of ``(src, dst)`` pairs (ppermute-style);
+- a callable ``rank -> Optional[dst]``;
+- ``None`` — derived from the matching send/recv side.
+
+Wildcards (``ANY_SOURCE``/``ANY_TAG``, ref recv.py:44-48) do not exist on a
+statically-scheduled interconnect; ``recv(source=None)`` instead adopts the
+routing of the queued matching ``send`` (see ops/send.py / ops/recv.py), which
+covers the reference's default-argument use.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+
+class shift:
+    """Ring (or edge-stopping) shift pattern: rank ``r`` sends to ``r + k``.
+
+    ``wrap=True`` (default) wraps modulo the comm size, giving a ring.
+    ``wrap=False`` drops out-of-range endpoints: the halo-exchange pattern at
+    domain boundaries (ref examples/shallow_water.py:228-263 sends only where
+    a neighbor exists).
+    """
+
+    def __init__(self, k: int, *, wrap: bool = True):
+        self.k = int(k)
+        self.wrap = bool(wrap)
+
+    def __call__(self, r: int, size: int) -> Optional[int]:
+        d = r + self.k
+        if self.wrap:
+            return d % size
+        return d if 0 <= d < size else None
+
+    def inverse(self) -> "shift":
+        return shift(-self.k, wrap=self.wrap)
+
+    def __repr__(self):
+        return f"shift({self.k}{'' if self.wrap else ', wrap=False'})"
+
+
+RankSpecLike = Union[
+    shift,
+    Dict[int, int],
+    Sequence[Tuple[int, int]],
+    Callable[[int], Optional[int]],
+    None,
+]
+
+
+def normalize_dest(spec: RankSpecLike, size: int, *, what: str) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a routing spec into a sorted tuple of (src, dst) pairs.
+
+    Validates that the pairs form a partial permutation (no duplicate sources
+    or destinations) — the contract ``CollectivePermute`` requires.
+    """
+    if spec is None:
+        raise ValueError(
+            f"{what}: routing spec is required here (got None). Under SPMD, "
+            "point-to-point routing describes all ranks at once; use "
+            "shift(k), a {src: dst} dict, or [(src, dst), ...] pairs."
+        )
+    if isinstance(spec, int):
+        raise TypeError(
+            f"{what}: a bare int rank is ambiguous under SPMD (every rank "
+            "executes the same program, so 'dest=1' would mean all ranks send "
+            "to rank 1 — not a valid permutation). Describe the full pattern: "
+            "pairs=[(0, 1)] for a single message, shift(k) for rings, or a "
+            "{src: dst} dict."
+        )
+    pairs: List[Tuple[int, int]]
+    if isinstance(spec, shift):
+        pairs = []
+        for r in range(size):
+            d = spec(r, size)
+            if d is not None:
+                pairs.append((r, d))
+    elif isinstance(spec, dict):
+        pairs = [(int(s), int(d)) for s, d in spec.items()]
+    elif callable(spec):
+        pairs = []
+        for r in range(size):
+            d = spec(r)
+            if d is not None:
+                pairs.append((r, int(d)))
+    else:
+        pairs = [(int(s), int(d)) for (s, d) in spec]
+
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    for v, role in ((srcs, "source"), (dsts, "destination")):
+        if len(set(v)) != len(v):
+            raise ValueError(
+                f"{what}: duplicate {role} ranks in routing {pairs}; "
+                "point-to-point routing must be a (partial) permutation"
+            )
+    for v in srcs + dsts:
+        if not (0 <= v < size):
+            raise ValueError(f"{what}: rank {v} out of range for comm size {size}")
+    return tuple(sorted(pairs))
+
+
+def normalize_source(spec: RankSpecLike, size: int, *, what: str) -> Tuple[Tuple[int, int], ...]:
+    """Like ``normalize_dest`` but the spec is receiver-centric:
+    ``spec(r) = source of rank r``.  Returns (src, dst) pairs."""
+    if isinstance(spec, shift):
+        # receiving from r+k  <=>  r+k sends to r
+        inv = spec.inverse()
+        return normalize_dest(inv, size, what=what)
+    if isinstance(spec, dict):
+        return normalize_dest({int(s): int(r) for r, s in spec.items()}, size, what=what)
+    if spec is None or isinstance(spec, int):
+        return normalize_dest(spec, size, what=what)  # raises with guidance
+    if callable(spec):
+        pairs = {}
+        for r in range(size):
+            s = spec(r)
+            if s is not None:
+                pairs[int(s)] = r
+        return normalize_dest(pairs, size, what=what)
+    # sequence of (dst, src)? — for sequences we require (src, dst) pairs
+    # directly, same as dest specs, to avoid silent transposition bugs.
+    return normalize_dest(spec, size, what=what)
+
+
+def invert_pairs(pairs: Sequence[Tuple[int, int]]) -> Tuple[Tuple[int, int], ...]:
+    return tuple(sorted((d, s) for s, d in pairs))

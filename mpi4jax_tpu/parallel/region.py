@@ -1,0 +1,172 @@
+"""Parallel regions: the SPMD execution surface.
+
+The reference runs one OS process per rank (``mpirun``), and every op executes
+against the process-global MPI state.  The TPU-native model traces ONE program
+for all ranks with ``jax.shard_map`` over a device mesh; a *parallel region*
+is that traced body.  This module provides:
+
+- ``spmd(...)`` — decorator turning a per-rank function into a jitted
+  ``shard_map`` over a comm's mesh (global arrays carry a leading rank axis);
+- the trace-time region context that (a) supplies the default communicator to
+  ops called with ``comm=None`` and (b) holds the send/recv matching queues
+  (see ops/send.py);
+- ``run(fn, *args)`` — one-shot form of ``spmd``.
+
+Because the region is a single program, every rank observes the same schedule
+of collectives — the deadlock class the reference's token machinery exists to
+prevent (ref docs/sharp-bits.rst, tests/collective_ops/test_send_and_recv.py:91-110
+"this deadlocks without proper token management") cannot occur by construction.
+Tokens are still honored: they pin the *relative order* of collectives through
+``optimization_barrier`` data dependencies (see ops/token.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from .comm import Comm
+from .mesh import DEFAULT_AXIS, get_default_mesh
+
+
+class RegionContext:
+    """Trace-time state for one parallel region."""
+
+    def __init__(self, comm: Comm):
+        self.comm = comm
+        # (comm_uid, tag) -> deque of pending _PendingSend (see ops/send.py)
+        self.send_queues: Dict[Tuple[int, int], deque] = {}
+
+    def queue(self, comm_uid: int, tag: int) -> deque:
+        return self.send_queues.setdefault((comm_uid, tag), deque())
+
+    def check_drained(self) -> None:
+        leftover = {k: len(q) for k, q in self.send_queues.items() if q}
+        if leftover:
+            raise RuntimeError(
+                f"parallel region ended with unmatched send(s): "
+                f"{{(comm_uid, tag): count}} = {leftover}. Every send must be "
+                "matched by a recv on the same comm and tag within the same "
+                "region (the SPMD analog of the reference's matched-pair "
+                "requirement)."
+            )
+
+
+_region_stack: List[RegionContext] = []
+
+# Fallback context for ops used inside a *user's own* shard_map (no spmd
+# wrapper). Queues here are keyed the same way; staleness across traces is
+# caught by JAX's leaked-tracer errors.
+_global_ctx = RegionContext(comm=None)  # type: ignore[arg-type]
+
+_default_comm: Optional[Comm] = None
+
+
+def current_context() -> RegionContext:
+    return _region_stack[-1] if _region_stack else _global_ctx
+
+
+def get_default_comm() -> Comm:
+    """The world communicator (analog of ref ``get_default_comm``,
+    mpi4jax/_src/comm.py:4-11): inside a region, the region's comm; outside,
+    a cached comm over the default world mesh."""
+    ctx = current_context()
+    if ctx.comm is not None:
+        return ctx.comm
+    global _default_comm
+    if _default_comm is None:
+        _default_comm = Comm(DEFAULT_AXIS, mesh=get_default_mesh())
+    return _default_comm
+
+
+def resolve_comm(comm: Optional[Comm]) -> Comm:
+    return comm if comm is not None else get_default_comm()
+
+
+def spmd(
+    fn=None,
+    *,
+    comm: Optional[Comm] = None,
+    in_specs: Any = None,
+    out_specs: Any = None,
+    jit: bool = True,
+    static_argnums=(),
+):
+    """Turn a per-rank function into an SPMD program over ``comm``'s mesh.
+
+    The wrapped function sees rank-local arrays; global inputs/outputs carry a
+    leading rank axis by default (``in_specs=P(axis)``), matching the
+    convention that rank ``r``'s local value is ``global[r]``.  Custom
+    ``in_specs``/``out_specs`` follow ``jax.shard_map``.
+
+    Inside the body, ops called with ``comm=None`` use this region's comm, and
+    ``send``/``recv`` matching is scoped to the region.
+    """
+
+    def wrap(f):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            c = resolve_comm(comm)
+            if c.mesh is None:
+                raise RuntimeError(
+                    "spmd requires a comm bound to a mesh (comm.bind(mesh)) "
+                    "or an available default mesh"
+                )
+            axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])
+            ispecs = in_specs if in_specs is not None else axes_spec
+            ospecs = out_specs if out_specs is not None else axes_spec
+            # Default-spec convention: a global array is (size, *local_shape),
+            # global[r] being rank r's value — so the body sees true local
+            # shapes, we squeeze the sharded leading axis on the way in and
+            # restore it on the way out. Custom specs disable this.
+            squeeze_in = in_specs is None
+            squeeze_out = out_specs is None
+
+            def body(*a, **kw):
+                ctx = RegionContext(c)
+                _region_stack.append(ctx)
+                try:
+                    if squeeze_in:
+                        a, kw = jax.tree.map(lambda v: v[0], (a, kw))
+                    out = f(*a, **kw)
+                    if squeeze_out:
+                        out = jax.tree.map(lambda v: v[None], out)
+                    ctx.check_drained()
+                    return out
+                finally:
+                    _region_stack.pop()
+
+            sm = jax.shard_map(
+                body, mesh=c.mesh, in_specs=ispecs, out_specs=ospecs
+            )
+            if jit:
+                sm = jax.jit(sm, static_argnums=static_argnums)
+            return sm(*args, **kwargs)
+
+        return wrapped
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def run(f, *args, comm: Optional[Comm] = None, **spmd_kwargs):
+    """One-shot ``spmd``: ``run(f, x)`` == ``spmd(f, ...)(x)``."""
+    return spmd(comm=comm, **spmd_kwargs)(f)(*args)
+
+
+def in_parallel_region(comm: Comm) -> bool:
+    """True if the comm's axes are bound in the current trace (i.e. we are
+    inside a shard_map body over those axes)."""
+    from jax import lax
+
+    try:
+        for a in comm.axes:
+            lax.axis_size(a)
+        return True
+    except NameError:
+        return False

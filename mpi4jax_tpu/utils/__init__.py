@@ -1,0 +1,52 @@
+"""Support utilities: dtype table, env flags, validation, debug logging.
+
+TPU-native re-design of the reference's L3 support layer
+(ref: mpi4jax/_src/{utils,decorators,validation,flush}.py).  What is *not*
+here, and why:
+
+- MPI handle marshalling (ref utils.py:80-96) — no MPI objects exist.
+- ``HashableMPIType`` wrappers (ref utils.py:133-152) — comms/ops here are
+  plain hashable Python objects already.
+- platform-gated lowering decorators (ref decorators.py:94-149) — collectives
+  lower through ``jax.lax`` on every platform; there are no per-platform
+  custom-call bridges to gate.
+"""
+
+import jax
+
+from .config import parse_env_bool, prefer_notoken  # noqa: F401
+from .debug import get_logging, set_logging  # noqa: F401
+from .dtypes import SUPPORTED_DTYPES, check_dtype  # noqa: F401
+from .flush import flush  # noqa: F401
+from .validation import enforce_types  # noqa: F401
+
+
+def has_tpu_support() -> bool:
+    """True if a TPU backend is available.
+
+    Capability probe in the spirit of ref ``has_cuda_support``
+    (mpi4jax/_src/utils.py:158-165).
+    """
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def has_cuda_support() -> bool:
+    """True if a CUDA backend is available (ref: _src/utils.py:158-165).
+
+    Collectives here lower to XLA HLO, so GPU works without any extension —
+    this probe reports backend availability only.
+    """
+    try:
+        return any(d.platform == "gpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def has_sycl_support() -> bool:
+    """Ref parity probe (mpi4jax/_src/utils.py:168-173). Always False: XLA has
+    no SYCL plugin in this environment; the XPU platform was the reference
+    fork's custom-call backend, which this framework replaces entirely."""
+    return False

@@ -1,0 +1,53 @@
+"""Environment-variable flag system.
+
+TPU-native analog of the reference's env-flag configuration
+(ref: mpi4jax/_src/decorators.py:29-34 truthy parser; mpi4jax/_src/utils.py:175-177
+``MPI4JAX_PREFER_NOTOKEN``; mpi4jax/_src/xla_bridge/__init__.py:24-28
+``MPI4JAX_DEBUG``).
+
+Recognized variables:
+
+- ``MPI4JAX_TPU_DEBUG``     — per-op debug logging (``r{rank} | {id} | …`` format).
+- ``MPI4JAX_TPU_PREFER_NOTOKEN`` — make the token API delegate to the notoken
+  (implicit-ordering) implementation.
+- ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the JAX version advisory.
+"""
+
+import os
+
+TRUTHY = ("true", "1", "on", "yes")
+FALSY = ("false", "0", "off", "no", "")
+
+
+def parse_env_bool(name: str, default: bool = False) -> bool:
+    """Parse a truthy/falsy environment variable.
+
+    Raises ``ValueError`` on unrecognized values, like the reference's
+    truthy/falsy parser (ref: mpi4jax/_src/decorators.py:29-34).
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    val = raw.lower().strip()
+    if val in TRUTHY:
+        return True
+    if val in FALSY:
+        return False
+    raise ValueError(
+        f"Environment variable {name}={raw!r} could not be parsed as a boolean "
+        f"(truthy values: {TRUTHY}, falsy values: {FALSY})"
+    )
+
+
+def debug_enabled() -> bool:
+    return parse_env_bool("MPI4JAX_TPU_DEBUG", False)
+
+
+def prefer_notoken() -> bool:
+    """Whether the token API should delegate to implicit (notoken) ordering.
+
+    Ref: mpi4jax/_src/utils.py:175-177 (``MPI4JAX_PREFER_NOTOKEN``).  In this
+    framework the two paths share one lowering, so this only controls whether
+    tokens are threaded through ``optimization_barrier`` chains.
+    """
+    return parse_env_bool("MPI4JAX_TPU_PREFER_NOTOKEN", False)

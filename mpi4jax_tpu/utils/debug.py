@@ -1,0 +1,61 @@
+"""Env-gated per-op debug logging + profiler annotations.
+
+TPU-native analog of the reference's bridge logging
+(ref: mpi4jax/_src/xla_bridge/mpi_xla_bridge.pyx:38-60): when enabled, every
+collective emits a device-side print in the same format the reference used::
+
+    r{rank} | {8-char id} | {OpName}: {details}
+
+The id is random per *call site* (generated at trace time), matching the
+reference's per-invocation 8-char hex id (ref: mpi_xla_bridge.pyx:47-52).
+Logging is toggled by ``MPI4JAX_TPU_DEBUG`` (env, read at import like
+ref xla_bridge/__init__.py:24-28) or programmatically via ``set_logging``.
+
+Every collective is additionally wrapped in ``jax.named_scope`` so ops show up
+named in XLA HLO and in ``jax.profiler`` traces (capability the reference
+lacked).
+"""
+
+import secrets
+from contextlib import contextmanager
+
+import jax
+
+from .config import debug_enabled
+
+_logging_enabled = debug_enabled()
+
+
+def set_logging(enabled: bool) -> None:
+    """Analog of ref mpi_xla_bridge.pyx:38-40 ``set_logging``."""
+    global _logging_enabled
+    _logging_enabled = bool(enabled)
+
+
+def get_logging() -> bool:
+    """Analog of ref mpi_xla_bridge.pyx:43-44 ``get_logging``."""
+    return _logging_enabled
+
+
+def log_op(opname: str, rank, detail: str = "") -> None:
+    """Emit the per-op debug line (device-side, ordered with the computation).
+
+    ``rank`` may be a traced value (``lax.axis_index``); formatting happens on
+    the host via ``jax.debug.print`` when the op actually executes.
+    """
+    if not _logging_enabled:
+        return
+    call_id = secrets.token_hex(4)  # 8 hex chars, per trace site
+    if detail:
+        jax.debug.print(
+            "r{rank} | " + call_id + " | " + opname + ": " + detail, rank=rank
+        )
+    else:
+        jax.debug.print("r{rank} | " + call_id + " | " + opname, rank=rank)
+
+
+@contextmanager
+def op_scope(opname: str):
+    """Named scope so collectives are attributable in profiles and HLO."""
+    with jax.named_scope(f"mpi4jax_tpu.{opname}"):
+        yield

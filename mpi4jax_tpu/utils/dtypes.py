@@ -1,0 +1,56 @@
+"""Supported dtype table.
+
+TPU-native analog of the reference's ``MPI_TYPE_MAP``
+(ref: mpi4jax/_src/utils.py:100-115), which maps numpy dtypes to MPI datatype
+handles.  Here there is no wire format to pick — XLA collectives are typed by
+the HLO — so the table only *gates* which dtypes the public API accepts, and
+records TPU-specific notes:
+
+- ``bfloat16`` is first-class (it was not representable in the reference's MPI
+  type map at all).
+- ``float128``/``complex256`` are dropped (unsupported by XLA on every
+  platform this framework targets; ref had them via MPI_LONG_DOUBLE).
+- ``float64`` works on the CPU backend and is software-emulated (slow) on TPU.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+# dtype -> short display name used by debug logging
+SUPPORTED_DTYPES = {
+    np.dtype(jnp.bfloat16): "bf16",
+    np.dtype(np.float16): "f16",
+    np.dtype(np.float32): "f32",
+    np.dtype(np.float64): "f64",
+    np.dtype(np.int8): "i8",
+    np.dtype(np.int16): "i16",
+    np.dtype(np.int32): "i32",
+    np.dtype(np.int64): "i64",
+    np.dtype(np.uint8): "u8",
+    np.dtype(np.uint16): "u16",
+    np.dtype(np.uint32): "u32",
+    np.dtype(np.uint64): "u64",
+    np.dtype(np.bool_): "bool",
+    np.dtype(np.complex64): "c64",
+    np.dtype(np.complex128): "c128",
+}
+
+
+def check_dtype(arr, opname: str) -> None:
+    """Reject dtypes outside the supported table with a clear error.
+
+    Analog of the KeyError raised by the reference's ``to_dtype_handle``
+    (ref: mpi4jax/_src/utils.py:118-127).
+    """
+    dt = np.dtype(arr.dtype)
+    if dt not in SUPPORTED_DTYPES:
+        supported = ", ".join(sorted(str(k) for k in SUPPORTED_DTYPES))
+        raise TypeError(
+            f"{opname}: unsupported dtype {dt}. Supported dtypes: {supported}. "
+            "Note: float128/complex256 are not available on TPU/XLA "
+            "(the reference supported them only via MPI_LONG_DOUBLE on CPU)."
+        )
+
+
+def dtype_shortname(dtype) -> str:
+    return SUPPORTED_DTYPES.get(np.dtype(dtype), str(np.dtype(dtype)))

@@ -1,0 +1,17 @@
+"""Exit-time flush of pending async dispatch.
+
+Analog of the reference's atexit flush handler
+(ref: mpi4jax/_src/flush.py:4-6 and _src/__init__.py:13-17), which runs
+``jax.effects_barrier()`` before teardown so in-flight MPI ops complete and
+the process does not deadlock at MPI_Finalize.  On TPU there is no MPI
+finalizer, but JAX's async dispatch can still hold in-flight collectives at
+interpreter exit; blocking on the effects barrier keeps shutdown clean and
+keeps the reference's user-visible guarantee.
+"""
+
+import jax
+
+
+def flush() -> None:
+    """Wait for all pending XLA operations (incl. collectives) to complete."""
+    jax.effects_barrier()

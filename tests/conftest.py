@@ -1,0 +1,40 @@
+"""Test configuration: 8 virtual CPU devices.
+
+The reference suite runs under ``pytest`` and ``mpirun -np N pytest``
+(ref docs/developers.rst:15-27) — real MPI, no fakes.  The TPU-native analog
+runs the real collective lowerings on a virtual multi-device CPU mesh
+(``--xla_force_host_platform_device_count``), exercising the identical XLA
+collective code paths that run on ICI, without TPU hardware
+(SURVEY.md §4 "Implication for the TPU build").
+"""
+
+import os
+
+# Must be set before jax initializes. JAX_PLATFORMS=cpu also overrides the
+# axon TPU plugin, whose sitecustomize would otherwise claim the backend.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_report_header(config):
+    # Analog of ref tests/conftest.py:1-9 (reports MPI vendor/rank/size).
+    return (
+        f"mpi4jax_tpu: backend={jax.default_backend()} "
+        f"n_devices={jax.device_count()}"
+    )
+
+
+@pytest.fixture
+def mesh8():
+    import mpi4jax_tpu as mpx
+
+    mesh = mpx.make_world_mesh()
+    yield mesh
