@@ -45,6 +45,7 @@ from .ops import (  # noqa: F401
     scatter,
     send,
     sendrecv,
+    varying,
 )
 from .parallel import (  # noqa: F401
     Comm,
@@ -104,6 +105,7 @@ __all__ = [
     # tokens / status
     "Token",
     "create_token",
+    "varying",
     "Status",
     # runtime
     "Comm",

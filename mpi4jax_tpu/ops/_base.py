@@ -111,6 +111,7 @@ def apply_allreduce(x, op: OpLike, axes: Tuple[str, ...]):
     (bandwidth-optimal on ICI for small payloads; XLA fuses the local
     reduction).
     """
+    x = as_varying(x, axes)
     if isinstance(op, Op) and op in _NATIVE_COLLECTIVE:
         return _NATIVE_COLLECTIVE[op](x, axes)
     fn = combine_fn(op)
@@ -133,16 +134,31 @@ def linear_rank(comm: Comm):
 # ---------------------------------------------------------------------------
 
 
+def varying(x, *, comm: Optional[Comm] = None):
+    """Public helper: re-type a replicated value as rank-varying.
+
+    Collective results (``allreduce``/``bcast``/…) are *replicated-typed* in
+    JAX's collective type system — that typing is what gives the reference's
+    transpose contract.  Structured control flow (``lax.while_loop`` /
+    ``scan`` carries) requires stable types, so a carry that passes through a
+    collective must be re-typed with this helper.  See docs/sharp_bits.md.
+    """
+    comm = resolve_comm(comm)
+    return jax.tree.map(lambda v: as_varying(v, comm.axes), x)
+
+
 def as_varying(x, axes: Tuple[str, ...]):
     """Promote a replicated-typed value to varying over ``axes`` (VMA typing).
 
-    Needed when feeding trace-constants into collectives under shard_map's
-    varying-manual-axes checking.
+    JAX's variant/invariant collective typing requires ``psum`` inputs to be
+    *varying* over the reduced axes; fresh trace constants (e.g. tangents of
+    ``ones``) are replicated.  No-op for axes already varying.
     """
-    try:
-        return lax.pvary(x, axes)
-    except Exception:
-        return lax.pcast(x, axes, to="varying")
+    vma = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in vma)
+    if not missing:
+        return x
+    return lax.pcast(x, missing, to="varying")
 
 
 def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
@@ -161,6 +177,9 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
     for a in arrays:
         check_dtype(a, opname)
     if in_parallel_region(comm):
+        # promote replicated trace-constants to rank-varying once, centrally,
+        # so every op accepts them (collectives are variant->invariant typed)
+        arrays = tuple(as_varying(a, comm.axes) for a in arrays)
         with op_scope(opname):
             return body(comm, arrays, token)
 

@@ -19,7 +19,7 @@ from ..parallel.comm import Comm
 from ..parallel.rankspec import normalize_source
 from ..parallel.region import current_context
 from ..utils.debug import log_op
-from ._base import dispatch
+from ._base import as_varying, dispatch
 from .sendrecv import _apply_permute, _fill_status
 from .status import Status
 from .token import Token, consume, produce
@@ -60,7 +60,7 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
                 f"recv: template shape/dtype {template.shape}/{template.dtype} "
                 f"does not match sent {pending.value.shape}/{pending.value.dtype}"
             )
-        payload = consume(token, pending.value)
+        payload = as_varying(consume(token, pending.value), comm.axes)
         log_op("MPI_Recv", comm.Get_rank(),
                f"{payload.size} items along {list(pending.pairs)} (tag {tag})")
         res = _apply_permute(payload, template, pending.pairs, comm)

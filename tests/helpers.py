@@ -1,0 +1,34 @@
+"""Shared test helpers: the world comm/size and global-array builders.
+
+Mirrors the reference's module-level ``comm/rank/size`` globals
+(ref tests/collective_ops/test_allreduce.py:8-10), adapted to the SPMD
+model: ``SIZE`` virtual devices, global arrays carry a leading rank axis.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as mpx
+
+COMM = None
+SIZE = None
+
+
+def world():
+    global COMM, SIZE
+    if COMM is None:
+        COMM = mpx.get_default_comm()
+        SIZE = COMM.Get_size()
+    return COMM, SIZE
+
+
+def per_rank(fn_of_rank, *, dtype=jnp.float32):
+    """Build a global array where global[r] = fn_of_rank(r)."""
+    _, size = world()
+    return jnp.stack([jnp.asarray(fn_of_rank(r), dtype=dtype) for r in range(size)])
+
+
+def ranks_arange(shape=(), dtype=jnp.float32):
+    """global[r] = full(shape, r) — the README-style input."""
+    _, size = world()
+    return per_rank(lambda r: np.full(shape, r), dtype=dtype)

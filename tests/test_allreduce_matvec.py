@@ -1,0 +1,119 @@
+"""Distributed matvec: the transpose-correctness acceptance suite.
+
+Port of ref tests/collective_ops/test_allreduce_matvec.py (239 LoC): a dense
+matrix A is column-sharded across ranks; ``A @ x`` needs one allreduce of the
+per-rank partial products, and ``jax.linear_transpose`` of that operator must
+give the exact row-sharded ``A.T @ y`` — "the transposed operator for free" —
+including through jit and double transposition (SURVEY.md §2.6(3)).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from helpers import world
+
+N = 16  # precondition n % size == 0 (ref test_allreduce_matvec.py:23)
+
+
+def _setup():
+    comm, size = world()
+    rng = np.random.RandomState(42)
+    A = rng.randn(N, N).astype(np.float32)
+    x = rng.randn(N).astype(np.float32)
+    cols = N // size
+    # global sharded operands: rank r holds A[:, r*cols:(r+1)*cols] and the
+    # corresponding slice of x
+    A_sharded = jnp.asarray(
+        np.stack([A[:, r * cols:(r + 1) * cols] for r in range(size)])
+    )
+    x_sharded = jnp.asarray(x.reshape(size, cols))
+    return A, x, A_sharded, x_sharded, size, cols
+
+
+def _matvec(A_local, x_local):
+    """Per-rank column-sharded matvec: partial = A_local @ x_local, allreduce."""
+    partial = A_local @ x_local
+    res, _ = mpx.allreduce(partial, op=mpx.SUM)
+    return res
+
+
+def test_matvec_forward():
+    A, x, A_sh, x_sh, size, cols = _setup()
+
+    @mpx.spmd
+    def f(Al, xl):
+        return _matvec(Al, xl)
+
+    out = np.asarray(f(A_sh, x_sh))
+    expected = A @ x
+    assert np.allclose(out, expected, atol=1e-4), np.abs(out - expected).max()
+
+
+def test_matvec_transpose():
+    # linear_transpose of the column-sharded matvec = row-sharded A.T @ y
+    A, x, A_sh, x_sh, size, cols = _setup()
+    rng = np.random.RandomState(7)
+    y = rng.randn(N).astype(np.float32)
+
+    @mpx.spmd
+    def f(Al, xl):
+        mv = lambda v: _matvec(Al, v)
+        t = jax.linear_transpose(mv, xl)
+        y_rep = jax.lax.psum(jnp.zeros((N,), jnp.float32), "mpi4jax") + jnp.asarray(y)
+        (ct,) = t(y_rep)
+        return ct
+
+    out = np.asarray(f(A_sh, x_sh))  # (size, cols)
+    expected = (A.T @ y).reshape(out.shape)
+    assert np.allclose(out, expected, atol=1e-4), np.abs(out - expected).max()
+
+
+def test_matvec_double_transpose():
+    A, x, A_sh, x_sh, size, cols = _setup()
+
+    @mpx.spmd
+    def f(Al, xl):
+        mv = lambda v: _matvec(Al, v)
+        t = jax.linear_transpose(mv, xl)
+        y_rep = jax.lax.psum(jnp.zeros((N,), jnp.float32), "mpi4jax")
+        t2 = jax.linear_transpose(lambda c: t(c)[0], y_rep)
+        return t2(xl)[0]
+
+    out = np.asarray(f(A_sh, x_sh))
+    expected = A @ x
+    assert np.allclose(out, expected, atol=1e-4)
+
+
+def test_matvec_vjp_matches_numpy():
+    A, x, A_sh, x_sh, size, cols = _setup()
+    rng = np.random.RandomState(3)
+    y = rng.randn(N).astype(np.float32)
+
+    @mpx.spmd
+    def f(Al, xl):
+        mv = lambda v: _matvec(Al, v)
+        out, vjp_fn = jax.vjp(mv, xl)
+        y_rep = jax.lax.psum(jnp.zeros((N,), jnp.float32), "mpi4jax") + jnp.asarray(y)
+        (ct,) = vjp_fn(y_rep)
+        return ct
+
+    out = np.asarray(f(A_sh, x_sh))
+    expected = (A.T @ y).reshape(out.shape)
+    assert np.allclose(out, expected, atol=1e-4)
+
+
+def test_matvec_jvp():
+    A, x, A_sh, x_sh, size, cols = _setup()
+
+    @mpx.spmd
+    def f(Al, xl):
+        mv = lambda v: _matvec(Al, v)
+        y, dy = jax.jvp(mv, (xl,), (jnp.ones_like(xl),))
+        return dy
+
+    out = np.asarray(f(A_sh, x_sh))
+    expected = A @ np.ones(N, np.float32)
+    assert np.allclose(out, expected, atol=1e-4)

@@ -1,0 +1,245 @@
+"""Per-op correctness for the collective family.
+
+Ports the per-op suites in ref tests/collective_ops/ (allgather, alltoall,
+bcast, gather, scatter, reduce, scan, barrier) — eager + jit variants, shape
+contracts, and the rank-dependent-result contracts where preserved.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from helpers import per_rank, ranks_arange, world
+
+
+def test_allgather():
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allgather(x)
+        return res
+
+    x = per_rank(lambda r: np.full((3,), r))
+    out = np.asarray(f(x))  # (size, size, 3)
+    for r in range(size):
+        assert np.allclose(out[r], np.arange(size)[:, None] * np.ones(3))
+
+
+def test_allgather_eager():
+    _, size = world()
+    x = per_rank(lambda r: np.full((3,), r))
+    res, token = mpx.allgather(x)
+    assert res.shape == (size, size, 3)
+    assert np.allclose(np.asarray(res)[0], np.asarray(res)[size - 1])
+
+
+def test_alltoall():
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.alltoall(x)
+        return res
+
+    # rank r sends value r*size+i to rank i
+    x = per_rank(lambda r: np.arange(r * size, (r + 1) * size, dtype=np.float32)[:, None])
+    out = np.asarray(f(x))  # (size, size, 1)
+    for r in range(size):
+        # rank r receives from rank i: i*size + r
+        assert np.allclose(out[r, :, 0], np.arange(size) * size + r)
+
+
+def test_alltoall_shape_check():
+    _, size = world()
+    with pytest.raises(ValueError, match="leading axis"):
+        @mpx.spmd
+        def f(x):
+            res, _ = mpx.alltoall(x)
+            return res
+
+        f(per_rank(lambda r: np.zeros((size + 1, 2))))
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_bcast(root):
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.bcast(x, root)
+        return res
+
+    x = ranks_arange((2, 2))
+    out = np.asarray(f(x))
+    assert np.allclose(out, root)
+
+
+def test_bcast_bool():
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.bcast(x, 1)
+        return res
+
+    x = per_rank(lambda r: np.array([r == 1, False]), dtype=jnp.bool_)
+    out = np.asarray(f(x))
+    assert out.dtype == bool
+    assert out[:, 0].all() and not out[:, 1].any()
+
+
+def test_bcast_grad():
+    # differentiable broadcast: cotangents route back to root
+    _, size = world()
+
+    def loss(x):
+        @mpx.spmd
+        def per_rank_f(xl):
+            y, _ = mpx.bcast(xl, 0)
+            return jnp.sum(y ** 2)
+
+        return jnp.sum(per_rank_f(x))
+
+    x = ranks_arange((2,))
+    g = np.asarray(jax.grad(loss)(x))
+    # every rank's output is root's value (0.0 here broadcast from rank 0);
+    # d/dx_root sum_r (x_root^2) = 2 * size * x_root; non-root grads are 0
+    assert np.allclose(g[0], 2 * size * 0.0)
+    assert np.allclose(g[1:], 0.0)
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_gather(root):
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.gather(x, root)
+        return res
+
+    x = per_rank(lambda r: np.full((2,), r))
+    out = np.asarray(f(x))  # uniform (size, size, 2) — documented divergence
+    assert np.allclose(out[root], np.arange(size)[:, None] * np.ones(2))
+
+
+@pytest.mark.parametrize("root", [0, 5])
+def test_scatter(root):
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.scatter(x, root)
+        return res
+
+    # only root's buffer should matter: poison other ranks' buffers
+    def buf(r):
+        if r == root:
+            return np.arange(size, dtype=np.float32)[:, None]
+        return np.full((size, 1), -99.0, dtype=np.float32)
+
+    out = np.asarray(f(per_rank(buf)))
+    assert np.allclose(out[:, 0], np.arange(size))
+
+
+def test_scatter_shape_check():
+    _, size = world()
+    with pytest.raises(ValueError, match="leading axis"):
+        @mpx.spmd
+        def f(x):
+            res, _ = mpx.scatter(x, 0)
+            return res
+
+        f(per_rank(lambda r: np.zeros((3,))))
+
+
+@pytest.mark.parametrize("root", [0, 4])
+def test_reduce(root):
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.reduce(x, mpx.SUM, root)
+        return res
+
+    x = ranks_arange((2,))
+    out = np.asarray(f(x))
+    total = size * (size - 1) / 2
+    # ref contract (reduce.py:77-80): root gets reduction, others their input
+    assert np.allclose(out[root], total)
+    for r in range(size):
+        if r != root:
+            assert np.allclose(out[r], r)
+
+
+@pytest.mark.parametrize(
+    "op,npfn",
+    [(mpx.SUM, np.cumsum), (mpx.MAX, np.maximum.accumulate),
+     (mpx.PROD, np.cumprod), (mpx.MIN, np.minimum.accumulate)],
+)
+def test_scan(op, npfn):
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.scan(x, op=op)
+        return res
+
+    vals = np.linspace(1.5, 0.5, size).astype(np.float32).reshape(size, 1)
+    out = np.asarray(f(jnp.asarray(vals)))
+    assert np.allclose(out, npfn(vals, axis=0), rtol=1e-5), (out, npfn(vals, axis=0))
+
+
+def test_scan_int():
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.scan(x, op=mpx.SUM)
+        return res
+
+    x = per_rank(lambda r: np.full((1,), r), dtype=jnp.int32)
+    out = np.asarray(f(x))
+    assert np.array_equal(out[:, 0], np.cumsum(np.arange(size)))
+
+
+def test_barrier():
+    @mpx.spmd
+    def f(x):
+        token = mpx.barrier()
+        y, _ = mpx.allreduce(x, token=token)
+        return y
+
+    _, size = world()
+    out = np.asarray(f(ranks_arange(())))
+    assert np.allclose(out, size * (size - 1) / 2)
+
+
+def test_barrier_eager():
+    token = mpx.barrier()
+    assert isinstance(token, mpx.Token)
+
+
+def test_chained_mixed_ops():
+    # a chain across op families through one token
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        token = mpx.create_token()
+        a, token = mpx.bcast(x, 0, token=token)
+        b, token = mpx.allreduce(x, op=mpx.SUM, token=token)
+        c, token = mpx.scan(x, op=mpx.SUM, token=token)
+        token = mpx.barrier(token=token)
+        d, token = mpx.allgather(x, token=token)
+        return a + b + c + jnp.sum(d)
+
+    out = f(ranks_arange(()))
+    total = size * (size - 1) / 2
+    ranks = np.arange(size)
+    expected = 0 + total + np.cumsum(ranks) + total
+    assert np.allclose(np.asarray(out), expected)

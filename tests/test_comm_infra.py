@@ -1,0 +1,186 @@
+"""Comm structure, eager mode, dtypes, validation, config, capability probes.
+
+Ports ref tests/test_validation.py, test_decorators.py (env parsing),
+test_has_cuda.py / test_has_sycl.py (probes), and the comm-handling parts of
+test_common.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.utils.config import parse_env_bool
+from helpers import per_rank, ranks_arange, world
+
+
+def test_comm_size_rank():
+    comm, size = world()
+    assert comm.Get_size() == jax.device_count()
+
+    @mpx.spmd
+    def f(x):
+        r = mpx.get_default_comm().Get_rank()
+        return x * 0 + r
+
+    out = np.asarray(f(ranks_arange((1,))))[:, 0]
+    assert np.allclose(out, np.arange(size))
+
+
+def test_comm_clone_distinct_uid():
+    comm, _ = world()
+    clone = comm.Clone()
+    assert clone.uid != comm.uid
+    assert clone.axes == comm.axes
+    assert clone.Get_size() == comm.Get_size()
+
+
+def test_comm_2d_mesh_sub():
+    mesh = mpx.make_world_mesh((4, 2), ("y", "x"))
+    comm = mpx.Comm(("y", "x"), mesh=mesh)
+    assert comm.Get_size() == 8
+
+    @mpx.spmd(comm=comm)
+    def f(xl):
+        row = mpx.get_default_comm().sub("x")
+        col = mpx.get_default_comm().sub("y")
+        rs, _ = mpx.allreduce(xl, op=mpx.SUM, comm=row)
+        cs, _ = mpx.allreduce(xl, op=mpx.SUM, comm=col)
+        return rs, cs
+
+    x = jnp.arange(8.0)[:, None]
+    rs, cs = f(x)
+    rs, cs = np.asarray(rs)[:, 0], np.asarray(cs)[:, 0]
+    # row-major (y,x): linear rank r has y=r//2, x=r%2
+    assert np.allclose(rs, [1, 1, 5, 5, 9, 9, 13, 13])  # sums over x
+    assert np.allclose(cs, [12, 16, 12, 16, 12, 16, 12, 16])  # sums over y
+
+
+def test_comm_multi_axis_allreduce():
+    mesh = mpx.make_world_mesh((4, 2), ("y", "x"))
+    comm = mpx.Comm(("y", "x"), mesh=mesh)
+
+    @mpx.spmd(comm=comm)
+    def f(xl):
+        s, _ = mpx.allreduce(xl, op=mpx.SUM)
+        return s
+
+    out = np.asarray(f(jnp.arange(8.0)[:, None]))
+    assert np.allclose(out, 28.0)
+
+
+def test_comm_rank_row_major():
+    mesh = mpx.make_world_mesh((4, 2), ("y", "x"))
+    comm = mpx.Comm(("y", "x"), mesh=mesh)
+
+    @mpx.spmd(comm=comm)
+    def f(xl):
+        return xl * 0 + comm.Get_rank()
+
+    out = np.asarray(f(jnp.zeros((8, 1))))[:, 0]
+    assert np.allclose(out, np.arange(8))
+
+
+def test_p2p_requires_single_axis():
+    mesh = mpx.make_world_mesh((4, 2), ("y", "x"))
+    comm = mpx.Comm(("y", "x"), mesh=mesh)
+    with pytest.raises(ValueError, match="single-axis"):
+        @mpx.spmd(comm=comm)
+        def f(xl):
+            y, _ = mpx.sendrecv(xl, xl, dest=mpx.shift(1))
+            return y
+
+        f(jnp.zeros((8, 1)))
+
+
+def test_unbound_comm_error():
+    comm = mpx.Comm("nonexistent_axis")
+    with pytest.raises(RuntimeError, match="not bound"):
+        comm.Get_size()
+
+
+def test_eager_wrong_leading_axis():
+    with pytest.raises(ValueError, match="leading rank axis"):
+        mpx.allreduce(jnp.zeros((3, 2)))
+
+
+def test_eager_token_roundtrip():
+    x = ranks_arange((2,))
+    res, token = mpx.allreduce(x)
+    res2, token2 = mpx.allreduce(x, token=token)
+    assert np.allclose(np.asarray(res), np.asarray(res2))
+
+
+def test_unsupported_dtype():
+    # f64 works on CPU; check the rejection path with a genuinely
+    # unsupported width via a numpy structured view is overkill — use
+    # float128 if the platform has it
+    if not hasattr(np, "float128"):
+        pytest.skip("platform lacks float128")
+    x = np.zeros((8, 2), dtype=np.float128)
+    with pytest.raises((TypeError, ValueError)):
+        @mpx.spmd
+        def f(xl):
+            return mpx.allreduce(xl)[0]
+
+        f(x)
+
+
+def test_parse_env_bool(monkeypatch):
+    # ref tests/test_decorators.py truthy-env parsing
+    for v in ("1", "true", "ON", "yes"):
+        monkeypatch.setenv("MPI4JAX_TPU_TESTFLAG", v)
+        assert parse_env_bool("MPI4JAX_TPU_TESTFLAG") is True
+    for v in ("0", "false", "OFF", "no", ""):
+        monkeypatch.setenv("MPI4JAX_TPU_TESTFLAG", v)
+        assert parse_env_bool("MPI4JAX_TPU_TESTFLAG") is False
+    monkeypatch.setenv("MPI4JAX_TPU_TESTFLAG", "maybe")
+    with pytest.raises(ValueError, match="could not be parsed"):
+        parse_env_bool("MPI4JAX_TPU_TESTFLAG")
+    monkeypatch.delenv("MPI4JAX_TPU_TESTFLAG")
+    assert parse_env_bool("MPI4JAX_TPU_TESTFLAG", True) is True
+
+
+def test_capability_probes():
+    # ref tests/test_has_cuda.py / test_has_sycl.py
+    assert mpx.has_cuda_support() in (True, False)
+    assert mpx.has_tpu_support() in (True, False)
+    assert mpx.has_sycl_support() is False
+    # CPU test backend: no cuda/tpu
+    assert not mpx.has_cuda_support()
+
+
+def test_public_api_surface():
+    # the reference's 12 ops + probes (ref mpi4jax/__init__.py:26-41) must
+    # all be importable from the top level
+    for name in [
+        "allgather", "allreduce", "alltoall", "barrier", "bcast", "gather",
+        "recv", "reduce", "scan", "scatter", "send", "sendrecv",
+        "has_cuda_support", "has_sycl_support", "has_tpu_support",
+    ]:
+        assert hasattr(mpx, name), name
+
+
+def test_debug_logging_format(capfd):
+    # ref tests/collective_ops/test_common.py:118-144 — debug log format
+    # r{rank} | {8 hex} | MPI_X asserted on captured output
+    import re
+
+    from mpi4jax_tpu.utils import debug
+
+    debug.set_logging(True)
+    try:
+        @mpx.spmd
+        def f(x):
+            res, _ = mpx.allreduce(x, op=mpx.SUM)
+            return res
+
+        out = f(ranks_arange((1,)))
+        out.block_until_ready()
+        jax.effects_barrier()
+    finally:
+        debug.set_logging(False)
+    captured = capfd.readouterr()
+    text = captured.out + captured.err
+    assert re.search(r"r\d+ \| [0-9a-f]{8} \| MPI_Allreduce", text), text[:500]
