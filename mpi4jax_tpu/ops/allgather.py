@@ -25,7 +25,7 @@ def allgather(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
         (xl,) = arrays
         xl = consume(token, xl)
         log_op("MPI_Allgather", comm.Get_rank(), f"sending {xl.size} items")
-        res = lax.all_gather(xl, comm.axis, axis=0, tiled=False)
+        res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
         return res, produce(token, res)
 
     return dispatch("allgather", comm, body, (x,), token)

@@ -39,7 +39,9 @@ def gather(x, root: int, *, comm: Optional[Comm] = None,
         xl = consume(token, xl)
         log_op("MPI_Gather", comm.Get_rank(),
                f"sending {xl.size} items to root {root}")
-        res = lax.all_gather(xl, comm.axis, axis=0, tiled=False)
+        # multi-axis comms gather in row-major rank order (axis tuples are
+        # supported natively by the AllGather lowering)
+        res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
         return res, produce(token, res)
 
     return dispatch("gather", comm, body, (x,), token)

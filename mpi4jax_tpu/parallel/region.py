@@ -108,6 +108,21 @@ def spmd(
     """
 
     def wrap(f):
+        # One compiled program per (mesh, comm) — built lazily on first call
+        # and reused, so host loops over an spmd function hit the jit cache
+        # instead of re-tracing every iteration.
+        program_cache = {}
+
+        # normalize like jax.jit: accept a bare int, sort ascending (the
+        # re-interleaving insert below requires ascending order); negative
+        # indices are resolved against the actual call arity per call
+        if static_argnums is None:
+            statics_raw = ()
+        elif isinstance(static_argnums, int):
+            statics_raw = (static_argnums,)
+        else:
+            statics_raw = tuple(static_argnums)
+
         @functools.wraps(f)
         def wrapped(*args, **kwargs):
             c = resolve_comm(comm)
@@ -116,36 +131,59 @@ def spmd(
                     "spmd requires a comm bound to a mesh (comm.bind(mesh)) "
                     "or an available default mesh"
                 )
-            axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])
-            ispecs = in_specs if in_specs is not None else axes_spec
-            ospecs = out_specs if out_specs is not None else axes_spec
-            # Default-spec convention: a global array is (size, *local_shape),
-            # global[r] being rank r's value — so the body sees true local
-            # shapes, we squeeze the sharded leading axis on the way in and
-            # restore it on the way out. Custom specs disable this.
-            squeeze_in = in_specs is None
-            squeeze_out = out_specs is None
+            # static args are closed over (they never enter shard_map, whose
+            # in_specs only describe arrays); the cache is keyed on their
+            # values, mirroring jit's static_argnums semantics
+            statics = tuple(sorted(
+                i if i >= 0 else i + len(args) for i in statics_raw
+            ))
+            for i in statics:
+                if not 0 <= i < len(args):
+                    raise ValueError(
+                        f"static_argnums entry {i} out of range for "
+                        f"{len(args)} positional arguments"
+                    )
+            static_vals = tuple(args[i] for i in statics)
+            dyn_args = tuple(a for i, a in enumerate(args) if i not in statics)
+            key = (c.mesh, c.uid, statics, static_vals)
+            sm = program_cache.get(key)
+            if sm is None:
+                axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])
+                ispecs = in_specs if in_specs is not None else axes_spec
+                ospecs = out_specs if out_specs is not None else axes_spec
+                # Default-spec convention: a global array is
+                # (size, *local_shape), global[r] being rank r's value — so
+                # the body sees true local shapes, we squeeze the sharded
+                # leading axis on the way in and restore it on the way out.
+                # Custom specs disable this.
+                squeeze_in = in_specs is None
+                squeeze_out = out_specs is None
 
-            def body(*a, **kw):
-                ctx = RegionContext(c)
-                _region_stack.append(ctx)
-                try:
-                    if squeeze_in:
-                        a, kw = jax.tree.map(lambda v: v[0], (a, kw))
-                    out = f(*a, **kw)
-                    if squeeze_out:
-                        out = jax.tree.map(lambda v: v[None], out)
-                    ctx.check_drained()
-                    return out
-                finally:
-                    _region_stack.pop()
+                def body(*a, **kw):
+                    ctx = RegionContext(c)
+                    _region_stack.append(ctx)
+                    try:
+                        if squeeze_in:
+                            a, kw = jax.tree.map(lambda v: v[0], (a, kw))
+                        # re-interleave the closed-over static args
+                        full = list(a)
+                        for i, v in zip(statics, static_vals):
+                            full.insert(i, v)
+                        out = f(*full, **kw)
+                        if squeeze_out:
+                            out = jax.tree.map(lambda v: v[None], out)
+                        ctx.check_drained()
+                        return out
+                    finally:
+                        _region_stack.pop()
 
-            sm = jax.shard_map(
-                body, mesh=c.mesh, in_specs=ispecs, out_specs=ospecs
-            )
-            if jit:
-                sm = jax.jit(sm, static_argnums=static_argnums)
-            return sm(*args, **kwargs)
+                sm = jax.shard_map(
+                    body, mesh=c.mesh, in_specs=ispecs, out_specs=ospecs
+                )
+                if jit:
+                    sm = jax.jit(sm)
+                program_cache[key] = sm
+            return sm(*dyn_args, **kwargs)
 
         return wrapped
 
