@@ -1,0 +1,46 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Workload: the reference's published benchmark (BASELINE.md) — the
+shallow-water solver at 10x linear scale (3600 x 1800 interior), 0.1
+simulated days, timed after warm-up compile, exactly the reference's
+protocol (ref docs/shallow-water.rst:44-55).
+
+Metric: steps/sec/chip.  ``vs_baseline`` compares wall time against the
+reference's best published single-device result (Tesla P100, 6.28 s for
+the same workload, ref docs/shallow-water.rst:81-83): values > 1 mean
+faster than the reference's GPU.
+"""
+
+import json
+import sys
+
+import jax
+
+
+def main():
+    sys.path.insert(0, "examples")
+    from shallow_water import DAY_IN_SECONDS, Config, pick_process_grid, solve
+
+    devices = jax.devices()
+    nproc_y, nproc_x = pick_process_grid(len(devices))
+    cfg = Config(nproc_y=nproc_y, nproc_x=nproc_x, nx=3600, ny=1800)
+    t1 = 0.1 * DAY_IN_SECONDS
+
+    _, wall, n_steps = solve(cfg, t1, devices=devices, collect=False)
+
+    steps_per_sec_per_chip = n_steps / wall / len(devices)
+    ref_gpu_wall = 6.28  # Tesla P100, 1 process (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "shallow-water steps/sec/chip (3600x1800, 0.1 days)",
+                "value": round(steps_per_sec_per_chip, 2),
+                "unit": "steps/s/chip",
+                "vs_baseline": round(ref_gpu_wall / wall, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

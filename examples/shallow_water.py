@@ -401,27 +401,33 @@ def solve(cfg: Config, t1: float, *, num_multisteps: int = 10, devices=None,
     t = cfg.dt
 
     # warm-up compile (excluded from timing, like the reference's
-    # pre-compilation at examples/shallow_water.py:449-450)
-    multistep(state, num_multisteps)[0].block_until_ready()
+    # pre-compilation at examples/shallow_water.py:449-450); the host fetch
+    # drains the async dispatch queue — block_until_ready alone is not a
+    # reliable sync point on remote-attached devices
+    np.asarray(multistep(state, num_multisteps).h)
 
     n_steps = 1
     start = time.perf_counter()
     while t < t1:
         state = multistep(state, num_multisteps)
-        state.h.block_until_ready()
         if collect:
-            snapshots.append(np.asarray(state.h))
+            snapshots.append(np.asarray(state.h))  # device->host sync
         t += cfg.dt * num_multisteps
         n_steps += num_multisteps
         if verbose:
             print(f"  t = {t / DAY_IN_SECONDS:.3f} days", end="\r")
+    if not collect:
+        # pipelined throughput mode: one sync at the end
+        np.asarray(state.h)
     wall = time.perf_counter() - start
 
     # collect the full solution at rank 0 — exercises the eager gather path
-    # (ref examples/shallow_water.py:588 uses mpi4jax.gather the same way)
+    # (ref examples/shallow_water.py:588 uses mpi4jax.gather the same way);
+    # appended as an extra snapshot, so the last two entries hold the same
+    # final state (stacked view, then root-gathered view)
     if collect:
         gathered, _ = mpx.gather(state.h, root=0, comm=comm)
-        snapshots[-1] = np.asarray(gathered[0])
+        snapshots.append(np.asarray(gathered[0]))
 
     return snapshots, wall, n_steps
 
@@ -453,10 +459,15 @@ def save_animation(snapshots, cfg: Config, path: str = "shallow-water.gif"):
 
 
 def pick_process_grid(n: int):
-    """Same decomposition rule as the reference: nproc_y = min(n, 2)
-    (ref examples/shallow_water.py:63-64)."""
+    """Same decomposition rule as the reference: nproc_y = min(n, 2), and
+    even device counts only above 1 (ref examples/shallow_water.py:57-64
+    validates against its supported process counts the same way)."""
     nproc_y = min(n, 2)
-    assert n % nproc_y == 0
+    if n % nproc_y != 0:
+        raise ValueError(
+            f"Got invalid number of devices: {n}. Use 1 or an even count "
+            "(the domain is decomposed over a (2, n//2) grid)."
+        )
     return nproc_y, n // nproc_y
 
 

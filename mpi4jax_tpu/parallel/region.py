@@ -134,9 +134,9 @@ def spmd(
             # static args are closed over (they never enter shard_map, whose
             # in_specs only describe arrays); the cache is keyed on their
             # values, mirroring jit's static_argnums semantics
-            statics = tuple(sorted(
+            statics = tuple(sorted({
                 i if i >= 0 else i + len(args) for i in statics_raw
-            ))
+            }))
             for i in statics:
                 if not 0 <= i < len(args):
                     raise ValueError(
@@ -144,6 +144,13 @@ def spmd(
                         f"{len(args)} positional arguments"
                     )
             static_vals = tuple(args[i] for i in statics)
+            try:
+                hash(static_vals)
+            except TypeError as e:
+                raise TypeError(
+                    f"spmd static argument values must be hashable (like "
+                    f"jax.jit static_argnums); got {static_vals!r}"
+                ) from e
             dyn_args = tuple(a for i, a in enumerate(args) if i not in statics)
             key = (c.mesh, c.uid, statics, static_vals)
             sm = program_cache.get(key)

@@ -54,8 +54,11 @@ def test_shallow_water_decomposition_invariance():
 def test_shallow_water_gathered_solution_matches_stacked():
     cfg = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
     snaps, _, _ = solve(cfg, 10 * cfg.dt, num_multisteps=5)
-    # the last snapshot is the eager-gather copy of the stacked state
+    # the last snapshot is the eager-gather copy of the final stacked state:
+    # identical values in identical rank order (catches any gather
+    # rank-ordering regression on the multi-axis comm)
     assert snaps[-1].shape == snaps[0].shape
+    np.testing.assert_array_equal(snaps[-1], snaps[-2])
 
 
 def test_initial_state_decomposition_independent():
