@@ -12,13 +12,16 @@ faster than the reference's GPU.
 """
 
 import json
+import os
 import sys
 
 import jax
 
 
 def main():
-    sys.path.insert(0, "examples")
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples")
+    )
     from shallow_water import DAY_IN_SECONDS, Config, pick_process_grid, solve
 
     devices = jax.devices()

@@ -1,0 +1,196 @@
+"""Native host-hooks: loading, registration, and jit-visible wrappers.
+
+The reference's native layer (Cython XLA custom-call bridge,
+ref mpi4jax/_src/xla_bridge/*.pyx) *is* the transport; here the transport is
+XLA collective HLO, and the native library (csrc/host_hooks.cc) instead
+provides the host-side runtime services around it:
+
+- ``op_begin``/``op_end`` — per-op runtime logging and wall-clock latency in
+  the reference's debug format (ref mpi_xla_bridge.pyx:47-60, 100-112),
+  threaded into the program with data dependencies so the host timestamps
+  bracket the collective's execution;
+- ``abort_if`` — data-dependent fail-fast (MPI_Abort-on-error semantics,
+  ref mpi_xla_bridge.pyx:67-91): if the predicate is true at run time the
+  whole process dies, not just the computation;
+- ``wallclock`` — host timestamp as an in-graph value.
+
+All hooks are CPU-backend custom calls (the test/dev backend).  On TPU the
+compute path has no host hooks by design — ``runtime_tracing_supported()``
+reports availability, and the pure-Python fallbacks (``jax.debug.callback``)
+cover platforms without the native library.
+
+Build the library with ``python -m mpi4jax_tpu.native build``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+_LIB_PATH = os.path.join(_LIB_DIR, "libmpx_hooks.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_registered = False
+
+_HANDLERS = ("MpxOpBegin", "MpxOpEnd", "MpxAbortIf", "MpxWallclock")
+_TARGETS = ("mpx_op_begin", "mpx_op_end", "mpx_abort_if", "mpx_wallclock")
+
+
+def build(verbose: bool = True) -> str:
+    """Compile csrc/host_hooks.cc → mpi4jax_tpu/_lib/libmpx_hooks.so.
+
+    Direct g++ invocation (no build system needed); csrc/CMakeLists.txt
+    offers the same build for CMake users.
+    """
+    src = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "csrc", "host_hooks.cc"
+    )
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+        f"-I{jax.ffi.include_dir()}",
+        os.path.abspath(src), "-o", _LIB_PATH,
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return _LIB_PATH
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _registered
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    _lib = ctypes.CDLL(_LIB_PATH)
+    if not _registered:
+        for handler, target in zip(_HANDLERS, _TARGETS):
+            jax.ffi.register_ffi_target(
+                target,
+                jax.ffi.pycapsule(getattr(_lib, handler)),
+                platform="cpu",
+            )
+        _registered = True
+    return _lib
+
+
+def available() -> bool:
+    """True if the native hooks library is built and loadable."""
+    return _load() is not None
+
+
+def runtime_tracing_supported() -> bool:
+    """Native runtime op tracing runs on the CPU backend only (on TPU the
+    compute path is pure HLO with no host hooks, by design)."""
+    return available() and jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# jit-visible wrappers
+# ---------------------------------------------------------------------------
+
+
+def _tie(x, dep):
+    """Make ``x`` depend on ``dep`` (ordering via OptimizationBarrier)."""
+    x, _ = lax.optimization_barrier((x, dep))
+    return x
+
+
+def op_begin(opname: str, call_id: str, rank, detail: str = ""):
+    """Log op entry on the host; returns a u32 the collective's inputs
+    should be tied to (so the timestamp precedes the collective)."""
+    call = jax.ffi.ffi_call(
+        "mpx_op_begin",
+        jax.ShapeDtypeStruct((), jnp.uint32),
+        has_side_effect=True,
+    )
+    return call(
+        jnp.asarray(rank, jnp.uint32), opname=opname, call_id=call_id, detail=detail
+    )
+
+
+def op_end(opname: str, call_id: str, rank, dep):
+    """Log op completion + elapsed; ``dep`` ties the call after the
+    collective's outputs."""
+    call = jax.ffi.ffi_call(
+        "mpx_op_end",
+        jax.ShapeDtypeStruct((), jnp.uint32),
+        has_side_effect=True,
+    )
+    return call(_tie(jnp.asarray(rank, jnp.uint32), dep),
+                opname=opname, call_id=call_id)
+
+
+def abort_if(pred, rank, message: str):
+    """Kill the process if ``pred`` is true at run time (fail-fast,
+    ref mpi_xla_bridge.pyx:67-91 ``abort_on_error``).
+
+    Falls back to ``jax.debug.callback`` + ``os.abort`` off-CPU or without
+    the native library.  Returns a u32 to thread into downstream values if
+    the caller wants the check ordered before them.
+    """
+    pred = jnp.asarray(pred).astype(jnp.uint32).reshape(())
+    rank = jnp.asarray(rank, jnp.uint32)
+    if runtime_tracing_supported():
+        call = jax.ffi.ffi_call(
+            "mpx_abort_if",
+            jax.ShapeDtypeStruct((), jnp.uint32),
+            has_side_effect=True,
+        )
+        return call(pred, rank, message=message)
+
+    def _cb(p, r):
+        if p:
+            print(f"r{int(r)} | FATAL: {message}", file=sys.stderr, flush=True)
+            os.abort()
+
+    jax.debug.callback(_cb, pred, rank, ordered=False)
+    return pred
+
+
+def wallclock(dep=None):
+    """Host wall-clock timestamp (f64 seconds) as an in-graph value,
+    ordered after ``dep``."""
+    tok = jnp.zeros((), jnp.uint32) if dep is None else _tie(
+        jnp.zeros((), jnp.uint32), dep
+    )
+    if runtime_tracing_supported():
+        call = jax.ffi.ffi_call(
+            "mpx_wallclock",
+            jax.ShapeDtypeStruct((), jnp.float64),
+            has_side_effect=True,
+        )
+        return call(tok)
+    import time
+
+    def _now(_):
+        return jnp.asarray(time.perf_counter(), jnp.float64)
+
+    return jax.pure_callback(
+        _now, jax.ShapeDtypeStruct((), jnp.float64), tok
+    )
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv[:1] == ["build"]:
+        path = build()
+        print(f"built {path}")
+    else:
+        print("usage: python -m mpi4jax_tpu.native build", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

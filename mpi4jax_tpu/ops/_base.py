@@ -34,7 +34,7 @@ from ..parallel.region import (
     in_parallel_region,
     resolve_comm,
 )
-from ..utils.debug import log_op, op_scope
+from ..utils.debug import get_runtime_tracing, log_op, op_scope
 from ..utils.dtypes import check_dtype
 
 
@@ -161,6 +161,38 @@ def as_varying(x, axes: Tuple[str, ...]):
     return lax.pcast(x, missing, to="varying")
 
 
+def _mpi_opname(opname: str) -> str:
+    return "MPI_" + opname.capitalize()
+
+
+def _run_body(opname: str, comm: Comm, body, arrays, token):
+    """Run an op body, bracketed by native runtime begin/end hooks when
+    tracing is on (host-side log + measured per-op wall-clock latency; see
+    mpi4jax_tpu/native.py).  Data dependencies pin the hooks around the
+    collective: inputs are tied after ``op_begin``, ``op_end`` is tied to
+    the first output."""
+    from .. import native
+
+    if not (get_runtime_tracing() and native.runtime_tracing_supported()):
+        return body(comm, arrays, token)
+    import secrets
+
+    call_id = secrets.token_hex(4)
+    rank = comm.Get_rank()
+    name = _mpi_opname(opname)
+    begin = native.op_begin(name, call_id, rank, "")
+    arrays = tuple(native._tie(a, begin) for a in arrays)
+    out = body(comm, arrays, token)
+    results = [r for r in out if r is not None]
+    dep = results[0]
+    from .token import Token
+
+    if isinstance(dep, Token):
+        dep = dep.value
+    native.op_end(name, call_id, rank, dep)
+    return out
+
+
 def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
     """Run op ``body`` either inline (inside a parallel region) or eagerly.
 
@@ -181,7 +213,7 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
         # so every op accepts them (collectives are variant->invariant typed)
         arrays = tuple(as_varying(a, comm.axes) for a in arrays)
         with op_scope(opname):
-            return body(comm, arrays, token)
+            return _run_body(opname, comm, body, arrays, token)
 
     if comm.mesh is None:
         raise RuntimeError(
@@ -208,7 +240,9 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
         try:
             with op_scope(opname):
                 # shard_map hands us (1, *local); body wants (*local,)
-                out = body(comm, tuple(a[0] for a in arrs), tok)
+                out = _run_body(
+                    opname, comm, body, tuple(a[0] for a in arrs), tok
+                )
             ctx.check_drained()
         finally:
             _region_stack.pop()

@@ -15,7 +15,12 @@ here, and why:
 import jax
 
 from .config import parse_env_bool, prefer_notoken  # noqa: F401
-from .debug import get_logging, set_logging  # noqa: F401
+from .debug import (  # noqa: F401
+    get_logging,
+    get_runtime_tracing,
+    set_logging,
+    set_runtime_tracing,
+)
 from .dtypes import SUPPORTED_DTYPES, check_dtype  # noqa: F401
 from .flush import flush  # noqa: F401
 from .validation import enforce_types  # noqa: F401

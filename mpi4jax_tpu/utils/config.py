@@ -8,6 +8,9 @@ TPU-native analog of the reference's env-flag configuration
 Recognized variables:
 
 - ``MPI4JAX_TPU_DEBUG``     — per-op debug logging (``r{rank} | {id} | …`` format).
+- ``MPI4JAX_TPU_TRACE``     — native runtime op tracing: host-side begin/end
+  log lines with measured wall-clock latency per collective, via the C++
+  host-hooks library (CPU backend; see mpi4jax_tpu/native.py).
 - ``MPI4JAX_TPU_PREFER_NOTOKEN`` — make the token API delegate to the notoken
   (implicit-ordering) implementation.
 - ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the JAX version advisory.
@@ -41,6 +44,10 @@ def parse_env_bool(name: str, default: bool = False) -> bool:
 
 def debug_enabled() -> bool:
     return parse_env_bool("MPI4JAX_TPU_DEBUG", False)
+
+
+def trace_enabled() -> bool:
+    return parse_env_bool("MPI4JAX_TPU_TRACE", False)
 
 
 def prefer_notoken() -> bool:

@@ -21,9 +21,10 @@ from contextlib import contextmanager
 
 import jax
 
-from .config import debug_enabled
+from .config import debug_enabled, trace_enabled
 
 _logging_enabled = debug_enabled()
+_tracing_enabled = trace_enabled()
 
 
 def set_logging(enabled: bool) -> None:
@@ -35,6 +36,17 @@ def set_logging(enabled: bool) -> None:
 def get_logging() -> bool:
     """Analog of ref mpi_xla_bridge.pyx:43-44 ``get_logging``."""
     return _logging_enabled
+
+
+def set_runtime_tracing(enabled: bool) -> None:
+    """Toggle native runtime op tracing (host-side begin/end + latency via
+    the C++ hooks library; see mpi4jax_tpu/native.py)."""
+    global _tracing_enabled
+    _tracing_enabled = bool(enabled)
+
+
+def get_runtime_tracing() -> bool:
+    return _tracing_enabled
 
 
 def log_op(opname: str, rank, detail: str = "") -> None:
