@@ -1,0 +1,137 @@
+"""Microbenchmarks: allreduce bandwidth + point-to-point latency.
+
+The reference publishes no microbenchmarks (BASELINE.md: `published: {}`);
+these fill that gap with the two north-star metrics from BASELINE.json:
+
+- **allreduce bus bandwidth** (GB/s per device) over a size sweep — on a
+  TPU slice this measures ICI; algorithmic bytes per device for a ring
+  allreduce are ``2 * (n-1)/n * size`` (the standard bus-bandwidth
+  convention, so numbers are comparable across device counts);
+- **sendrecv ring latency** (µs per hop) — the halo-exchange primitive.
+
+Usage:  python benchmarks/micro.py [--json]
+
+Timing protocol: each measurement chains ``iters`` collectives inside one
+jitted program (so dispatch overhead amortizes), syncs via a host fetch
+(remote-attached devices do not honor block_until_ready), and reports the
+best of 3 trials.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+
+def _time_program(fn, args, trials=3):
+    """Best-of-N wall time of ``fn(*args)`` with host-fetch sync."""
+    out = fn(*args)  # compile
+    np.asarray(jax.tree.leaves(out)[0].ravel()[0])  # sync, single element
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_allreduce(comm, sizes_mb, iters=20):
+    n = comm.Get_size()
+    rows = []
+    for mb in sizes_mb:
+        nelem = max(1, int(mb * 1e6 / 4))
+
+        @mpx.spmd(comm=comm)
+        def prog(x):
+            def body(_, v):
+                s, _tok = mpx.allreduce(v, op=mpx.SUM)
+                return mpx.varying(s * (1.0 / n))  # keep values bounded
+
+            return jax.lax.fori_loop(0, iters, body, x)
+
+        x = jnp.ones((n, nelem), jnp.float32)
+        t = _time_program(prog, (x,)) / iters
+        # ring-allreduce bus bandwidth per device
+        bus_bytes = 2 * (n - 1) / n * nelem * 4
+        rows.append({
+            "size_mb": round(nelem * 4 / 1e6, 3),
+            "time_us": round(t * 1e6, 1),
+            "bus_gb_s": round(bus_bytes / t / 1e9, 2) if n > 1 else None,
+        })
+    return rows
+
+
+def bench_sendrecv_ring(comm, sizes_kb, iters=50):
+    n = comm.Get_size()
+    rows = []
+    for kb in sizes_kb:
+        nelem = max(1, int(kb * 1e3 / 4))
+
+        @mpx.spmd(comm=comm)
+        def prog(x):
+            def body(_, v):
+                r, _tok = mpx.sendrecv(v, v, dest=mpx.shift(1))
+                return r
+
+            return jax.lax.fori_loop(0, iters, body, x)
+
+        x = jnp.ones((n, nelem), jnp.float32)
+        t = _time_program(prog, (x,)) / iters
+        rows.append({
+            "size_kb": round(nelem * 4 / 1e3, 2),
+            "hop_us": round(t * 1e6, 2),
+            "link_gb_s": round(nelem * 4 / t / 1e9, 2) if n > 1 else None,
+        })
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument("--sizes-mb", type=float, nargs="+",
+                   default=[0.004, 0.25, 1, 4, 16, 64])
+    p.add_argument("--sizes-kb", type=float, nargs="+",
+                   default=[0.004, 4, 64, 1024])
+    args = p.parse_args()
+
+    devices = jax.devices()
+    mesh = mpx.make_world_mesh(devices=devices)
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+
+    ar = bench_allreduce(comm, args.sizes_mb)
+    pp = bench_sendrecv_ring(comm, args.sizes_kb)
+
+    if args.json:
+        print(json.dumps({
+            "platform": devices[0].platform,
+            "n_devices": n,
+            "allreduce": ar,
+            "sendrecv_ring": pp,
+        }))
+        return
+
+    print(f"platform={devices[0].platform} n_devices={n}")
+    print("\nallreduce (SUM, f32)          time/op      bus bandwidth/device")
+    for r in ar:
+        bw = f"{r['bus_gb_s']} GB/s" if r["bus_gb_s"] is not None else "n/a (1 device)"
+        print(f"  {r['size_mb']:>10.3f} MB   {r['time_us']:>10.1f} us   {bw}")
+    print("\nsendrecv ring (shift(1))      time/hop     link bandwidth")
+    for r in pp:
+        bw = f"{r['link_gb_s']} GB/s" if r["link_gb_s"] is not None else "n/a (1 device)"
+        print(f"  {r['size_kb']:>10.2f} KB   {r['hop_us']:>10.2f} us   {bw}")
+
+
+if __name__ == "__main__":
+    main()

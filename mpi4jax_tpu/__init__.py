@@ -65,6 +65,12 @@ from .utils import (  # noqa: F401
     has_tpu_support,
 )
 
+# JAX version advisory at import (ref mpi4jax/_src/__init__.py:6-8).
+from .utils.jax_compat import check_jax_version as _check_jax_version
+
+_check_jax_version()
+del _check_jax_version
+
 # Exit-time flush: keep the reference's guarantee that pending async
 # communication completes before interpreter teardown
 # (ref mpi4jax/_src/__init__.py:13-17).

@@ -1,0 +1,8 @@
+"""Experimental namespace (ref parity: mpi4jax/experimental/).
+
+The reference's only populated experimental module is ``notoken`` — the full
+primitive set re-implemented on JAX ordered effects so no user-visible
+tokens are needed (ref mpi4jax/experimental/notoken/__init__.py:2-13).
+"""
+
+from . import notoken  # noqa: F401

@@ -1,0 +1,109 @@
+"""Tokenless API: every op returns only its data.
+
+Ref parity: ``mpi4jax.experimental.notoken`` re-implements all 12 ops on
+JAX's *ordered effects* so XLA threads an implicit token and users never
+touch one (ref experimental/notoken/collective_ops/*.py; SURVEY.md §2.3).
+
+In this framework the tokenless style is the *primary* design: the SPMD
+model compiles ONE program for all ranks, so cross-rank schedule divergence
+(the deadlock class tokens exist to prevent, ref docs/sharp-bits.rst) is
+impossible by construction, and XLA's own data/collective ordering provides
+the per-rank execution order.  These wrappers are therefore thin: call the
+main op with ``token=None`` and drop the returned token.
+
+The reverse delegation also holds: with ``MPI4JAX_TPU_PREFER_NOTOKEN=1``
+the token API skips threading ``optimization_barrier`` chains (ref
+``MPI4JAX_PREFER_NOTOKEN``, _src/utils.py:175-177).
+
+Signatures match the reference's notoken variants: data in, data out —
+``send`` and ``barrier`` return ``None`` (ref notoken/collective_ops/
+send.py:211-212 and barrier.py:146-147 return no value).
+"""
+
+from typing import Optional
+
+from .. import ops as _ops
+from ..ops import SUM, OpLike, Status
+from ..parallel.comm import Comm
+
+
+def allreduce(x, op: OpLike = SUM, *, comm: Optional[Comm] = None):
+    res, _ = _ops.allreduce(x, op, comm=comm)
+    return res
+
+
+def allgather(x, *, comm: Optional[Comm] = None):
+    res, _ = _ops.allgather(x, comm=comm)
+    return res
+
+
+def alltoall(x, *, comm: Optional[Comm] = None):
+    res, _ = _ops.alltoall(x, comm=comm)
+    return res
+
+
+def barrier(*, comm: Optional[Comm] = None) -> None:
+    """Synchronize all ranks.
+
+    The barrier's token is deposited in the region context
+    (``RegionContext.pending_sync``): the next op — or the region's outputs —
+    consumes it, so the synchronizing collective survives DCE and subsequent
+    work is ordered after it (the ordered-effects analog; ref
+    notoken/collective_ops/barrier.py:146-147 declares {ordered_effect})."""
+    from ..parallel.region import current_context, in_parallel_region, resolve_comm
+
+    tok = _ops.barrier(comm=comm)
+    if not in_parallel_region(resolve_comm(comm)):
+        return  # eager: the one-op program already executed
+    ctx = current_context()
+    if ctx.pending_sync is not None:
+        # merge consecutive barriers
+        from ..ops.token import Token, consume
+
+        tok = Token(consume(ctx.pending_sync, tok.value))
+    ctx.pending_sync = tok
+
+
+def bcast(x, root: int, *, comm: Optional[Comm] = None):
+    res, _ = _ops.bcast(x, root, comm=comm)
+    return res
+
+
+def gather(x, root: int, *, comm: Optional[Comm] = None):
+    res, _ = _ops.gather(x, root, comm=comm)
+    return res
+
+
+def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
+         status: Optional[Status] = None):
+    res, _ = _ops.recv(x, source, tag, comm=comm, status=status)
+    return res
+
+
+def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None):
+    res, _ = _ops.reduce(x, op, root, comm=comm)
+    return res
+
+
+def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None):
+    res, _ = _ops.scan(x, op, comm=comm)
+    return res
+
+
+def scatter(x, root: int, *, comm: Optional[Comm] = None):
+    res, _ = _ops.scatter(x, root, comm=comm)
+    return res
+
+
+def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None) -> None:
+    _ops.send(x, dest, tag, comm=comm)
+
+
+def sendrecv(sendbuf, recvbuf, source=None, dest=None, *, sendtag: int = 0,
+             recvtag: int = 0, comm: Optional[Comm] = None,
+             status: Optional[Status] = None):
+    res, _ = _ops.sendrecv(
+        sendbuf, recvbuf, source, dest, sendtag=sendtag, recvtag=recvtag,
+        comm=comm, status=status,
+    )
+    return res

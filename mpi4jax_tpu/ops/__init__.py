@@ -18,6 +18,7 @@ from ._base import (  # noqa: F401
     PROD,
     SUM,
     Op,
+    OpLike,
     varying,
 )
 from .allgather import allgather  # noqa: F401

@@ -209,6 +209,18 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
     for a in arrays:
         check_dtype(a, opname)
     if in_parallel_region(comm):
+        # a pending tokenless barrier (see RegionContext.pending_sync) is
+        # folded into this op's token so the op is ordered after it
+        ctx = _region_stack[-1] if _region_stack else None
+        if ctx is not None and ctx.pending_sync is not None:
+            sync = ctx.pending_sync
+            ctx.pending_sync = None
+            from .token import Token, tie
+
+            token = sync if token is None else Token(tie(sync, token.value))
+            # tie the op inputs directly too: consume() may be disabled by
+            # MPI4JAX_TPU_PREFER_NOTOKEN, but barrier ordering must hold
+            arrays = tuple(tie(sync, a) for a in arrays)
         # promote replicated trace-constants to rank-varying once, centrally,
         # so every op accepts them (collectives are variant->invariant typed)
         arrays = tuple(as_varying(a, comm.axes) for a in arrays)

@@ -28,7 +28,11 @@ def barrier(*, comm: Optional[Comm] = None, token: Optional[Token] = None):
             z = consume(token, z)
         log_op("MPI_Barrier", comm.Get_rank())
         s = lax.psum(as_varying(z, comm.axes), comm.axes)
-        return (produce(token, s),)
+        # the output token IS the collective result: consuming the token
+        # orders work after the barrier, and the AllReduce can never be
+        # dead-code-eliminated away from a consumed token (even under
+        # MPI4JAX_TPU_PREFER_NOTOKEN, where produce() stops chaining)
+        return (Token(s),)
 
     out = dispatch("barrier", comm, body, (), token)
     return out[0]

@@ -56,9 +56,13 @@ def _barrier_pair(a, b):
 def consume(token: Optional[Token], *arrays):
     """Make ``arrays`` depend on ``token`` (op inputs wait for the token).
 
-    Returns the (possibly rewrapped) arrays.  ``None`` token is a no-op.
+    Returns the (possibly rewrapped) arrays.  ``None`` token is a no-op, and
+    with ``MPI4JAX_TPU_PREFER_NOTOKEN=1`` the token API stops threading
+    ``optimization_barrier`` chains entirely — the delegation the reference
+    implements by re-binding through the notoken primitives
+    (ref _src/collective_ops/allreduce.py:66-69, _src/utils.py:175-177).
     """
-    if token is None:
+    if token is None or _prefer_notoken():
         return arrays if len(arrays) != 1 else arrays[0]
     tied = []
     tval = token.value
@@ -71,7 +75,23 @@ def consume(token: Optional[Token], *arrays):
 def produce(token: Optional[Token], *arrays) -> Token:
     """Produce the op's output token: depends on every output array, so the
     next token-consuming op is scheduled after this op completes."""
+    if _prefer_notoken():
+        return token if token is not None else Token(jnp.zeros((), jnp.uint32))
     tval = token.value if token is not None else jnp.zeros((), jnp.uint32)
     for x in arrays:
         _, tval = _barrier_pair(x, tval)
     return Token(tval)
+
+
+def _prefer_notoken() -> bool:
+    from ..utils.config import prefer_notoken
+
+    return prefer_notoken()
+
+
+def tie(token: Token, x):
+    """Unconditionally make ``x`` depend on ``token`` — unlike ``consume``,
+    never skipped by MPI4JAX_TPU_PREFER_NOTOKEN.  Used for synchronization
+    that must survive DCE (RegionContext.pending_sync)."""
+    x, _ = _barrier_pair(x, token.value)
+    return x

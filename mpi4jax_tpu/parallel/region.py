@@ -40,6 +40,12 @@ class RegionContext:
         self.comm = comm
         # (comm_uid, tag) -> deque of pending _PendingSend (see ops/send.py)
         self.send_queues: Dict[Tuple[int, int], deque] = {}
+        # implicit ordering handle for the tokenless API (the ordered-effects
+        # analog, ref notoken abstract evals declare {ordered_effect}): a
+        # tokenless barrier deposits its token here; the next op (or the
+        # region's outputs) consumes it, so the synchronizing collective is
+        # never dead-code-eliminated and subsequent ops are ordered after it.
+        self.pending_sync = None
 
     def queue(self, comm_uid: int, tag: int) -> deque:
         return self.send_queues.setdefault((comm_uid, tag), deque())
@@ -177,6 +183,14 @@ def spmd(
                         for i, v in zip(statics, static_vals):
                             full.insert(i, v)
                         out = f(*full, **kw)
+                        if ctx.pending_sync is not None:
+                            # a trailing tokenless barrier: tie it into the
+                            # region outputs so it is not dead-code-eliminated
+                            from ..ops.token import tie
+
+                            sync = ctx.pending_sync
+                            ctx.pending_sync = None
+                            out = jax.tree.map(lambda v: tie(sync, v), out)
                         if squeeze_out:
                             out = jax.tree.map(lambda v: v[None], out)
                         ctx.check_drained()

@@ -23,6 +23,7 @@ from .debug import (  # noqa: F401
 )
 from .dtypes import SUPPORTED_DTYPES, check_dtype  # noqa: F401
 from .flush import flush  # noqa: F401
+from .jax_compat import check_jax_version  # noqa: F401
 from .validation import enforce_types  # noqa: F401
 
 

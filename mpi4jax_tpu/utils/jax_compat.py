@@ -1,0 +1,70 @@
+"""JAX version advisory.
+
+Analog of ref mpi4jax/_src/jax_compat.py:11-47: the reference pins a
+latest-validated JAX version (shipped as ``_latest_jax_version.txt``) and
+warns when the installed JAX is newer (its custom-call lowerings reach into
+JAX internals that move between releases).  This framework touches far fewer
+internals (public ``jax.lax`` collectives + ``shard_map``), so the advisory
+is informational: warn above the validated ceiling, error below the hard
+floor (``shard_map``/VMA typing requirements).
+
+``MPI4JAX_TPU_NO_WARN_JAX_VERSION=1`` silences the warning
+(ref jax_compat.py:35-36 ``MPI4JAX_NO_WARN_JAX_VERSION``).
+
+The rest of the reference module — ``custom_call`` shims, ``ShapedArray``
+import paths, effect allow-list registration (ref jax_compat.py:51-120) —
+has no analog here: there are no custom calls and no manually-registered
+effects.
+"""
+
+import warnings
+
+from .config import parse_env_bool
+
+# oldest JAX with the shard_map/VMA semantics the ops rely on
+MIN_JAX_VERSION = "0.6.0"
+# newest JAX this package was validated against
+LATEST_JAX_VERSION = "0.9.0"
+
+
+def versiontuple(v: str):
+    """'0.9.0' -> (0, 9, 0); tolerates dev/rc suffixes
+    (ref jax_compat.py:11-21)."""
+    parts = []
+    for p in v.split("."):
+        digits = ""
+        for ch in p:
+            if ch.isdigit():
+                digits += ch
+            else:
+                break
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts[:3])
+
+
+def check_jax_version(jax_version: str = None) -> None:
+    """Warn/raise on unvalidated JAX versions (ref jax_compat.py:24-47)."""
+    if jax_version is None:
+        import jax
+
+        jax_version = jax.__version__
+
+    if versiontuple(jax_version) < versiontuple(MIN_JAX_VERSION):
+        raise RuntimeError(
+            f"mpi4jax_tpu requires jax>={MIN_JAX_VERSION} (found "
+            f"{jax_version}): the collective ops rely on jax.shard_map and "
+            "collective (VMA) typing introduced there."
+        )
+
+    if versiontuple(jax_version) > versiontuple(LATEST_JAX_VERSION):
+        if parse_env_bool("MPI4JAX_TPU_NO_WARN_JAX_VERSION", False):
+            return
+        warnings.warn(
+            f"The latest supported JAX version with this release of "
+            f"mpi4jax_tpu is {LATEST_JAX_VERSION} (found {jax_version}). "
+            "If you encounter problems, consider pinning "
+            f"jax=={LATEST_JAX_VERSION}. Set "
+            "MPI4JAX_TPU_NO_WARN_JAX_VERSION=1 to silence this warning.",
+            UserWarning,
+            stacklevel=3,
+        )

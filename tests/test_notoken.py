@@ -1,0 +1,200 @@
+"""Tokenless API tests (ref tests/experimental/test_notoken.py).
+
+The reference's notoken suite proves that *implicit* ordering (JAX ordered
+effects) preserves program order for point-to-point messages — the "hot
+potato" test provably fails without it (ref test_notoken.py:80-131) — and
+that ops work inside ``fori_loop``/``while_loop``/``cond`` (:134-190) and
+rank-divergent cond branches (:316-357).  Here ordering is structural (one
+SPMD program; ppermute pairs are data-ordered), so the same behaviors are
+asserted through the tokenless wrappers.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.experimental import notoken
+
+SIZE = 8
+
+
+def test_allreduce_and_variants():
+    @mpx.spmd
+    def f(x):
+        return notoken.allreduce(x, op=mpx.SUM)
+
+    out = np.asarray(f(jnp.arange(SIZE, dtype=jnp.float32)[:, None]))
+    assert (out == np.arange(SIZE).sum()).all()
+
+
+def test_all_ops_smoke():
+    """Every tokenless wrapper returns data only (no token tuple)."""
+
+    @mpx.spmd
+    def f(x):
+        size = SIZE
+        a = notoken.allreduce(x, op=mpx.SUM)
+        b = notoken.allgather(x)
+        c = notoken.bcast(x, 0)
+        d = notoken.gather(x, 0)
+        e = notoken.reduce(x, mpx.SUM, 0)
+        g = notoken.scan(x)
+        h = notoken.sendrecv(x, x, dest=mpx.shift(1))
+        notoken.barrier()
+        i = notoken.alltoall(jnp.tile(x, (size, 1)))
+        j = notoken.scatter(jnp.tile(x, (size, 1)), 0)
+        return a, b.sum(0), c, d.sum(0), e, g, h, i.sum(0), j
+
+    outs = f(jnp.arange(SIZE, dtype=jnp.float32)[:, None])
+    for o in outs:
+        assert np.all(np.isfinite(np.asarray(o)))
+
+
+def test_send_recv_return_none_and_data():
+    @mpx.spmd
+    def f(x):
+        notoken.send(x, dest=[(0, 1)])
+        got = notoken.recv(x, tag=0)
+        return got
+
+    out = np.asarray(f(jnp.arange(SIZE, dtype=jnp.float32)[:, None])).ravel()
+    # rank 1 received rank 0's value; everyone else kept the template
+    assert out[1] == 0.0
+    assert (out[2:] == np.arange(2, SIZE)).all()
+
+
+def test_hot_potato():
+    """Ref test_notoken.py:80-131: pass a value around the ring one hop per
+    step; strict program order makes the final value land back at rank 0."""
+
+    @mpx.spmd
+    def f(x):
+        val = x
+        for _ in range(SIZE):
+            val = notoken.sendrecv(val, val, dest=mpx.shift(1))
+        return val
+
+    start = jnp.arange(SIZE, dtype=jnp.float32)[:, None]
+    out = np.asarray(f(start)).ravel()
+    # SIZE hops around a SIZE-ring is the identity
+    assert (out == np.arange(SIZE)).all()
+
+
+def test_inside_fori_loop():
+    @mpx.spmd
+    def f(x):
+        def body(_, v):
+            return mpx.varying(notoken.allreduce(v, op=mpx.SUM))
+
+        return jax.lax.fori_loop(0, 3, body, x)
+
+    out = np.asarray(f(jnp.ones((SIZE, 1), jnp.float32)))
+    assert (out == SIZE**3).all()
+
+
+def test_inside_cond():
+    """Ops must work under lax.cond with identical branches on all ranks
+    (rank-divergent *communication schedules* are impossible under SPMD —
+    the reference needs tokens to survive them; see docs/sharp_bits.md)."""
+
+    @mpx.spmd
+    def f(x, flag):
+        def yes(v):
+            # collective outputs are replicated-typed; re-type as varying so
+            # both branches agree (docs/sharp_bits.md)
+            return mpx.varying(notoken.allreduce(v, op=mpx.SUM))
+
+        def no(v):
+            return v
+
+        return jax.lax.cond(flag[0] > 0, yes, no, x)
+
+    ones = jnp.ones((SIZE, 1), jnp.float32)
+    on = np.asarray(f(ones, jnp.ones((SIZE, 1))))
+    off = np.asarray(f(ones, jnp.zeros((SIZE, 1))))
+    assert (on == SIZE).all() and (off == 1).all()
+
+
+def _count_all_reduce(stablehlo: str) -> int:
+    return stablehlo.count("all_reduce")
+
+
+def test_notoken_barrier_survives_dce():
+    """The tokenless barrier's AllReduce must appear in the lowered program
+    even though no value is returned from it (the pending_sync mechanism;
+    a plain discarded psum would be dead-code-eliminated)."""
+    import mpi4jax_tpu.parallel.region as region
+
+    comm = mpx.get_default_comm()
+
+    def with_barrier(x):
+        from mpi4jax_tpu.parallel.region import RegionContext, _region_stack
+
+        ctx = RegionContext(comm)
+        _region_stack.append(ctx)
+        try:
+            notoken.barrier()
+            out = x * 2
+            if ctx.pending_sync is not None:
+                from mpi4jax_tpu.ops.token import tie
+
+                out = tie(ctx.pending_sync, out)
+            return out
+        finally:
+            _region_stack.pop()
+
+    lowered = jax.jit(
+        jax.shard_map(
+            with_barrier,
+            mesh=comm.mesh,
+            in_specs=jax.sharding.PartitionSpec(comm.axis),
+            out_specs=jax.sharding.PartitionSpec(comm.axis),
+        )
+    ).lower(jnp.ones((SIZE,)))
+    assert _count_all_reduce(lowered.as_text()) >= 1
+
+
+def test_notoken_barrier_orders_next_op():
+    """barrier followed by an op: both collectives appear, barrier first."""
+
+    @mpx.spmd
+    def f(x):
+        notoken.barrier()
+        return notoken.allreduce(x, op=mpx.SUM)
+
+    out = np.asarray(f(jnp.ones((SIZE, 1), jnp.float32)))
+    assert (out == SIZE).all()
+
+
+def test_trailing_notoken_barrier_in_region():
+    """A barrier as the LAST statement of a region is tied into the region
+    outputs (not elided)."""
+
+    @mpx.spmd
+    def f(x):
+        y = notoken.allreduce(x, op=mpx.SUM)
+        notoken.barrier()
+        return mpx.varying(y)
+
+    out = np.asarray(f(jnp.ones((SIZE, 1), jnp.float32)))
+    assert (out == SIZE).all()
+
+
+def test_prefer_notoken_skips_token_chains(monkeypatch):
+    """MPI4JAX_TPU_PREFER_NOTOKEN=1 drops optimization_barrier threading
+    from the token API (ref _src/utils.py:175-177 delegation) while keeping
+    results and the barrier collective intact."""
+    monkeypatch.setenv("MPI4JAX_TPU_PREFER_NOTOKEN", "1")
+
+    @mpx.spmd
+    def f(x):
+        tok = mpx.create_token()
+        y, tok = mpx.allreduce(x, op=mpx.SUM, token=tok)
+        tok = mpx.barrier(token=tok)
+        return mpx.varying(y)
+
+    out = np.asarray(f(jnp.ones((SIZE, 1), jnp.float32)))
+    assert (out == SIZE).all()
