@@ -35,13 +35,18 @@ import mpi4jax_tpu as mpx  # noqa: E402
 
 def _time_program(fn, args, trials=3):
     """Best-of-N wall time of ``fn(*args)`` with host-fetch sync."""
-    out = fn(*args)  # compile
-    np.asarray(jax.tree.leaves(out)[0].ravel()[0])  # sync, single element
+    def sync(out):
+        # single-element fetch with no reshape: plain indexing slices one
+        # element off the leading shard (ravel() would dispatch a full
+        # device reshape of the global array inside the timed window)
+        leaf = jax.tree.leaves(out)[0]
+        np.asarray(leaf[(0,) * leaf.ndim])
+
+    sync(fn(*args))  # compile + drain queue
     best = float("inf")
     for _ in range(trials):
         t0 = time.perf_counter()
-        out = fn(*args)
-        np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+        sync(fn(*args))
         best = min(best, time.perf_counter() - t0)
     return best
 

@@ -1,0 +1,171 @@
+"""Long-context attention on the communication primitives.
+
+The reference contains no sequence parallelism (SURVEY.md §5) — but its
+primitive set is exactly what the standard long-context schemes are built
+from.  This module implements both standard schemes TPU-natively on
+mpi4jax_tpu's primitives, as executable documentation that the primitives
+compose into sequence/context parallelism:
+
+- **ring attention** (blockwise attention over a `sendrecv` ring;
+  Liu et al. 2023): each rank holds a sequence shard of K/V and rotates it
+  around the ring with ``shift(1)`` — one CollectivePermute per step over
+  ICI — accumulating attention with a streaming (flash-style) softmax.
+  Memory per chip stays O(T/n), enabling sequences n× longer than one chip
+  could hold; compute overlaps the permutes (XLA pipelines the unrolled
+  steps).
+- **Ulysses-style attention** (`alltoall` head exchange; Jacobs et al.
+  2023): two all-to-alls re-shard from sequence-parallel to head-parallel
+  and back, with full-sequence local attention in between.
+
+Both are exact (not approximations) and match single-device attention to
+f32 precision — see tests/test_long_context.py.
+"""
+
+import math
+import os
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+from mpi4jax_tpu.experimental import notoken  # noqa: E402
+
+
+def reference_attention(q, k, v, *, causal=False):
+    """Plain full attention (B, T, H, D) — the single-device ground truth."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q, k, v, *, comm=None, causal=False):
+    """Exact blockwise attention over a K/V ring.
+
+    ``q``/``k``/``v``: rank-local sequence shards ``(B, T_local, H, D)``;
+    the global sequence is the rank-order concatenation.  Returns the local
+    shard of the attention output.  Call inside a parallel region.
+    """
+    comm = comm if comm is not None else mpx.get_default_comm()
+    size = comm.Get_size()
+    rank = comm.Get_rank()
+    b, t_loc, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    # streaming-softmax accumulators (flash-attention style)
+    m = jnp.full((b, h, t_loc), -jnp.inf, q.dtype)
+    l = jnp.zeros((b, h, t_loc), q.dtype)
+    acc = jnp.zeros_like(q)
+    # promote fresh (replicated-typed) constants so they can join the
+    # varying carry (docs/sharp_bits.md)
+    m, l, acc = mpx.varying((m, l, acc))
+
+    q_idx = rank * t_loc + jnp.arange(t_loc)  # global query positions
+
+    k_blk, v_blk = k, v
+    # static unroll: `size` steps, each one CollectivePermute + one block of
+    # MXU work — XLA pipelines compute with the permutes
+    for step in range(size):
+        # k_blk currently holds the shard originally owned by rank - step
+        src = (rank - step) % size
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        if causal:
+            k_idx = src * t_loc + jnp.arange(t_loc)
+            mask = q_idx[:, None] >= k_idx[None, :]  # (t_loc, t_loc)
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> nan
+        safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+        corr = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - safe_m))
+        p = jnp.exp(s - safe_m[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        l = l * corr + p.sum(axis=-1)
+        corr_t = jnp.moveaxis(corr, 1, 2)[..., None]  # (B, T_l, H, 1)
+        acc = acc * corr_t + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+        m = m_new
+
+        if step + 1 < size:
+            # rotate K/V one hop around the ring (tokenless: the data
+            # dependency on k_blk/v_blk already orders the permute)
+            k_blk = notoken.sendrecv(k_blk, k_blk, dest=mpx.shift(1), comm=comm)
+            v_blk = notoken.sendrecv(v_blk, v_blk, dest=mpx.shift(1), comm=comm)
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return acc / jnp.moveaxis(l_safe, 1, 2)[..., None]
+
+
+def ulysses_attention(q, k, v, *, comm=None, causal=False):
+    """Exact attention via all-to-all head exchange (Ulysses).
+
+    Input shards ``(B, T_local, H, D)`` with ``H % size == 0``: re-shard to
+    ``(B, T_global, H/size, D)`` with one ``alltoall``, run full-sequence
+    local attention on the head group, and re-shard back.
+    """
+    comm = comm if comm is not None else mpx.get_default_comm()
+    size = comm.Get_size()
+    b, t_loc, h, d = q.shape
+    if h % size != 0:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by ranks ({size})")
+    h_loc = h // size
+
+    def seq_to_heads(x):
+        # (B, T_l, H, D) -> alltoall rows = head groups -> (B, T_g, H/size, D)
+        x = x.reshape(b, t_loc, size, h_loc, d).transpose(2, 0, 1, 3, 4)
+        x = notoken.alltoall(x, comm=comm)  # row i: rank i's T_l for my heads
+        return x.transpose(1, 0, 2, 3, 4).reshape(b, size * t_loc, h_loc, d)
+
+    def heads_to_seq(x):
+        # (B, T_g, H/size, D) -> (B, T_l, H, D)
+        x = x.reshape(b, size, t_loc, h_loc, d).transpose(1, 0, 2, 3, 4)
+        x = notoken.alltoall(x, comm=comm)
+        return x.transpose(1, 2, 0, 3, 4).reshape(b, t_loc, h, d)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = reference_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _demo_data(key, size, b, t_loc, h, d):
+    ks = jax.random.split(key, 3)
+    shape = (size, b, t_loc, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def main():
+    devices = jax.devices()
+    n = len(devices)
+    mesh = mpx.make_world_mesh(devices=devices)
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    b, t_loc, h, d = 2, 128, max(8, n), 64
+    q, k, v = _demo_data(jax.random.PRNGKey(0), n, b, t_loc, h, d)
+
+    @mpx.spmd(comm=comm)
+    def ring(q, k, v):
+        return ring_attention(q, k, v, comm=comm, causal=True)
+
+    out = ring(q, k, v)
+    print(f"ring attention over {n} devices: global T = {n * t_loc}, "
+          f"local out {out.shape[1:]} ok")
+
+    @mpx.spmd(comm=comm)
+    def uly(q, k, v):
+        return ulysses_attention(q, k, v, comm=comm, causal=True)
+
+    out = uly(q, k, v)
+    print(f"ulysses attention over {n} devices: ok {out.shape[1:]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,18 +50,13 @@ def barrier(*, comm: Optional[Comm] = None) -> None:
     consumes it, so the synchronizing collective survives DCE and subsequent
     work is ordered after it (the ordered-effects analog; ref
     notoken/collective_ops/barrier.py:146-147 declares {ordered_effect})."""
-    from ..parallel.region import current_context, in_parallel_region, resolve_comm
+    from ..ops.token import deposit_sync
+    from ..parallel.region import in_parallel_region, resolve_comm
 
     tok = _ops.barrier(comm=comm)
     if not in_parallel_region(resolve_comm(comm)):
         return  # eager: the one-op program already executed
-    ctx = current_context()
-    if ctx.pending_sync is not None:
-        # merge consecutive barriers
-        from ..ops.token import Token, consume
-
-        tok = Token(consume(ctx.pending_sync, tok.value))
-    ctx.pending_sync = tok
+    deposit_sync(tok)
 
 
 def bcast(x, root: int, *, comm: Optional[Comm] = None):

@@ -28,11 +28,19 @@ def barrier(*, comm: Optional[Comm] = None, token: Optional[Token] = None):
             z = consume(token, z)
         log_op("MPI_Barrier", comm.Get_rank())
         s = lax.psum(as_varying(z, comm.axes), comm.axes)
-        # the output token IS the collective result: consuming the token
-        # orders work after the barrier, and the AllReduce can never be
-        # dead-code-eliminated away from a consumed token (even under
-        # MPI4JAX_TPU_PREFER_NOTOKEN, where produce() stops chaining)
+        # the output token IS the collective result, so consuming the token
+        # both orders work after the barrier and keeps the AllReduce alive
         return (Token(s),)
 
     out = dispatch("barrier", comm, body, (), token)
-    return out[0]
+    tok = out[0]
+    from ..parallel.region import in_parallel_region, resolve_comm
+    from .token import deposit_sync
+
+    if in_parallel_region(resolve_comm(comm)):
+        # MPI_Barrier always executes, even if the caller drops the returned
+        # token (and consume() may be disabled by PREFER_NOTOKEN): anchor the
+        # collective through the implicit-sync mechanism.  A consumed token
+        # just adds a second, harmless data dependency.
+        deposit_sync(tok)
+    return tok

@@ -95,3 +95,24 @@ def tie(token: Token, x):
     that must survive DCE (RegionContext.pending_sync)."""
     x, _ = _barrier_pair(x, token.value)
     return x
+
+
+def deposit_sync(token: Token) -> None:
+    """Record ``token`` as implicit pending synchronization.
+
+    Inside an spmd-managed region, the token lands in
+    ``RegionContext.pending_sync`` where the next op (or the region outputs)
+    ties it in.  Inside a *user's own* ``shard_map`` (the global fallback
+    context) there is no drain point and a stored tracer would leak across
+    traces — instead the token is anchored with an effectful no-op host
+    callback, which DCE cannot remove."""
+    from ..parallel.region import _region_stack
+
+    if _region_stack:
+        ctx = _region_stack[-1]
+        if ctx.pending_sync is not None:
+            # merge consecutive deposits
+            token = Token(tie(ctx.pending_sync, token.value))
+        ctx.pending_sync = token
+    else:
+        jax.debug.callback(lambda _: None, token.value)

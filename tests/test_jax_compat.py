@@ -26,11 +26,13 @@ def test_versiontuple(raw, expected):
     assert versiontuple(raw) == expected
 
 
-def test_current_jax_passes_silently():
+def test_in_range_version_passes_silently():
+    # explicit in-range version: keeps CI green when a newer jax ships
+    # (the advisory for the *installed* jax is informational, not an error)
     with warnings.catch_warnings():
         warnings.simplefilter("error")
-        # installed JAX is within [MIN, LATEST]; no warning expected
-        check_jax_version()
+        check_jax_version(LATEST_JAX_VERSION)
+        check_jax_version(MIN_JAX_VERSION)
 
 
 def test_newer_jax_warns():

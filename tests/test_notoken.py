@@ -183,6 +183,76 @@ def test_trailing_notoken_barrier_in_region():
     assert (out == SIZE).all()
 
 
+def test_token_barrier_survives_prefer_notoken(monkeypatch):
+    """With PREFER_NOTOKEN=1, consume() is a no-op, so the token-API barrier
+    must anchor itself through the pending-sync mechanism: two all_reduce
+    ops must appear in the lowering (one allreduce + one barrier)."""
+    comm = mpx.get_default_comm()
+
+    def prog(x):
+        tok = mpx.create_token()
+        y, tok = mpx.allreduce(x, op=mpx.SUM, token=tok)
+        mpx.barrier(token=tok)
+        return mpx.varying(y)
+
+    def lower_count():
+        lowered = jax.jit(
+            jax.shard_map(
+                lambda x: _in_region(comm, prog, x),
+                mesh=comm.mesh,
+                in_specs=jax.sharding.PartitionSpec(comm.axis),
+                out_specs=jax.sharding.PartitionSpec(comm.axis),
+            )
+        ).lower(jnp.ones((SIZE,)))
+        return _count_all_reduce(lowered.as_text())
+
+    monkeypatch.setenv("MPI4JAX_TPU_PREFER_NOTOKEN", "0")
+    baseline = lower_count()
+    monkeypatch.setenv("MPI4JAX_TPU_PREFER_NOTOKEN", "1")
+    assert lower_count() == baseline == 2
+
+
+def _in_region(comm, fn, *args):
+    from mpi4jax_tpu.ops.token import tie
+    from mpi4jax_tpu.parallel.region import RegionContext, _region_stack
+
+    ctx = RegionContext(comm)
+    _region_stack.append(ctx)
+    try:
+        out = fn(*args)
+        if ctx.pending_sync is not None:
+            sync = ctx.pending_sync
+            ctx.pending_sync = None
+            out = jax.tree.map(lambda v: tie(sync, v), out)
+        return out
+    finally:
+        _region_stack.pop()
+
+
+def test_notoken_barrier_in_raw_shard_map_survives():
+    """notoken.barrier inside a user's own shard_map (no spmd wrapper) must
+    still execute (anchored via an effectful callback, not a leakable
+    pending tracer) and must not leak state into the global context."""
+    import mpi4jax_tpu.parallel.region as region
+
+    comm = mpx.get_default_comm()
+
+    def body(x):
+        notoken.barrier()
+        return notoken.allreduce(x, op=mpx.SUM)
+
+    lowered = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=jax.sharding.PartitionSpec(comm.axis),
+            out_specs=jax.sharding.PartitionSpec(comm.axis),
+        )
+    ).lower(jnp.ones((SIZE,)))
+    assert _count_all_reduce(lowered.as_text()) >= 2
+    assert region._global_ctx.pending_sync is None
+
+
 def test_prefer_notoken_skips_token_chains(monkeypatch):
     """MPI4JAX_TPU_PREFER_NOTOKEN=1 drops optimization_barrier threading
     from the token API (ref _src/utils.py:175-177 delegation) while keeping
