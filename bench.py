@@ -22,14 +22,14 @@ def main():
     sys.path.insert(
         0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples")
     )
-    from shallow_water import DAY_IN_SECONDS, Config, pick_process_grid, solve
+    from shallow_water import DAY_IN_SECONDS, Config, pick_process_grid, solve_fused
 
     devices = jax.devices()
     nproc_y, nproc_x = pick_process_grid(len(devices))
     cfg = Config(nproc_y=nproc_y, nproc_x=nproc_x, nx=3600, ny=1800)
     t1 = 0.1 * DAY_IN_SECONDS
 
-    _, wall, n_steps = solve(cfg, t1, devices=devices, collect=False)
+    wall, n_steps = solve_fused(cfg, t1, devices=devices)
 
     steps_per_sec_per_chip = n_steps / wall / len(devices)
     ref_gpu_wall = 6.28  # Tesla P100, 1 process (BASELINE.md)

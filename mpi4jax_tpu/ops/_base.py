@@ -34,7 +34,7 @@ from ..parallel.region import (
     in_parallel_region,
     resolve_comm,
 )
-from ..utils.debug import get_runtime_tracing, log_op, op_scope
+from ..utils.debug import get_logging, get_runtime_tracing, log_op, op_scope
 from ..utils.dtypes import check_dtype
 
 
@@ -193,7 +193,21 @@ def _run_body(opname: str, comm: Comm, body, arrays, token):
     return out
 
 
-def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
+# eager-mode compiled programs, keyed by
+# (opname, mesh, comm uid, op-specific statics, observability flags) — the
+# analog of jax caching `xla.apply_primitive` per primitive+params (ref
+# _src/utils.py:34-35).  jit itself handles shape/dtype/token-structure
+# retraces within one entry.  LRU-bounded: callers may produce unbounded
+# distinct keys (e.g. many routing patterns), and each entry pins a
+# compiled executable plus its mesh.
+from collections import OrderedDict
+
+_eager_cache: "OrderedDict" = OrderedDict()
+_EAGER_CACHE_MAX = 128
+
+
+def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
+             static_key: Optional[tuple] = None):
     """Run op ``body`` either inline (inside a parallel region) or eagerly.
 
     ``body(comm, arrays, token) -> (outputs..., token)`` operates on
@@ -246,6 +260,19 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
 
     axes_spec = P(comm.axes if len(comm.axes) > 1 else comm.axes[0])
 
+    # ``static_key`` lists every closure value of ``body`` that shapes the
+    # trace; ``None`` marks the call uncacheable (e.g. a Status out-param
+    # that must be filled at trace time)
+    cache_key = None
+    if static_key is not None:
+        cache_key = (opname, comm.mesh, comm.uid, static_key,
+                     get_runtime_tracing(), get_logging())
+        cached = _eager_cache.get(cache_key)
+        if cached is not None:
+            _eager_cache.move_to_end(cache_key)
+            results, tok_out = cached(tuple(arrays), token)
+            return (*results, tok_out)
+
     def wrapped(arrs, tok):
         ctx = RegionContext(comm)
         _region_stack.append(ctx)
@@ -267,11 +294,15 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token):
             tok_out = Token(lax.psum(as_varying(tok_out.value, comm.axes), comm.axes))
         return tuple(r[None] for r in results), tok_out
 
-    sm = jax.shard_map(
+    sm = jax.jit(jax.shard_map(
         wrapped,
         mesh=comm.mesh,
         in_specs=(tuple(axes_spec for _ in arrays), P()),
         out_specs=(axes_spec, P()),
-    )
-    results, tok_out = jax.jit(sm)(tuple(arrays), token)
+    ))
+    if cache_key is not None:
+        _eager_cache[cache_key] = sm
+        if len(_eager_cache) > _EAGER_CACHE_MAX:
+            _eager_cache.popitem(last=False)
+    results, tok_out = sm(tuple(arrays), token)
     return (*results, tok_out)

@@ -28,4 +28,4 @@ def allgather(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
         res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
         return res, produce(token, res)
 
-    return dispatch("allgather", comm, body, (x,), token)
+    return dispatch("allgather", comm, body, (x,), token, static_key=())

@@ -41,4 +41,7 @@ def allreduce(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
         res = apply_allreduce(xl, op, comm.axes)
         return res, produce(token, res)
 
-    return dispatch("allreduce", comm, body, (x,), token)
+    # custom callable ops are uncacheable: their captured state can change
+    # without changing identity (enum ops are pure values)
+    return dispatch("allreduce", comm, body, (x,), token,
+                    static_key=(op,) if isinstance(op, Op) else None)

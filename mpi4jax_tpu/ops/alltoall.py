@@ -38,4 +38,4 @@ def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
         res = lax.all_to_all(xl, comm.axis, split_axis=0, concat_axis=0)
         return res, produce(token, res)
 
-    return dispatch("alltoall", comm, body, (x,), token)
+    return dispatch("alltoall", comm, body, (x,), token, static_key=())

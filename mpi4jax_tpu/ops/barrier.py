@@ -32,7 +32,7 @@ def barrier(*, comm: Optional[Comm] = None, token: Optional[Token] = None):
         # both orders work after the barrier and keeps the AllReduce alive
         return (Token(s),)
 
-    out = dispatch("barrier", comm, body, (), token)
+    out = dispatch("barrier", comm, body, (), token, static_key=())
     tok = out[0]
     from ..parallel.region import in_parallel_region, resolve_comm
     from .token import deposit_sync

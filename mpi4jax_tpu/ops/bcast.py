@@ -48,4 +48,4 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
             res = lax.psum(masked, comm.axes)
         return res, produce(token, res)
 
-    return dispatch("bcast", comm, body, (x,), token)
+    return dispatch("bcast", comm, body, (x,), token, static_key=(root,))

@@ -44,4 +44,4 @@ def gather(x, root: int, *, comm: Optional[Comm] = None,
         res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
         return res, produce(token, res)
 
-    return dispatch("gather", comm, body, (x,), token)
+    return dispatch("gather", comm, body, (x,), token, static_key=(root,))

@@ -41,4 +41,5 @@ def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None,
         res = jnp.where(rank == root, reduced, xl)
         return res, produce(token, res)
 
-    return dispatch("reduce", comm, body, (x,), token)
+    return dispatch("reduce", comm, body, (x,), token,
+                    static_key=(op, root) if isinstance(op, Op) else None)

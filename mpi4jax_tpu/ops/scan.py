@@ -19,7 +19,7 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
-from ._base import SUM, OpLike, combine_fn, dispatch
+from ._base import SUM, Op, OpLike, combine_fn, dispatch
 from .token import Token, consume, produce
 
 
@@ -47,4 +47,5 @@ def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
             d *= 2
         return acc, produce(token, acc)
 
-    return dispatch("scan", comm, body, (x,), token)
+    return dispatch("scan", comm, body, (x,), token,
+                    static_key=(op,) if isinstance(op, Op) else None)

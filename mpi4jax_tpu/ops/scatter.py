@@ -47,4 +47,4 @@ def scatter(x, root: int, *, comm: Optional[Comm] = None,
         res = exchanged[root]
         return res, produce(token, res)
 
-    return dispatch("scatter", comm, body, (x,), token)
+    return dispatch("scatter", comm, body, (x,), token, static_key=(root,))

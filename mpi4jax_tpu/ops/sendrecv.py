@@ -112,4 +112,20 @@ def sendrecv(
         _fill_status(status, pairs, comm, xl.size, xl.dtype)
         return res, produce(token, res)
 
-    return dispatch("sendrecv", comm, body, (sendbuf, recvbuf), token)
+    # a Status out-param must be filled at trace time, so those calls are
+    # uncacheable.  The cache key uses the *normalized* routing pairs (not
+    # the spec object): callables/dicts with identical routing share an
+    # entry, and a callable whose captured state changed re-resolves to
+    # different pairs instead of stale-hitting.  Eager-only: inside a region
+    # the key is ignored, and comm size may not be known statically there.
+    static_key = None
+    if status is None:
+        from ..parallel.region import in_parallel_region, resolve_comm
+
+        c = resolve_comm(comm)
+        if c.mesh is not None and not in_parallel_region(c):
+            pairs = _resolve_pairs(source, dest, c.Get_size(), "sendrecv")
+            static_key = (pairs, sendtag, recvtag)
+    return dispatch(
+        "sendrecv", comm, body, (sendbuf, recvbuf), token, static_key=static_key
+    )

@@ -22,6 +22,7 @@ from shallow_water import (  # noqa: E402
     initial_state,
     reassemble,
     solve,
+    solve_fused,
 )
 
 
@@ -59,6 +60,17 @@ def test_shallow_water_gathered_solution_matches_stacked():
     # rank-ordering regression on the multi-axis comm)
     assert snaps[-1].shape == snaps[0].shape
     np.testing.assert_array_equal(snaps[-1], snaps[-2])
+
+
+def test_solve_fused_matches_host_loop_step_count():
+    # the fused (single-dispatch) benchmark path must run exactly the same
+    # number of model steps as the host-loop path
+    cfg = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
+    t1 = 23 * cfg.dt
+    _, _, n_host = solve(cfg, t1, num_multisteps=5, collect=False)
+    wall, n_fused = solve_fused(cfg, t1, num_multisteps=5)
+    assert n_fused == n_host
+    assert wall > 0
 
 
 def test_initial_state_decomposition_independent():
