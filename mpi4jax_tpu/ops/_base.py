@@ -265,8 +265,12 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     # that must be filled at trace time)
     cache_key = None
     if static_key is not None:
+        from ..utils.config import prefer_notoken
+
+        # every dynamically-read flag that shapes the trace must be in the
+        # key, or toggling it would silently keep serving the old program
         cache_key = (opname, comm.mesh, comm.uid, static_key,
-                     get_runtime_tracing(), get_logging())
+                     get_runtime_tracing(), get_logging(), prefer_notoken())
         cached = _eager_cache.get(cache_key)
         if cached is not None:
             _eager_cache.move_to_end(cache_key)

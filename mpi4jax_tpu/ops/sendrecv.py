@@ -101,10 +101,29 @@ def sendrecv(
             f"{sendbuf.dtype} vs {recvbuf.shape}/{recvbuf.dtype}"
         )
 
+    # Eager-path caching: resolve the routing spec to concrete pairs ONCE,
+    # up front, and close the body over the *resolved* pairs — the cached
+    # program can then never re-read a mutated spec object, even on a
+    # shape-triggered internal retrace.  The cache key uses the same pairs,
+    # so callables/dicts with identical routing share an entry.  A Status
+    # out-param must be filled at trace time, so those calls are
+    # uncacheable.  Inside a region, pairs resolve at trace time instead
+    # (comm size may only be known from the axis environment there).
+    static_key = None
+    resolved_pairs = None
+    if status is None:
+        from ..parallel.region import in_parallel_region, resolve_comm
+
+        c = resolve_comm(comm)
+        if c.mesh is not None and not in_parallel_region(c):
+            resolved_pairs = _resolve_pairs(source, dest, c.Get_size(), "sendrecv")
+            static_key = (resolved_pairs, sendtag, recvtag)
+
     def body(comm, arrays, token):
         xl, rbuf = arrays
-        size = comm.Get_size()
-        pairs = _resolve_pairs(source, dest, size, "sendrecv")
+        pairs = resolved_pairs
+        if pairs is None:
+            pairs = _resolve_pairs(source, dest, comm.Get_size(), "sendrecv")
         xl = consume(token, xl)
         log_op("MPI_Sendrecv", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)}")
@@ -112,20 +131,6 @@ def sendrecv(
         _fill_status(status, pairs, comm, xl.size, xl.dtype)
         return res, produce(token, res)
 
-    # a Status out-param must be filled at trace time, so those calls are
-    # uncacheable.  The cache key uses the *normalized* routing pairs (not
-    # the spec object): callables/dicts with identical routing share an
-    # entry, and a callable whose captured state changed re-resolves to
-    # different pairs instead of stale-hitting.  Eager-only: inside a region
-    # the key is ignored, and comm size may not be known statically there.
-    static_key = None
-    if status is None:
-        from ..parallel.region import in_parallel_region, resolve_comm
-
-        c = resolve_comm(comm)
-        if c.mesh is not None and not in_parallel_region(c):
-            pairs = _resolve_pairs(source, dest, c.Get_size(), "sendrecv")
-            static_key = (pairs, sendtag, recvtag)
     return dispatch(
         "sendrecv", comm, body, (sendbuf, recvbuf), token, static_key=static_key
     )

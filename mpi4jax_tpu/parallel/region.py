@@ -145,6 +145,20 @@ def spmd(
             }))
             for i in statics:
                 if not 0 <= i < len(args):
+                    # like jax.jit: a static argument supplied by keyword is
+                    # a dedicated error, not a confusing out-of-range one
+                    import inspect
+
+                    try:
+                        names = list(inspect.signature(f).parameters)
+                    except (TypeError, ValueError):
+                        names = []
+                    if 0 <= i < len(names) and names[i] in kwargs:
+                        raise TypeError(
+                            f"spmd static argument {names[i]!r} "
+                            f"(static_argnums position {i}) was passed as a "
+                            "keyword; pass it positionally"
+                        )
                     raise ValueError(
                         f"static_argnums entry {i} out of range for "
                         f"{len(args)} positional arguments"
@@ -158,7 +172,17 @@ def spmd(
                     f"jax.jit static_argnums); got {static_vals!r}"
                 ) from e
             dyn_args = tuple(a for i, a in enumerate(args) if i not in statics)
-            key = (c.mesh, c.uid, statics, static_vals)
+            # shard_map is positional-only: keyword arrays are appended as
+            # trailing positionals (sorted by name) and rebound in the body
+            kw_names = tuple(sorted(kwargs))
+            if kw_names and in_specs is not None:
+                raise TypeError(
+                    "spmd with custom in_specs takes positional arguments "
+                    f"only (got keyword argument(s) {kw_names}); in_specs "
+                    "entries cannot be matched to keywords"
+                )
+            n_dyn = len(dyn_args)
+            key = (c.mesh, c.uid, statics, static_vals, kw_names, n_dyn)
             sm = program_cache.get(key)
             if sm is None:
                 axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])
@@ -172,14 +196,16 @@ def spmd(
                 squeeze_in = in_specs is None
                 squeeze_out = out_specs is None
 
-                def body(*a, **kw):
+                def body(*a):
                     ctx = RegionContext(c)
                     _region_stack.append(ctx)
                     try:
                         if squeeze_in:
-                            a, kw = jax.tree.map(lambda v: v[0], (a, kw))
+                            a = jax.tree.map(lambda v: v[0], a)
+                        pos, kwvals = a[:n_dyn], a[n_dyn:]
+                        kw = dict(zip(kw_names, kwvals))
                         # re-interleave the closed-over static args
-                        full = list(a)
+                        full = list(pos)
                         for i, v in zip(statics, static_vals):
                             full.insert(i, v)
                         out = f(*full, **kw)
@@ -204,7 +230,7 @@ def spmd(
                 if jit:
                     sm = jax.jit(sm)
                 program_cache[key] = sm
-            return sm(*dyn_args, **kwargs)
+            return sm(*dyn_args, *(kwargs[k] for k in kw_names))
 
         return wrapped
 
