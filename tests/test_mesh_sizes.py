@@ -1,0 +1,118 @@
+"""Degenerate and small mesh sizes + vmap coverage beyond allreduce.
+
+The reference runs its whole suite in BOTH 1-process and N-process modes
+(ref docs/developers.rst:15-27): collectives on 1 process degenerate to
+self-communication and must still work.  The analog here is running the
+ops over 1-, 2-, and 8-device meshes of the same virtual CPU pool.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as mpx
+
+
+def _comm(n):
+    mesh = mpx.make_world_mesh(devices=jax.devices()[:n])
+    return mpx.Comm(mesh.axis_names[0], mesh=mesh)
+
+
+@pytest.mark.parametrize("n", [1, 2, 8])
+def test_collectives_all_sizes(n):
+    comm = _comm(n)
+    x = jnp.arange(float(n))[:, None] + 1.0
+
+    @mpx.spmd(comm=comm)
+    def f(x):
+        a, tok = mpx.allreduce(x, op=mpx.SUM, comm=comm)
+        b, tok = mpx.allgather(x, comm=comm, token=tok)
+        c, tok = mpx.bcast(x, 0, comm=comm, token=tok)
+        d, tok = mpx.scan(x, mpx.SUM, comm=comm, token=tok)
+        e, tok = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=comm, token=tok)
+        mpx.barrier(comm=comm, token=tok)
+        return a, b.sum(0), c, d, e
+
+    a, b, c, d, e = (np.asarray(v).ravel() for v in f(x))
+    total = np.arange(1.0, n + 1).sum()
+    assert (a == total).all()
+    assert (b == total).all()
+    assert (c == 1.0).all()                       # root 0's value everywhere
+    np.testing.assert_allclose(d, np.cumsum(np.arange(1.0, n + 1)))
+    np.testing.assert_allclose(e, np.roll(np.arange(1.0, n + 1), 1))
+
+
+@pytest.mark.parametrize("n", [1, 2])
+def test_ring_self_communication(n):
+    """shift(1) on a size-n ring: on 1 device the permute is a self-send
+    (the reference's 1-process self-communication mode)."""
+    comm = _comm(n)
+
+    @mpx.spmd(comm=comm)
+    def f(x):
+        r, _ = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=comm)
+        return r
+
+    x = jnp.arange(float(n))[:, None]
+    out = np.asarray(f(x)).ravel()
+    np.testing.assert_array_equal(out, np.roll(np.arange(float(n)), 1))
+
+
+def test_complex_and_bool_collectives():
+    """Dtype parity with the reference's MPI_TYPE_MAP (ref
+    _src/utils.py:100-115): complex and bool ride the collectives."""
+    comm = _comm(8)
+
+    @mpx.spmd(comm=comm)
+    def f(z, m):
+        zs, tok = mpx.allreduce(z, op=mpx.SUM, comm=comm)
+        ms, _ = mpx.allreduce(m, op=mpx.LOR, comm=comm, token=tok)
+        return zs, ms
+
+    z = (jnp.arange(8.0) + 1j * jnp.arange(8.0))[:, None].astype(jnp.complex64)
+    m = (jnp.arange(8) == 3)[:, None]
+    zs, ms = f(z, m)
+    assert np.asarray(zs).ravel()[0] == 28 + 28j
+    assert np.asarray(ms).all()
+
+
+def test_vmap_over_sendrecv():
+    comm = _comm(8)
+
+    @mpx.spmd(comm=comm)
+    def f(x):
+        # batched halo rotation: vmap over the leading batch dim of the
+        # rank-local array
+        def one(v):
+            r, _ = mpx.sendrecv(v, v, dest=mpx.shift(1), comm=comm)
+            return r
+
+        return jax.vmap(one)(x)
+
+    x = jnp.arange(8.0 * 3).reshape(8, 3, 1)  # (ranks, batch, 1)
+    out = np.asarray(f(x))
+    expected = np.roll(np.asarray(x), 1, axis=0)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_vmap_over_gather_and_bcast():
+    comm = _comm(8)
+
+    @mpx.spmd(comm=comm)
+    def f(x):
+        def one(v):
+            g, tok = mpx.gather(v, 0, comm=comm)
+            b, _ = mpx.bcast(v, 3, comm=comm, token=tok)
+            return g.sum(0), b
+
+        return jax.vmap(one)(x)
+
+    x = jnp.arange(8.0 * 2).reshape(8, 2, 1)
+    s, b = f(x)
+    xs = np.asarray(x)
+    np.testing.assert_array_equal(np.asarray(s), np.broadcast_to(
+        xs.sum(0, keepdims=True), xs.shape))
+    np.testing.assert_array_equal(np.asarray(b), np.broadcast_to(
+        xs[3:4], xs.shape))
