@@ -1,0 +1,11 @@
+"""TPU Pallas kernels for hot compute paths.
+
+The reference has no device kernels at all (its Cython "GPU" module is
+host-side staging code, SURVEY.md §2.2) — compute around the collectives is
+where this framework can exceed it.  Kernels here are optional accelerators:
+every caller has an identical pure-``jax.numpy`` path, and the kernels are
+validated against it (tests/test_kernels.py runs them in interpret mode on
+CPU; the TPU build runs them natively).
+"""
+
+from .flash_attention import flash_block_partials  # noqa: F401

@@ -1,0 +1,136 @@
+"""Flash-attention block partials — the ring-attention hot op, in Pallas.
+
+One ring-attention step computes attention of the local queries against one
+rotating K/V block (examples/long_context_attention.py).  The Pallas kernel
+fuses score computation, masking, and the streaming-softmax partials for one
+(batch, head) pair entirely in VMEM — the (Tq, Tk) score matrix never
+touches HBM (XLA materializes it between the einsum and the softmax in the
+fallback path).
+
+Outputs are *partials* in the standard flash/log-sum-exp form, merged across
+ring steps by the caller:
+
+    m      = rowmax(scores)                      (B, H, Tq)
+    l      = rowsum(exp(scores - m))             (B, H, Tq)
+    o_part = exp(scores - m) @ V                 (B, Tq, H, D)
+
+``flash_block_partials`` dispatches to the kernel on TPU and to an
+identical-math jnp path elsewhere (or under ``force_jnp=True``); interpret
+mode covers CPU testing (tests/test_kernels.py).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref):
+    # refs: q (1, Tq, 1, D), k/v (1, Tk, 1, D), mask (Tq, Tk),
+    #       o (1, Tq, 1, D), m/l (1, 1, Tq)
+    q = q_ref[0, :, 0, :]
+    k = k_ref[0, :, 0, :]
+    v = v_ref[0, :, 0, :]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    s = jnp.where(mask_ref[:, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)
+    # fully-masked rows: exp(-inf - -inf) would be nan; zero them instead
+    m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+    p = jnp.exp(s - m_safe[:, None])
+    p = jnp.where(mask_ref[:, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    o_ref[0, :, 0, :] = o.astype(o_ref.dtype)
+    m_ref[0, 0, :] = m
+    l_ref[0, 0, :] = l
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "force_jnp"))
+def flash_block_partials(
+    q,
+    k,
+    v,
+    mask,
+    *,
+    scale: float,
+    interpret: bool = False,
+    force_jnp: bool = False,
+):
+    """Streaming-softmax partials of ``softmax(q k^T * scale) v`` for one
+    K/V block.
+
+    ``q``: (B, Tq, H, D); ``k``/``v``: (B, Tk, H, D); ``mask``: (Tq, Tk)
+    bool, True = attend (shared across batch and heads — the ring-step
+    causal mask depends only on block offsets).
+
+    Returns ``(o_part, m, l)`` with shapes (B, Tq, H, D), (B, H, Tq),
+    (B, H, Tq); rows with no attendable key get ``m = -inf``, ``l = 0``,
+    ``o_part = 0``.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+
+    use_kernel = _HAS_PLTPU and not force_jnp and (
+        interpret or jax.default_backend() == "tpu"
+    )
+    if not use_kernel:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        m = s.max(axis=-1)
+        m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)
+        l = p.sum(axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+        return o, m, l
+
+    qs = q * jnp.asarray(scale, q.dtype)
+    grid = (b, h)
+    out_shapes = (
+        jax.ShapeDtypeStruct((b, tq, h, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+    )
+    qkv_spec = lambda t: pl.BlockSpec(  # noqa: E731
+        (1, t, 1, d), lambda i, j: (i, 0, j, 0), memory_space=pltpu.VMEM
+    )
+    ml_spec = pl.BlockSpec(
+        (1, 1, tq), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            qkv_spec(tq),
+            qkv_spec(tk),
+            qkv_spec(tk),
+            pl.BlockSpec((tq, tk), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(qkv_spec(tq), ml_spec, ml_spec),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(qs, k, v, mask)
+
+
+def merge_partials(acc, m, l, o_new, m_new, l_new):
+    """Log-sum-exp merge of two partial-attention states (the flash
+    combine rule); all rows stay in the (B,H,Tq)/(B,Tq,H,D) layout."""
+    m_out = jnp.maximum(m, m_new)
+    m_safe = jnp.where(jnp.isinf(m_out), 0.0, m_out)
+    c_old = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - m_safe))
+    c_new = jnp.where(jnp.isinf(m_new), 0.0, jnp.exp(m_new - m_safe))
+    l_out = l * c_old + l_new * c_new
+    to_qhd = lambda c: jnp.moveaxis(c, 1, 2)[..., None]  # noqa: E731
+    acc_out = acc * to_qhd(c_old) + o_new * to_qhd(c_new)
+    return acc_out, m_out, l_out
