@@ -1,0 +1,76 @@
+"""Regression tests for the driver entry points (``__graft_entry__.py``).
+
+Round 1 shipped a ``dryrun_multichip`` that asserted on device count but
+never forced the virtual-CPU platform, so the driver's multi-chip check
+failed (VERDICT round 1, item 1).  These tests run the entry points the
+way the driver does — in a subprocess with no test conftest in sight —
+so the contract cannot silently rot.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code, timeout=600):
+    """Run ``code`` in a clean subprocess from the repo root.
+
+    Scrubs the JAX/XLA env vars that tests/conftest.py sets, so the child
+    sees what the driver's process sees (analog of the reference's
+    ``run_in_subprocess`` scrubbed env, ref
+    tests/collective_ops/test_common.py:13-57).
+    """
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO_ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.parametrize("n", [2, 3, 8])
+def test_dryrun_multichip_self_forces_platform(n):
+    # The child process gets NO platform env vars — dryrun_multichip must
+    # force the n-device virtual CPU platform entirely on its own.
+    res = _run(
+        f"import __graft_entry__ as g; g.dryrun_multichip({n})"
+    )
+    assert res.returncode == 0, f"stderr:\n{res.stderr}"
+    assert "dryrun_multichip OK" in res.stdout
+
+
+def test_dryrun_multichip_survives_preinitialized_jax():
+    # Even if jax was already imported and backend-initialized before the
+    # driver calls dryrun_multichip, the forcing must still yield n devices.
+    res = _run(
+        "import jax; jax.devices(); "
+        "import __graft_entry__ as g; g.dryrun_multichip(4)"
+    )
+    assert res.returncode == 0, f"stderr:\n{res.stderr}"
+    assert "dryrun_multichip OK" in res.stdout
+
+
+def test_entry_compiles_and_runs():
+    # The driver compile-checks entry() single-chip; mirror that here.
+    # block_until_ready is a no-op on the axon-tunneled TPU, so sync by
+    # fetching one element to host and assert it is finite.
+    res = _run(
+        "import __graft_entry__ as g; import jax, numpy as np; "
+        "fn, args = g.entry(); out = jax.jit(fn)(*args); "
+        "leaf = jax.tree_util.tree_leaves(out)[0]; "
+        "val = np.asarray(leaf)[(0,) * leaf.ndim]; "
+        "assert np.isfinite(val), val; print('entry OK')"
+    )
+    assert res.returncode == 0, f"stderr:\n{res.stderr}"
+    assert "entry OK" in res.stdout
