@@ -116,7 +116,11 @@ ffi::Error AbortIfImpl(ffi::BufferR0<ffi::U32> pred,
 ffi::Error WallclockImpl(ffi::BufferR0<ffi::U32> token,
                          ffi::Result<ffi::BufferR0<ffi::F64>> out) {
   (void)token;
-  out->typed_data()[0] = Now();
+  // Seconds since this library's first wallclock read, not since boot:
+  // callers may downcast to f32 (x64-disabled JAX), where a since-boot
+  // value has millisecond ULP. Differences are what is meaningful.
+  static const double base = Now();
+  out->typed_data()[0] = Now() - base;
   return ffi::Error::Success();
 }
 

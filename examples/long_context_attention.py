@@ -148,7 +148,8 @@ def main():
     n = len(devices)
     mesh = mpx.make_world_mesh(devices=devices)
     comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
-    b, t_loc, h, d = 2, 128, max(8, n), 64
+    # ulysses shards heads across devices, so h must be a multiple of n
+    b, t_loc, h, d = 2, 128, n * max(1, 8 // n), 64
     q, k, v = _demo_data(jax.random.PRNGKey(0), n, b, t_loc, h, d)
 
     @mpx.spmd(comm=comm)

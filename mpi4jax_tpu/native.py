@@ -158,26 +158,54 @@ def abort_if(pred, rank, message: str):
     return pred
 
 
+# Base timestamp for the pure-Python fallback, captured at first use.  Raw
+# clock values are seconds since boot/epoch, where f32 ULP is milliseconds
+# (or worse); subtracting a process-local base before any f32 downcast
+# keeps sub-microsecond resolution for hours of runtime.  The FFI path's
+# base lives inside the C++ hook (host_hooks.cc WallclockImpl) for the
+# same reason.
+_py_wallclock_base: Optional[float] = None
+
+
 def wallclock(dep=None):
-    """Host wall-clock timestamp (f64 seconds) as an in-graph value,
-    ordered after ``dep``."""
+    """Host wall-clock timestamp as an in-graph value, ordered after
+    ``dep``: seconds since the process's first ``wallclock`` use.
+
+    Returns f64 when ``jax_enable_x64`` is on, else f32 — on both the FFI
+    path and the pure-Python fallback, so the API is consistent across
+    platforms (with x64 disabled, callback ``result_shape_dtypes`` reject
+    64-bit types outright).  Only differences of ``wallclock`` values are
+    meaningful."""
     tok = jnp.zeros((), jnp.uint32) if dep is None else _tie(
         jnp.zeros((), jnp.uint32), dep
     )
+    out_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     if runtime_tracing_supported():
         call = jax.ffi.ffi_call(
             "mpx_wallclock",
             jax.ShapeDtypeStruct((), jnp.float64),
             has_side_effect=True,
         )
-        return call(tok)
+        return call(tok).astype(out_dtype)
     import time
 
-    def _now(_):
-        return jnp.asarray(time.perf_counter(), jnp.float64)
+    import numpy as np
 
-    return jax.pure_callback(
-        _now, jax.ShapeDtypeStruct((), jnp.float64), tok
+    from jax.experimental import io_callback
+
+    global _py_wallclock_base
+    if _py_wallclock_base is None:
+        _py_wallclock_base = time.perf_counter()
+    base = _py_wallclock_base
+
+    def _now(_):
+        # io_callback (ordered) rather than pure_callback: two wallclock
+        # reads in one jit are byte-identical subgraphs a pure callback
+        # could legally dedupe into a single host call
+        return np.asarray(time.perf_counter() - base, out_dtype)
+
+    return io_callback(
+        _now, jax.ShapeDtypeStruct((), out_dtype), tok, ordered=True
     )
 
 

@@ -304,9 +304,11 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
         in_specs=(tuple(axes_spec for _ in arrays), P()),
         out_specs=(axes_spec, P()),
     ))
+    # insert into the cache only after the first call succeeds — a
+    # trace/compile failure must not leave a broken entry to be replayed
+    results, tok_out = sm(tuple(arrays), token)
     if cache_key is not None:
         _eager_cache[cache_key] = sm
         if len(_eager_cache) > _EAGER_CACHE_MAX:
             _eager_cache.popitem(last=False)
-    results, tok_out = sm(tuple(arrays), token)
     return (*results, tok_out)

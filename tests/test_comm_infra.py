@@ -184,3 +184,57 @@ def test_debug_logging_format(capfd):
     captured = capfd.readouterr()
     text = captured.out + captured.err
     assert re.search(r"r\d+ \| [0-9a-f]{8} \| MPI_Allreduce", text), text[:500]
+
+
+def test_logging_toggle_busts_spmd_program_cache(capfd):
+    # Regression (ADVICE r1): the spmd program cache must key on the
+    # dynamically-read observability flags — enabling logging *after* a
+    # wrapped function's first call must re-trace, not silently serve the
+    # stale silent program.
+    import re
+
+    from mpi4jax_tpu.utils import debug
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM)
+        return res
+
+    out = f(ranks_arange((1,)))
+    out.block_until_ready()
+    jax.effects_barrier()
+    capfd.readouterr()  # discard pre-toggle output
+
+    debug.set_logging(True)
+    try:
+        out = f(ranks_arange((1,)))
+        out.block_until_ready()
+        jax.effects_barrier()
+    finally:
+        debug.set_logging(False)
+    text = capfd.readouterr()
+    text = text.out + text.err
+    assert re.search(r"r\d+ \| [0-9a-f]{8} \| MPI_Allreduce", text), text[:500]
+
+
+def test_wallclock_fallback_without_native_lib(monkeypatch):
+    # Regression (ADVICE r1): the pure-Python wallclock fallback declared a
+    # float64 pure_callback result, which raises under the default
+    # x64-disabled config. It must work and match the FFI path's dtype.
+    from mpi4jax_tpu import native
+
+    monkeypatch.setattr(native, "runtime_tracing_supported", lambda: False)
+    t0 = jax.jit(native.wallclock)()
+    t1 = jax.jit(native.wallclock)()
+    expect = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    assert t0.dtype == expect
+    assert float(t1) >= float(t0)
+
+    # two reads inside ONE jit must not be deduped into a single host call
+    def elapsed():
+        a = native.wallclock()
+        b = native.wallclock(dep=a)
+        return a, b
+
+    a, b = jax.jit(elapsed)()
+    assert float(b) >= float(a)
