@@ -33,6 +33,13 @@ def main():
 
     steps_per_sec_per_chip = n_steps / wall / len(devices)
     ref_gpu_wall = 6.28  # Tesla P100, 1 process (BASELINE.md)
+    # achieved HBM bandwidth, state-traffic model: each step must at least
+    # read and write the six (ny_l, nx_l) f32 state fields — a *lower
+    # bound* on real traffic (intermediates add more), so this understates
+    # utilization; v5e peak is ~819 GB/s (measured 826 GB/s streaming on
+    # this chip)
+    field_bytes = cfg.nproc * cfg.ny_local * cfg.nx_local * 4
+    gbps = 12 * field_bytes * n_steps / wall / 1e9 / len(devices)
     print(
         json.dumps(
             {
@@ -40,6 +47,8 @@ def main():
                 "value": round(steps_per_sec_per_chip, 2),
                 "unit": "steps/s/chip",
                 "vs_baseline": round(ref_gpu_wall / wall, 3),
+                "state_traffic_gb_per_s": round(gbps, 1),
+                "wall_s": round(wall, 3),
             }
         )
     )

@@ -89,3 +89,57 @@ def test_shallow_water_boundary_modes(periodic):
                   periodic_x=periodic)
     snaps, _, _ = solve(cfg, 10 * cfg.dt, num_multisteps=5)
     assert np.all(np.isfinite(reassemble(snaps[-2], cfg)))
+
+
+@pytest.mark.parametrize("grid", [(1, 1), (2, 4)])
+@pytest.mark.parametrize("periodic", [True, False])
+def test_fast_step_matches_reference_step(grid, periodic):
+    """model_step_fast must reproduce model_step field-for-field, on both a
+    single-rank and a 2-D decomposition, in both boundary modes.
+
+    Tolerance: the two programs deliberately differ in seam-halo freshness
+    around the viscous substep (model_step_fast docstring) — an artifact of
+    the same size as the *reference's own* decomposition variance (its
+    (1,1)-vs-(2,4) results differ by ~5e-5; see the invariance tests
+    below).  A halo-logic bug would produce O(field-scale) errors, far
+    above this band."""
+    from dataclasses import replace
+
+    from shallow_water import make_mesh_and_comm, make_stepper
+
+    ny_, nx_ = grid
+    cfg = replace(
+        Config(nproc_y=ny_, nproc_x=nx_, nx=48, ny=24), periodic_x=periodic
+    )
+    devices = jax.devices()[: cfg.nproc]
+    _, comm = make_mesh_and_comm(cfg, devices=devices)
+    first_ref, multi_ref = make_stepper(cfg, comm, fast=False)
+    first_fast, multi_fast = make_stepper(cfg, comm, fast=True)
+
+    s0 = initial_state(cfg)
+    ref = multi_ref(first_ref(s0), 20)
+    fast = multi_fast(first_fast(s0), 20)
+    for name, a, b in zip(ref._fields, ref, fast):
+        a, b = np.asarray(a), np.asarray(b)
+        bound = 1e-4 + 1e-5 * np.abs(a).max()
+        assert np.abs(a - b).max() <= bound, (
+            f"field {name} diverged beyond the freshness band "
+            f"(grid={grid}, periodic={periodic}): "
+            f"max abs {np.abs(a - b).max():.3e} > {bound:.3e}"
+        )
+
+
+def test_fast_step_decomposition_invariance_exact():
+    """The fast step's coherent-halo design makes it *exactly*
+    decomposition-invariant (the reference's stale-halo seams make its own
+    (1,1)-vs-(2,4) runs differ by ~5e-5): same bits on a single device and
+    on a (2,4) mesh."""
+    steps = 20
+    cfg8 = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
+    s8, _, _ = solve(cfg8, steps * cfg8.dt, num_multisteps=5, fast=True)
+    cfg1 = Config(nproc_y=1, nproc_x=1, nx=48, ny=24)
+    s1, _, _ = solve(cfg1, steps * cfg1.dt, num_multisteps=5, fast=True,
+                     devices=jax.devices()[:1])
+    g8 = reassemble(s8[-2], cfg8)
+    g1 = reassemble(s1[-2], cfg1)
+    np.testing.assert_array_equal(g8, g1)
