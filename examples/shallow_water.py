@@ -538,6 +538,224 @@ def model_step_fast(state: State, cfg: Config, comm: mpx.Comm,
     return State(h, u, v, dh_new, du_new, dv_new)
 
 
+# ---------------------------------------------------------------------------
+# Pallas single-kernel step (single-rank hot path)
+# ---------------------------------------------------------------------------
+
+_PBLK = 32  # output rows per grid step (multiple of 8: f32 sublane tile)
+_PMRG = 8  # margin rows each side (recompute chain needs 3; 8 = tile size)
+
+
+def _sw_step_kernel(cfg: Config, first_step: bool, n_rows: int, refs):
+    """Whole-step kernel body: the entire model_step_fast computation on a
+    ``(_PBLK + 2 * _PMRG, nx_local)`` row window, margins recomputed so no
+    intermediate field ever round-trips through HBM.
+
+    Valid only for the single-rank, periodic-x decomposition: x stencil
+    reads use true periodic lane rolls, and the mid-step halo refresh of
+    the integrated ``u``/``v`` (needed by the viscous fluxes) becomes an
+    in-register periodic column fix.  Wall/edge semantics are identical to
+    ``model_step_fast``'s iota masks, evaluated on global row indices.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas as pl
+
+    (h_p, h_m, h_n, u_p, u_m, u_n, v_p, v_m, v_n,
+     dh_p, dh_m, dh_n_, dv_p_du, du_m, du_n,
+     dv_p, dv_m, dv_n,
+     h_o, u_o, v_o, dho_o, duo_o, dvo_o) = refs
+
+    nx = cfg.nx_local
+    nr = _PBLK + 2 * _PMRG
+    dx, dy, g, dt = cfg.dx, cfg.dy, cfg.gravity, cfg.dt
+
+    def assemble(p, m, n):
+        return jnp.concatenate([p[:], m[:], n[:]], axis=0)
+
+    h = assemble(h_p, h_m, h_n)
+    u = assemble(u_p, u_m, u_n)
+    v = assemble(v_p, v_m, v_n)
+    dh = assemble(dh_p, dh_m, dh_n_)
+    du = assemble(dv_p_du, du_m, du_n)
+    dv = assemble(dv_p, dv_m, dv_n)
+
+    # periodic lane shifts; sublane shifts wrap inside the window (the
+    # wrapped rows are margin garbage that the masks keep out of the
+    # stored rows — chain depth 3 < _PMRG)
+    rm1x = lambda a: pltpu.roll(a, nx - 1, 1)  # noqa: E731  a[j, i+1]
+    rp1x = lambda a: pltpu.roll(a, 1, 1)  # noqa: E731      a[j, i-1]
+    rm1y = lambda a: pltpu.roll(a, nr - 1, 0)  # noqa: E731  a[j+1, i]
+    rp1y = lambda a: pltpu.roll(a, 1, 0)  # noqa: E731       a[j-1, i]
+
+    pid = pl.program_id(0)
+    iy = (
+        jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 0)
+        + pid * _PBLK
+        - _PMRG
+    )
+    ix = jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 1)
+    kept = (iy == 0) | (iy == n_rows - 1)  # single rank: both walls
+    interior = (iy > 0) & (iy < n_rows - 1) & (ix > 0) & (ix < nx - 1)
+    wall_v = kept | (iy == n_rows - 2)  # kind-"v" no-flux row
+
+    def derived(expr, mask):
+        return jnp.where(mask, 0.0, expr)
+
+    def pc_fix(a):
+        # periodic column refresh: col 0 <- col -2, col -1 <- col 1 (what
+        # the single-rank wrap exchange delivers), fully in-register
+        return jnp.where(
+            ix == 0,
+            pltpu.roll(a, 2, 1),
+            jnp.where(ix == nx - 1, pltpu.roll(a, nx - 2, 1), a),
+        )
+
+    # hc: edge-replicated pad rows at the walls (single rank: both)
+    hc = jnp.where(iy == 0, rm1y(h), jnp.where(iy == n_rows - 1, rp1y(h), h))
+
+    fe = derived(0.5 * (hc + rm1x(hc)) * u, kept)
+    fn = derived(0.5 * (hc + rm1y(hc)) * v, wall_v)
+
+    cor = cfg.coriolis_f + (iy - 1).astype(jnp.float32) * cfg.dy * cfg.coriolis_beta
+    rel_vort = (rm1x(v) - v) / dx - (rm1y(u) - u) / dy
+    depth_q = 0.25 * (hc + rm1x(hc) + rm1y(hc) + rm1y(rm1x(hc)))
+    q = derived((cor + rel_vort) / depth_q, kept)
+    ke = derived(
+        0.5 * (0.5 * (u**2 + rp1x(u) ** 2) + 0.5 * (v**2 + rp1y(v) ** 2)),
+        kept,
+    )
+
+    dh_new = jnp.where(
+        interior, -(fe - rp1x(fe)) / dx - (fn - rp1y(fn)) / dy, 0.0
+    )
+    du_new = jnp.where(
+        interior,
+        -g * (rm1x(h) - h) / dx
+        + 0.5
+        * (q * 0.5 * (fn + rm1x(fn)) + rp1y(q) * 0.5 * (rp1y(fn) + rp1y(rm1x(fn))))
+        - (rm1x(ke) - ke) / dx,
+        0.0,
+    )
+    dv_new = jnp.where(
+        interior,
+        -g * (rm1y(h) - h) / dy
+        - 0.5
+        * (q * 0.5 * (fe + rm1y(fe)) + rp1x(q) * 0.5 * (rp1x(fe) + rp1x(rm1y(fe))))
+        - (rm1y(ke) - ke) / dy,
+        0.0,
+    )
+
+    if first_step:
+        h1 = h + dt * dh_new
+        u1 = u + dt * du_new
+        v1 = v + dt * dv_new
+    else:
+        h1 = h + dt * (cfg.ab_a * dh_new + cfg.ab_b * dh)
+        u1 = u + dt * (cfg.ab_a * du_new + cfg.ab_b * du)
+        v1 = v + dt * (cfg.ab_a * dv_new + cfg.ab_b * dv)
+
+    # mid-step halo refresh (the jnp path's enforce_boundaries between
+    # integration and viscosity): periodic column fix + kind-"v" wall row
+    u1 = pc_fix(u1)
+    v1 = jnp.where(iy == n_rows - 2, 0.0, pc_fix(v1))
+
+    if cfg.lateral_viscosity > 0:
+        visc = cfg.lateral_viscosity
+        for which in (0, 1):
+            f = u1 if which == 0 else v1
+            gx = derived(visc * (rm1x(f) - f) / dx, kept)
+            gy = derived(visc * (rm1y(f) - f) / dy, wall_v)
+            f = f + jnp.where(
+                interior,
+                dt * ((gx - rp1x(gx)) / dx + (gy - rp1y(gy)) / dy),
+                0.0,
+            )
+            if which == 0:
+                u1 = f
+            else:
+                v1 = f
+
+    sl = slice(_PMRG, _PMRG + _PBLK)
+    h_o[:] = h1[sl]
+    u_o[:] = u1[sl]
+    v_o[:] = v1[sl]
+    dho_o[:] = dh_new[sl]
+    duo_o[:] = du_new[sl]
+    dvo_o[:] = dv_new[sl]
+
+
+def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
+                      first_step: bool, interpret: bool = False) -> State:
+    """``model_step_fast`` as ONE fused Pallas kernel + the end-of-step
+    exchanges.
+
+    Every intermediate (hc, fe, fn, q, ke, viscous fluxes) lives in VMEM
+    only: per step the state is read and written once (plus an 8-row
+    margin per 32-row block), instead of materializing ~10 intermediate
+    full fields through HBM.  Single-rank periodic-x decompositions only
+    (the benchmark configuration); multi-rank meshes use
+    ``model_step_fast``, whose exchange structure this kernel reproduces
+    in-register (see ``_sw_step_kernel``).  Equality with the jnp step is
+    pinned by tests (interpret mode on CPU, compiled on TPU).
+    """
+    assert cfg.nproc == 1 and cfg.periodic_x, (
+        "model_step_pallas: single-rank periodic-x only; use model_step_fast"
+    )
+    import jax.experimental.pallas as pl
+
+    ny, nx = cfg.ny_local, cfg.nx_local
+    token = mpx.create_token()
+    h, u, v, dh, du, dv = state
+
+    grid = ((ny + _PBLK - 1) // _PBLK,)
+    n_hblocks = (ny + _PMRG - 1) // _PMRG  # 8-row halo block count
+    r = _PBLK // _PMRG
+
+    def main_spec():
+        return pl.BlockSpec((_PBLK, nx), lambda i: (i, 0))
+
+    def prev_spec():
+        return pl.BlockSpec(
+            (_PMRG, nx), lambda i: (jnp.clip(i * r - 1, 0, n_hblocks - 1), 0)
+        )
+
+    def next_spec():
+        return pl.BlockSpec(
+            (_PMRG, nx), lambda i: (jnp.clip(i * r + r, 0, n_hblocks - 1), 0)
+        )
+
+    in_specs = []
+    operands = []
+    for f in (h, u, v, dh, du, dv):
+        in_specs += [prev_spec(), main_spec(), next_spec()]
+        operands += [f, f, f]
+
+    # inside shard_map with VMA checking the outputs must be typed as
+    # varying over the mesh axes, like the (sharded) inputs
+    vma = frozenset(getattr(jax.typeof(h), "vma", frozenset()))
+    out_shape = [
+        jax.ShapeDtypeStruct((ny, nx), jnp.float32, vma=vma)
+    ] * 6
+    outs = pl.pallas_call(
+        lambda *refs: _sw_step_kernel(cfg, first_step, ny, refs),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[main_spec() for _ in range(6)],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+    h1, u1, v1, dh_new, du_new, dv_new = outs
+
+    # end-of-step exchanges, as in model_step_fast: h post-integration
+    # (kind "h"), u/v post-viscosity halo refresh (kind "h": the wall
+    # conditions were applied once, in-kernel)
+    h1, token = enforce_boundaries(h1, "h", cfg, comm, token)
+    u1, token = enforce_boundaries(u1, "h", cfg, comm, token)
+    v1, token = enforce_boundaries(v1, "h", cfg, comm, token)
+
+    return State(h1, u1, v1, dh_new, du_new, dv_new)
+
+
 def select_step(fast: bool):
     """The model-step implementation behind ``fast``: the single source of
     truth for every driver (make_stepper, solve_fused, bench.py)."""
