@@ -29,7 +29,9 @@ def main():
     cfg = Config(nproc_y=nproc_y, nproc_x=nproc_x, nx=3600, ny=1800)
     t1 = 0.1 * DAY_IN_SECONDS
 
-    wall, n_steps = solve_fused(cfg, t1, devices=devices)
+    # fast="auto": single-device runs use the fused whole-step Pallas
+    # kernel (model_step_pallas); multi-device meshes use model_step_fast
+    wall, n_steps = solve_fused(cfg, t1, devices=devices, fast="auto")
 
     steps_per_sec_per_chip = n_steps / wall / len(devices)
     ref_gpu_wall = 6.28  # Tesla P100, 1 process (BASELINE.md)

@@ -556,14 +556,16 @@ def _sw_step_kernel(cfg: Config, first_step: bool, n_rows: int, refs):
     the integrated ``u``/``v`` (needed by the viscous fluxes) becomes an
     in-register periodic column fix.  Wall/edge semantics are identical to
     ``model_step_fast``'s iota masks, evaluated on global row indices.
+
+    ``refs`` is 18 input refs (6 fields x [prev-margin, main, next-margin]
+    blocks, field order h,u,v,dh,du,dv) followed by the 6 output refs; the
+    unpacking below is positional by that structure.
     """
     from jax.experimental.pallas import tpu as pltpu
     import jax.experimental.pallas as pl
 
-    (h_p, h_m, h_n, u_p, u_m, u_n, v_p, v_m, v_n,
-     dh_p, dh_m, dh_n_, dv_p_du, du_m, du_n,
-     dv_p, dv_m, dv_n,
-     h_o, u_o, v_o, dho_o, duo_o, dvo_o) = refs
+    ins, outs = refs[:18], refs[18:]
+    h_o, u_o, v_o, dho_o, duo_o, dvo_o = outs
 
     nx = cfg.nx_local
     nr = _PBLK + 2 * _PMRG
@@ -572,12 +574,9 @@ def _sw_step_kernel(cfg: Config, first_step: bool, n_rows: int, refs):
     def assemble(p, m, n):
         return jnp.concatenate([p[:], m[:], n[:]], axis=0)
 
-    h = assemble(h_p, h_m, h_n)
-    u = assemble(u_p, u_m, u_n)
-    v = assemble(v_p, v_m, v_n)
-    dh = assemble(dh_p, dh_m, dh_n_)
-    du = assemble(dv_p_du, du_m, du_n)
-    dv = assemble(dv_p, dv_m, dv_n)
+    h, u, v, dh, du, dv = (
+        assemble(*ins[3 * k : 3 * k + 3]) for k in range(6)
+    )
 
     # periodic lane shifts; sublane shifts wrap inside the window (the
     # wrapped rows are margin garbage that the masks keep out of the
@@ -685,7 +684,7 @@ def _sw_step_kernel(cfg: Config, first_step: bool, n_rows: int, refs):
 
 
 def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
-                      first_step: bool, interpret: bool = False) -> State:
+                      first_step: bool, interpret=None) -> State:
     """``model_step_fast`` as ONE fused Pallas kernel + the end-of-step
     exchanges.
 
@@ -696,16 +695,48 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     (the benchmark configuration); multi-rank meshes use
     ``model_step_fast``, whose exchange structure this kernel reproduces
     in-register (see ``_sw_step_kernel``).  Equality with the jnp step is
-    pinned by tests (interpret mode on CPU, compiled on TPU).
+    pinned by tests/test_examples.py::test_pallas_step_matches_fast_step
+    (interpret mode on CPU, compiled on TPU).
+
+    ``interpret=None`` resolves at trace time to "the comm's mesh is not
+    on TPU devices", so the same call sites run the Mosaic-compiled kernel
+    on the chip and the interpret path everywhere else (CPU CI, the
+    driver's compile check).
     """
     assert cfg.nproc == 1 and cfg.periodic_x, (
         "model_step_pallas: single-rank periodic-x only; use model_step_fast"
     )
     import jax.experimental.pallas as pl
 
+    if interpret is None:
+        # resolve from the mesh the step actually runs on, not the process
+        # default backend (the two differ when a driver places the mesh on
+        # a non-default platform's devices)
+        mesh = comm.mesh
+        if mesh is not None and mesh.devices.size:
+            interpret = mesh.devices.flat[0].platform != "tpu"
+        else:
+            interpret = jax.default_backend() != "tpu"
+
     ny, nx = cfg.ny_local, cfg.nx_local
     token = mpx.create_token()
-    h, u, v, dh, du, dv = state
+    fields = state
+    # inside shard_map with VMA checking the outputs must be typed as
+    # varying over the mesh axes, like the (sharded) inputs
+    vma = frozenset(getattr(jax.typeof(state.h), "vma", frozenset()))
+    if interpret and vma:
+        # interpret mode inlines the kernel jaxpr under shard_map's
+        # varying-manual-axes checking, where kernel-created iotas and
+        # literals (unvarying) cannot mix with varying operands.  The
+        # kernel only ever runs on a 1x1 mesh (nproc == 1), so the axes
+        # are size-1 and a psum is an exact identity that makes every
+        # operand axis-invariant; the outputs are re-varied below.
+        axes = tuple(vma)
+        fields = State(*(jax.lax.psum(f, axes) for f in state))
+        out_vma = frozenset()
+    else:
+        out_vma = vma
+    h, u, v, dh, du, dv = fields
 
     grid = ((ny + _PBLK - 1) // _PBLK,)
     n_hblocks = (ny + _PMRG - 1) // _PMRG  # 8-row halo block count
@@ -730,12 +761,20 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
         in_specs += [prev_spec(), main_spec(), next_spec()]
         operands += [f, f, f]
 
-    # inside shard_map with VMA checking the outputs must be typed as
-    # varying over the mesh axes, like the (sharded) inputs
-    vma = frozenset(getattr(jax.typeof(h), "vma", frozenset()))
     out_shape = [
-        jax.ShapeDtypeStruct((ny, nx), jnp.float32, vma=vma)
+        jax.ShapeDtypeStruct((ny, nx), jnp.float32, vma=out_vma)
     ] * 6
+    if interpret:
+        compiler_params = None
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+
+        # at benchmark width (nx_local=3602) the 18 window blocks plus
+        # kernel intermediates need ~23 MB of VMEM — well within the
+        # chip's 128 MB but above Mosaic's 16 MB default scoped limit
+        compiler_params = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        )
     outs = pl.pallas_call(
         lambda *refs: _sw_step_kernel(cfg, first_step, ny, refs),
         grid=grid,
@@ -743,7 +782,10 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
         out_specs=[main_spec() for _ in range(6)],
         out_shape=out_shape,
         interpret=interpret,
+        compiler_params=compiler_params,
     )(*operands)
+    if interpret and vma:
+        outs = [jax.lax.pcast(o, axes, to="varying") for o in outs]
     h1, u1, v1, dh_new, du_new, dv_new = outs
 
     # end-of-step exchanges, as in model_step_fast: h post-integration
@@ -756,22 +798,38 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     return State(h1, u1, v1, dh_new, du_new, dv_new)
 
 
-def select_step(fast: bool):
+def select_step(fast, cfg: Config = None):
     """The model-step implementation behind ``fast``: the single source of
-    truth for every driver (make_stepper, solve_fused, bench.py)."""
+    truth for every driver (make_stepper, solve_fused, bench.py).
+
+    ``fast`` is one of:
+
+    - ``False`` — the reference-structured step (parity oracle);
+    - ``True`` — ``model_step_fast`` (works on any mesh);
+    - ``"pallas"`` — the fused whole-step Pallas kernel
+      (single-rank periodic-x only; asserts otherwise);
+    - ``"auto"`` — ``"pallas"`` when ``cfg`` is a single-rank periodic-x
+      decomposition (the benchmark configuration), else ``True``.
+    """
+    if fast == "auto":
+        eligible = cfg is not None and cfg.nproc == 1 and cfg.periodic_x
+        fast = "pallas" if eligible else True
+    if fast == "pallas":
+        return model_step_pallas
     return model_step_fast if fast else model_step
 
 
-def make_stepper(cfg: Config, comm: mpx.Comm, *, fast: bool = True):
+def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
     """Compile the two region programs: the first (Euler) step and an
     n-step AB-2 multistep (``lax.fori_loop`` inside the region — one XLA
     program per multistep, ref examples/shallow_water.py:415-420).
 
     ``fast`` selects the TPU-restructured step (``model_step_fast``,
-    default); ``fast=False`` keeps the reference-structured step —
-    the two are verified equal in tests/test_examples.py.
+    default); ``fast=False`` keeps the reference-structured step;
+    ``"pallas"``/``"auto"`` select the fused whole-step kernel (see
+    ``select_step``) — all verified equal in tests/test_examples.py.
     """
-    step = select_step(fast)
+    step = select_step(fast, cfg)
 
     @partial(mpx.spmd, comm=comm)
     def first_step(state: State) -> State:
@@ -792,7 +850,7 @@ def make_stepper(cfg: Config, comm: mpx.Comm, *, fast: bool = True):
 
 
 def solve(cfg: Config, t1: float, *, num_multisteps: int = 10, devices=None,
-          collect: bool = True, verbose: bool = False, fast: bool = True):
+          collect: bool = True, verbose: bool = False, fast=True):
     """Iterate the model to time ``t1`` [s].  Returns ``(snapshots,
     wall_time_s, n_steps)``; ``snapshots`` is a list of stacked-block h
     fields (empty when ``collect=False``)."""
@@ -841,7 +899,7 @@ def solve(cfg: Config, t1: float, *, num_multisteps: int = 10, devices=None,
 
 
 def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
-                devices=None, fast: bool = True):
+                devices=None, fast=True):
     """Benchmark-mode solve: the ENTIRE simulation is one XLA program
     (first Euler step + a ``fori_loop`` over all remaining steps), so the
     host dispatches once instead of once per multistep.  Runs the same
@@ -851,7 +909,7 @@ def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
     mesh, comm = make_mesh_and_comm(cfg, devices=devices)
     n_iters = max(0, math.ceil((t1 - cfg.dt) / (cfg.dt * num_multisteps)))
     n_steps = 1 + n_iters * num_multisteps
-    step = select_step(fast)
+    step = select_step(fast, cfg)
 
     @partial(mpx.spmd, comm=comm, static_argnums=(1,))
     def fused(state: State, total: int) -> State:
@@ -942,10 +1000,11 @@ def main():
 
     if args.benchmark:
         # one fused XLA program for the whole run (no snapshots)
-        wall, n_steps = solve_fused(cfg, t1, devices=devices)
+        wall, n_steps = solve_fused(cfg, t1, devices=devices, fast="auto")
         snapshots = []
     else:
-        snapshots, wall, n_steps = solve(cfg, t1, devices=devices, verbose=True)
+        snapshots, wall, n_steps = solve(cfg, t1, devices=devices,
+                                         verbose=True, fast="auto")
     print(f"\nSolution took {wall:.2f}s "
           f"({n_steps} steps, {n_steps / wall:.1f} steps/s)")
 

@@ -129,6 +129,69 @@ def test_fast_step_matches_reference_step(grid, periodic):
         )
 
 
+@pytest.mark.parametrize(
+    "ny,nx",
+    [
+        (24, 48),   # ny_local=26: single partial 32-row block
+        (30, 48),   # ny_local=32: exactly one full block
+        (62, 48),   # ny_local=64: two full blocks
+        (78, 40),   # ny_local=80: full blocks + partial, nx_local=42
+    ],
+)
+def test_pallas_step_matches_fast_step(ny, nx):
+    """The fused whole-step Pallas kernel (interpret mode on CPU) must
+    reproduce model_step_fast on the single-rank periodic-x configs it is
+    restricted to, including row counts that are not multiples of the
+    32-row block, at tight tolerance: same elementwise operand values, so
+    the only divergence is fusion-order rounding (~1 ulp/step — observed
+    max 1.1e-6 after 11 steps), far below the 1e-4 freshness band of the
+    fast-vs-reference test."""
+    from shallow_water import make_mesh_and_comm, make_stepper
+
+    cfg = Config(nproc_y=1, nproc_x=1, nx=nx, ny=ny)
+    devices = jax.devices()[:1]
+    _, comm = make_mesh_and_comm(cfg, devices=devices)
+    first_fast, multi_fast = make_stepper(cfg, comm, fast=True)
+    first_pal, multi_pal = make_stepper(cfg, comm, fast="pallas")
+
+    s0 = initial_state(cfg)
+    fast = multi_fast(first_fast(s0), 10)
+    pal = multi_pal(first_pal(s0), 10)
+    for name, a, b in zip(fast._fields, fast, pal):
+        a, b = np.asarray(a), np.asarray(b)
+        np.testing.assert_allclose(
+            a, b, rtol=1e-5, atol=1e-5,
+            err_msg=f"field {name} diverged (ny={ny}, nx={nx})",
+        )
+
+
+def test_pallas_step_rejects_multirank_config():
+    from shallow_water import make_mesh_and_comm, make_stepper
+
+    cfg = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
+    _, comm = make_mesh_and_comm(cfg)
+    with pytest.raises(AssertionError, match="single-rank periodic-x"):
+        first, _ = make_stepper(cfg, comm, fast="pallas")
+        first(initial_state(cfg))
+
+
+def test_select_step_auto_picks_pallas_only_when_eligible():
+    from dataclasses import replace
+
+    from shallow_water import (
+        model_step_fast,
+        model_step_pallas,
+        select_step,
+    )
+
+    single = Config(nproc_y=1, nproc_x=1, nx=48, ny=24)
+    assert select_step("auto", single) is model_step_pallas
+    multi = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
+    assert select_step("auto", multi) is model_step_fast
+    walls = replace(single, periodic_x=False)
+    assert select_step("auto", walls) is model_step_fast
+
+
 def test_fast_step_decomposition_invariance_exact():
     """The fast step's coherent-halo design makes it *exactly*
     decomposition-invariant (the reference's stale-halo seams make its own
