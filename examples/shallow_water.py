@@ -812,8 +812,12 @@ def select_step(fast, cfg: Config = None):
       decomposition (the benchmark configuration), else ``True``.
     """
     if fast == "auto":
-        eligible = cfg is not None and cfg.nproc == 1 and cfg.periodic_x
-        fast = "pallas" if eligible else True
+        if cfg is None:
+            raise ValueError(
+                "select_step('auto') needs the Config to decide kernel "
+                "eligibility — pass cfg"
+            )
+        fast = "pallas" if cfg.nproc == 1 and cfg.periodic_x else True
     if fast == "pallas":
         return model_step_pallas
     return model_step_fast if fast else model_step
