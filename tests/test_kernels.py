@@ -1,0 +1,183 @@
+"""Pallas kernel tests: flash-attention block partials.
+
+The kernel (``mpi4jax_tpu/kernels/flash_attention.py``) is the ring-attention
+hot op — ``examples/long_context_attention.py::ring_attention`` calls it once
+per ring step.  Interpret mode runs the actual kernel body on CPU; the
+acceptance criterion is equality with the identical-math jnp path
+(``force_jnp=True``), including rows with no attendable key, which must come
+back as ``m = -inf``, ``l = 0``, ``o = 0`` rather than NaN.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi4jax_tpu.kernels.flash_attention import (
+    flash_block_partials,
+    merge_partials,
+)
+
+
+def _qkv(seed, b, tq, tk, h, d, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, tq, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, tk, h, d), dtype)
+    v = jax.random.normal(ks[2], (b, tk, h, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "b,tq,tk,h,d",
+    [
+        (1, 16, 16, 1, 32),
+        (2, 16, 24, 4, 32),  # rectangular block (ring step of unequal shards)
+        (2, 8, 8, 3, 64),
+    ],
+)
+def test_kernel_matches_jnp_path(b, tq, tk, h, d):
+    q, k, v = _qkv(0, b, tq, tk, h, d)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(7), 0.8, (tq, tk))
+    scale = 1.0 / math.sqrt(d)
+    o_k, m_k, l_k = flash_block_partials(q, k, v, mask, scale=scale,
+                                         interpret=True)
+    o_j, m_j, l_j = flash_block_partials(q, k, v, mask, scale=scale,
+                                         force_jnp=True)
+    np.testing.assert_allclose(np.asarray(m_k), np.asarray(m_j),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(l_k), np.asarray(l_j),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_j),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_fully_masked_rows():
+    """A ring step attending a strictly-future K/V block has fully-masked
+    query rows: the partials must be (m=-inf, l=0, o=0) — not NaN — so the
+    merge rule can ignore them."""
+    b, t, h, d = 2, 16, 2, 32
+    q, k, v = _qkv(1, b, t, t, h, d)
+    # causal mask of a future block: every row fully masked
+    mask = jnp.zeros((t, t), bool)
+    for kwargs in ({"interpret": True}, {"force_jnp": True}):
+        o, m, l = flash_block_partials(q, k, v, mask, scale=0.1, **kwargs)
+        o, m, l = np.asarray(o), np.asarray(m), np.asarray(l)
+        assert np.all(np.isinf(m)) and np.all(m < 0), kwargs
+        assert np.all(l == 0.0), kwargs
+        assert np.all(o == 0.0), kwargs
+        assert not np.any(np.isnan(o)), kwargs
+
+
+def test_kernel_partially_masked_rows():
+    """The causal diagonal block: rows have 1..t attendable keys."""
+    b, t, h, d = 1, 16, 2, 32
+    q, k, v = _qkv(2, b, t, t, h, d)
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    o_k, m_k, l_k = flash_block_partials(q, k, v, mask, scale=0.2,
+                                         interpret=True)
+    o_j, m_j, l_j = flash_block_partials(q, k, v, mask, scale=0.2,
+                                         force_jnp=True)
+    np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_j),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l_k), np.asarray(l_j),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("impl", ["interpret", "force_jnp"])
+def test_blockwise_merge_equals_full_softmax(impl):
+    """Splitting K/V into blocks, computing partials per block, and folding
+    with merge_partials must equal plain full attention — the invariant
+    ring_attention rests on."""
+    b, t, h, d = 2, 32, 2, 32
+    q, k, v = _qkv(3, b, t, t, h, d)
+    scale = 1.0 / math.sqrt(d)
+    kwargs = {impl: True} if impl == "force_jnp" else {"interpret": True}
+
+    # ground truth: full softmax attention
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    expected = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v
+    )
+
+    m = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    acc = jnp.zeros_like(q)
+    n_blocks = 4
+    blk = t // n_blocks
+    full_mask = jnp.ones((t, blk), bool)
+    for i in range(n_blocks):
+        kb = k[:, i * blk : (i + 1) * blk]
+        vb = v[:, i * blk : (i + 1) * blk]
+        o_new, m_new, l_new = flash_block_partials(
+            q, kb, vb, full_mask, scale=scale, **kwargs
+        )
+        acc, m, l = merge_partials(acc, m, l, o_new, m_new, l_new)
+    out = acc / jnp.moveaxis(l, 1, 2)[..., None]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("impl", ["interpret", "force_jnp"])
+def test_mask_none_equals_all_true_mask(impl):
+    b, t, h, d = 2, 16, 2, 32
+    q, k, v = _qkv(5, b, t, t, h, d)
+    kwargs = {impl: True} if impl == "force_jnp" else {"interpret": True}
+    o_n, m_n, l_n = flash_block_partials(q, k, v, None, scale=0.2, **kwargs)
+    o_t, m_t, l_t = flash_block_partials(
+        q, k, v, jnp.ones((t, t), bool), scale=0.2, **kwargs
+    )
+    np.testing.assert_allclose(np.asarray(o_n), np.asarray(o_t),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m_n), np.asarray(m_t), rtol=1e-7)
+    np.testing.assert_allclose(np.asarray(l_n), np.asarray(l_t), rtol=1e-6)
+
+
+@pytest.mark.parametrize("impl", ["interpret", "force_jnp"])
+def test_bf16_dtype_contract(impl):
+    """o_part keeps the input dtype; m/l are f32 on both paths."""
+    b, t, h, d = 1, 16, 2, 32
+    q, k, v = _qkv(6, b, t, t, h, d, dtype=jnp.bfloat16)
+    kwargs = {impl: True} if impl == "force_jnp" else {"interpret": True}
+    o, m, l = flash_block_partials(q, k, v, None, scale=0.2, **kwargs)
+    assert o.dtype == jnp.bfloat16
+    assert m.dtype == jnp.float32 and l.dtype == jnp.float32
+
+
+def test_ring_attention_preserves_bf16_dtype():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "examples"))
+    from long_context_attention import ring_attention
+
+    import mpi4jax_tpu as mpx
+
+    comm = mpx.get_default_comm()
+    size = comm.Get_size()
+    shape = (size, 1, 8, 2, 32)
+    q = jnp.ones(shape, jnp.bfloat16)
+
+    @mpx.spmd
+    def f(q):
+        return ring_attention(q, q, q, comm=comm, causal=True)
+
+    out = f(q)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_merge_with_fully_masked_block_is_identity():
+    b, t, h, d = 1, 8, 1, 32
+    q, k, v = _qkv(4, b, t, t, h, d)
+    o1, m1, l1 = flash_block_partials(q, k, v, jnp.ones((t, t), bool),
+                                      scale=0.3, force_jnp=True)
+    o0, m0, l0 = flash_block_partials(q, k, v, jnp.zeros((t, t), bool),
+                                      scale=0.3, force_jnp=True)
+    acc, m, l = merge_partials(o1, m1, l1, o0, m0, l0)
+    np.testing.assert_allclose(np.asarray(acc), np.asarray(o1), rtol=1e-7)
+    np.testing.assert_array_equal(np.asarray(m), np.asarray(m1))
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l1), rtol=1e-7)
