@@ -134,7 +134,8 @@ def main():
         print(f"  {r['size_mb']:>10.3f} MB   {r['time_us']:>10.1f} us   {bw}")
     print("\nsendrecv ring (shift(1))      time/hop     link bandwidth")
     for r in pp:
-        bw = f"{r['link_gb_s']} GB/s" if r["link_gb_s"] is not None else "n/a (1 device)"
+        bw = (f"{r['link_gb_s']} GB/s" if r["link_gb_s"] is not None
+              else "n/a (1 device)")
         print(f"  {r['size_kb']:>10.2f} KB   {r['hop_us']:>10.2f} us   {bw}")
 
 

@@ -25,7 +25,6 @@ Measured on one v5e chip (B=4, T=4096, H=8, D=128, causal, f32):
 """
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -28,7 +28,6 @@ import ctypes
 import os
 import subprocess
 import sys
-from functools import partial
 from typing import Optional
 
 import jax

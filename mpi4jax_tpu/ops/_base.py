@@ -19,7 +19,6 @@ collectives, so:
 from __future__ import annotations
 
 import enum
-import operator
 from typing import Callable, Optional, Tuple, Union
 
 import jax
@@ -34,7 +33,7 @@ from ..parallel.region import (
     in_parallel_region,
     resolve_comm,
 )
-from ..utils.debug import get_logging, get_runtime_tracing, log_op, op_scope
+from ..utils.debug import get_logging, get_runtime_tracing, op_scope
 from ..utils.dtypes import check_dtype
 
 

@@ -11,10 +11,12 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(comm=(Comm, None), token=(Token, None))
 def allgather(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
     """Gather ``x`` from every rank; all ranks receive ``(size, *x.shape)``.
 

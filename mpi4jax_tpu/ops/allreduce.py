@@ -22,10 +22,12 @@ from typing import Optional
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import SUM, Op, OpLike, apply_allreduce, dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(comm=(Comm, None), token=(Token, None))
 def allreduce(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
               token: Optional[Token] = None):
     """Reduce ``x`` with ``op`` across all ranks of ``comm``; every rank

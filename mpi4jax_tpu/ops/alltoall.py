@@ -14,10 +14,12 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(comm=(Comm, None), token=(Token, None))
 def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
     """Exchange slices: rank ``r`` sends ``x[i]`` to rank ``i`` and receives
     into ``out[i]`` from rank ``i``.

@@ -14,10 +14,12 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import as_varying, dispatch
-from .token import Token, consume, produce
+from .token import Token, consume
 
 
+@enforce_types(comm=(Comm, None), token=(Token, None))
 def barrier(*, comm: Optional[Comm] = None, token: Optional[Token] = None):
     """Synchronize all ranks of ``comm``.  Returns a token
     (ref API: barrier.py:38-66)."""

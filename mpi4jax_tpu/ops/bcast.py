@@ -18,10 +18,12 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(root=int, comm=(Comm, None), token=(Token, None))
 def bcast(x, root: int, *, comm: Optional[Comm] = None,
           token: Optional[Token] = None):
     """Broadcast ``x`` from rank ``root`` to all ranks.
@@ -29,8 +31,6 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
     Returns ``(result, token)`` (ref API: bcast.py:40-84).  ``root`` must be
     a static Python int (SPMD traces one program for all ranks).
     """
-    if not isinstance(root, int):
-        raise TypeError(f"bcast root must be a static int, got {type(root)}")
 
     def body(comm, arrays, token):
         (xl,) = arrays

@@ -17,10 +17,12 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(root=int, comm=(Comm, None), token=(Token, None))
 def gather(x, root: int, *, comm: Optional[Comm] = None,
            token: Optional[Token] = None):
     """Gather ``x`` from every rank to ``root`` (all ranks receive a copy —
@@ -28,8 +30,6 @@ def gather(x, root: int, *, comm: Optional[Comm] = None,
 
     Returns ``(result, token)`` (ref API: gather.py:40-96).
     """
-    if not isinstance(root, int):
-        raise TypeError(f"gather root must be a static int, got {type(root)}")
 
     def body(comm, arrays, token):
         (xl,) = arrays

@@ -19,12 +19,15 @@ from ..parallel.comm import Comm
 from ..parallel.rankspec import normalize_source
 from ..parallel.region import current_context
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import as_varying, dispatch
 from .sendrecv import _apply_permute, _fill_status
 from .status import Status
 from .token import Token, consume, produce
 
 
+@enforce_types(tag=int, comm=(Comm, None), status=(Status, None),
+               token=(Token, None))
 def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
          status: Optional[Status] = None, token: Optional[Token] = None):
     """Receive into ``x``'s shape/dtype from the matching ``send``.
@@ -32,8 +35,6 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
     Returns ``(received, token)`` (ref API: recv.py:43-87).  Ranks outside
     the routing receive ``x`` back unchanged (MPI_PROC_NULL semantics).
     """
-    if not isinstance(tag, int):
-        raise TypeError(f"recv tag must be a static int, got {type(tag)}")
 
     def body(comm, arrays, token):
         (template,) = arrays
@@ -55,16 +56,21 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
                     f"recv: source spec implies routing {pairs_s} but the "
                     f"matching send declared {pending.pairs}"
                 )
-        if pending.value.shape != template.shape or pending.value.dtype != template.dtype:
+        if pending.value.dtype != template.dtype or (
+                pending.value.size != template.size):
             raise ValueError(
                 f"recv: template shape/dtype {template.shape}/{template.dtype} "
-                f"does not match sent {pending.value.shape}/{pending.value.dtype}"
+                f"does not match sent {pending.value.shape}/"
+                f"{pending.value.dtype} (shapes may differ only at equal "
+                "element count; the output is typed by the template, ref "
+                "recv.py:246)"
             )
         payload = as_varying(consume(token, pending.value), comm.axes)
         log_op("MPI_Recv", comm.Get_rank(),
                f"{payload.size} items along {list(pending.pairs)} (tag {tag})")
         res = _apply_permute(payload, template, pending.pairs, comm)
-        _fill_status(status, pending.pairs, comm, payload.size, payload.dtype)
+        _fill_status(status, pending.pairs, comm, payload.size,
+                     payload.dtype, tag)
         return res, produce(token, res)
 
     return dispatch("recv", comm, body, (x,), token)

@@ -15,10 +15,12 @@ import jax.numpy as jnp
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import Op, OpLike, apply_allreduce, dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(root=int, comm=(Comm, None), token=(Token, None))
 def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None,
            token: Optional[Token] = None):
     """Reduce ``x`` with ``op`` to rank ``root``; non-root ranks receive
@@ -26,8 +28,6 @@ def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None,
 
     Returns ``(result, token)`` (ref API: reduce.py:41-96).
     """
-    if not isinstance(root, int):
-        raise TypeError(f"reduce root must be a static int, got {type(root)}")
 
     def body(comm, arrays, token):
         (xl,) = arrays

@@ -19,10 +19,12 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import SUM, Op, OpLike, combine_fn, dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(comm=(Comm, None), token=(Token, None))
 def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
          token: Optional[Token] = None):
     """Inclusive prefix reduction: rank ``r`` gets ``x_0 op x_1 op … op x_r``.

@@ -15,10 +15,12 @@ from jax import lax
 
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import dispatch
 from .token import Token, consume, produce
 
 
+@enforce_types(root=int, comm=(Comm, None), token=(Token, None))
 def scatter(x, root: int, *, comm: Optional[Comm] = None,
             token: Optional[Token] = None):
     """Scatter ``x`` (shape ``(size, *s)``, contents significant on root
@@ -26,8 +28,6 @@ def scatter(x, root: int, *, comm: Optional[Comm] = None,
 
     Returns ``(result, token)`` (ref API: scatter.py:40-96).
     """
-    if not isinstance(root, int):
-        raise TypeError(f"scatter root must be a static int, got {type(root)}")
 
     def body(comm, arrays, token):
         (xl,) = arrays

@@ -24,6 +24,7 @@ from ..parallel.comm import Comm
 from ..parallel.rankspec import normalize_dest
 from ..parallel.region import current_context
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import dispatch
 from .token import Token, consume, produce
 
@@ -34,6 +35,7 @@ class PendingSend(NamedTuple):
     token: Optional[Token]
 
 
+@enforce_types(tag=int, comm=(Comm, None), token=(Token, None))
 def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
          token: Optional[Token] = None) -> Token:
     """Send ``x`` along routing ``dest`` (see parallel/rankspec.py).
@@ -41,8 +43,6 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
     Must be matched by a ``recv`` on the same comm and tag later in the same
     parallel region.  Returns a token (ref API: send.py:41-79).
     """
-    if not isinstance(tag, int):
-        raise TypeError(f"send tag must be a static int, got {type(tag)}")
 
     def body(comm, arrays, token):
         (xl,) = arrays

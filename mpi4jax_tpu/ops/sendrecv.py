@@ -26,8 +26,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..parallel.comm import Comm
-from ..parallel.rankspec import invert_pairs, normalize_dest, normalize_source
+from ..parallel.rankspec import normalize_dest, normalize_source
 from ..utils.debug import log_op
+from ..utils.validation import enforce_types
 from ._base import dispatch
 from .status import Status
 from .token import Token, consume, produce
@@ -51,6 +52,10 @@ def _resolve_pairs(source, dest, size, what):
 
 def _apply_permute(xl, recvbuf, pairs, comm):
     permuted = lax.ppermute(xl, comm.axis, list(pairs))
+    # the output is typed by the recv buffer (ref sendrecv.py:369-377
+    # abstract eval): a message with a matching element count but different
+    # shape — e.g. exchange-row-for-column — lands in recvbuf's shape
+    permuted = permuted.reshape(recvbuf.shape)
     receivers = sorted(d for _, d in pairs)
     if len(receivers) == comm.Get_size():
         return permuted
@@ -59,7 +64,7 @@ def _apply_permute(xl, recvbuf, pairs, comm):
     return jnp.where(is_recv, permuted, recvbuf)
 
 
-def _fill_status(status, pairs, comm, count, dtype):
+def _fill_status(status, pairs, comm, count, dtype, tag):
     if status is None:
         return
     rank = comm.Get_rank()
@@ -68,10 +73,15 @@ def _fill_status(status, pairs, comm, count, dtype):
     for s, d in pairs:
         src_table[d] = s
     status.source = jnp.asarray(src_table)[rank]
+    # the tag the matched message was sent with (ref recv.py:43-48 fills the
+    # full MPI.Status); matching is SPMD-uniform so this is static
+    status.tag = tag
     status.count = count
     status.dtype = dtype
 
 
+@enforce_types(sendtag=int, recvtag=int, comm=(Comm, None),
+               status=(Status, None), token=(Token, None))
 def sendrecv(
     sendbuf,
     recvbuf,
@@ -94,11 +104,18 @@ def sendrecv(
     here is positional within one traced program, so tags are not needed to
     disambiguate).
     """
-    if sendbuf.shape != recvbuf.shape or sendbuf.dtype != recvbuf.dtype:
+    if sendbuf.dtype != recvbuf.dtype:
         raise ValueError(
-            f"sendrecv requires matching send/recv buffer shapes and dtypes "
-            f"on a statically-scheduled interconnect; got {sendbuf.shape}/"
-            f"{sendbuf.dtype} vs {recvbuf.shape}/{recvbuf.dtype}"
+            f"sendrecv requires matching send/recv dtypes (MPI type-signature "
+            f"rule); got {sendbuf.dtype} vs {recvbuf.dtype}"
+        )
+    if sendbuf.shape != recvbuf.shape and sendbuf.size != recvbuf.size:
+        raise ValueError(
+            f"sendrecv: send/recv buffers may differ in shape only when their "
+            f"element counts match (the output is typed by recvbuf, ref "
+            f"sendrecv.py:369; under SPMD every rank's recv shape is the same "
+            f"static recvbuf shape, so mismatched counts cannot be routed); "
+            f"got {sendbuf.shape} vs {recvbuf.shape}. See docs/sharp_bits.md."
         )
 
     # Eager-path caching: resolve the routing spec to concrete pairs ONCE,
@@ -128,7 +145,7 @@ def sendrecv(
         log_op("MPI_Sendrecv", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)}")
         res = _apply_permute(xl, rbuf, pairs, comm)
-        _fill_status(status, pairs, comm, xl.size, xl.dtype)
+        _fill_status(status, pairs, comm, xl.size, xl.dtype, sendtag)
         return res, produce(token, res)
 
     return dispatch(

@@ -5,7 +5,9 @@ Analog of the reference's optional ``MPI.Status`` out-parameter on ``recv``/
 statically-routed interconnect everything a Status reports is known at trace
 time, so fields are filled from the routing spec: ``source`` is a traced
 per-rank value (-1 where the rank received nothing, the MPI_PROC_NULL
-analog), ``count``/``dtype`` are static.
+analog), ``tag``/``count``/``dtype`` are static (``tag`` is the tag the
+matched message was sent with — under SPMD matching it equals the receive
+tag, mirroring the MPI matching rule).
 """
 
 

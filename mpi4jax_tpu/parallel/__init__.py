@@ -13,7 +13,12 @@ from .mesh import (  # noqa: F401
     make_world_mesh,
     set_default_mesh,
 )
-from .rankspec import invert_pairs, normalize_dest, normalize_source, shift  # noqa: F401
+from .rankspec import (  # noqa: F401
+    invert_pairs,
+    normalize_dest,
+    normalize_source,
+    shift,
+)
 from .region import (  # noqa: F401
     current_context,
     get_default_comm,

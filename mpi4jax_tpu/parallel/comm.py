@@ -24,7 +24,7 @@ size statically and can run ops eagerly by auto-wrapping them in
 from __future__ import annotations
 
 import itertools
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
@@ -104,14 +104,15 @@ class Comm:
         """
         if self._mesh is not None:
             return int(np.prod([self._mesh.shape[a] for a in self._axes]))
-        try:
+        from ..utils.jax_compat import axis_bound
+
+        if all(axis_bound(a) for a in self._axes):
             return int(np.prod([lax.axis_size(a) for a in self._axes]))
-        except NameError:
-            raise RuntimeError(
-                f"Comm({self._axes}) is not bound to a mesh and axis sizes "
-                "are not available outside a shard_map trace. Bind the comm "
-                "(comm.bind(mesh)) or call inside a parallel region."
-            ) from None
+        raise RuntimeError(
+            f"Comm({self._axes}) is not bound to a mesh and axis sizes "
+            "are not available outside a shard_map trace. Bind the comm "
+            "(comm.bind(mesh)) or call inside a parallel region."
+        )
 
     def Get_rank(self):
         """Linear rank of the calling device (traced value, row-major).

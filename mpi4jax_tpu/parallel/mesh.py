@@ -17,8 +17,7 @@ link-bandwidth target in BASELINE.md).
 
 from __future__ import annotations
 
-import os
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
@@ -72,7 +71,8 @@ def make_world_mesh(
     if shape is None:
         shape = (n,)
     if axes is None:
-        axes = (DEFAULT_AXIS,) if len(shape) == 1 else tuple(f"ax{i}" for i in range(len(shape)))
+        axes = ((DEFAULT_AXIS,) if len(shape) == 1
+                else tuple(f"ax{i}" for i in range(len(shape))))
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh shape {tuple(shape)} does not cover {n} devices")
     # Auto axis types: global ops outside parallel regions behave classically;

@@ -60,7 +60,8 @@ RankSpecLike = Union[
 ]
 
 
-def normalize_dest(spec: RankSpecLike, size: int, *, what: str) -> Tuple[Tuple[int, int], ...]:
+def normalize_dest(spec: RankSpecLike, size: int, *,
+                   what: str) -> Tuple[Tuple[int, int], ...]:
     """Normalize a routing spec into a sorted tuple of (src, dst) pairs.
 
     Validates that the pairs form a partial permutation (no duplicate sources
@@ -112,7 +113,8 @@ def normalize_dest(spec: RankSpecLike, size: int, *, what: str) -> Tuple[Tuple[i
     return tuple(sorted(pairs))
 
 
-def normalize_source(spec: RankSpecLike, size: int, *, what: str) -> Tuple[Tuple[int, int], ...]:
+def normalize_source(spec: RankSpecLike, size: int, *,
+                     what: str) -> Tuple[Tuple[int, int], ...]:
     """Like ``normalize_dest`` but the spec is receiver-centric:
     ``spec(r) = source of rank r``.  Returns (src, dst) pairs."""
     if isinstance(spec, shift):
@@ -120,7 +122,8 @@ def normalize_source(spec: RankSpecLike, size: int, *, what: str) -> Tuple[Tuple
         inv = spec.inverse()
         return normalize_dest(inv, size, what=what)
     if isinstance(spec, dict):
-        return normalize_dest({int(s): int(r) for r, s in spec.items()}, size, what=what)
+        return normalize_dest(
+            {int(s): int(r) for r, s in spec.items()}, size, what=what)
     if spec is None or isinstance(spec, int):
         return normalize_dest(spec, size, what=what)  # raises with guidance
     if callable(spec):

@@ -255,11 +255,6 @@ def run(f, *args, comm: Optional[Comm] = None, **spmd_kwargs):
 def in_parallel_region(comm: Comm) -> bool:
     """True if the comm's axes are bound in the current trace (i.e. we are
     inside a shard_map body over those axes)."""
-    from jax import lax
+    from ..utils.jax_compat import axis_bound
 
-    try:
-        for a in comm.axes:
-            lax.axis_size(a)
-        return True
-    except NameError:
-        return False
+    return all(axis_bound(a) for a in comm.axes)

@@ -14,12 +14,41 @@ floor (``shard_map``/VMA typing requirements).
 The rest of the reference module — ``custom_call`` shims, ``ShapedArray``
 import paths, effect allow-list registration (ref jax_compat.py:51-120) —
 has no analog here: there are no custom calls and no manually-registered
-effects.
+effects.  ``axis_bound`` is this framework's one internals shim: the
+"am I inside a shard_map over this axis?" probe, with a public-behavior
+fallback, pinned by tests/test_comm_infra.py.
 """
 
 import warnings
 
 from .config import parse_env_bool
+
+
+def axis_bound(axis_name: str) -> bool:
+    """True iff ``axis_name`` is bound in the current trace's axis
+    environment (i.e. we are inside a ``shard_map``/``pmap`` body over it).
+
+    Primary probe: ``jax._src.core.get_axis_env().axis_exists`` — explicit,
+    but a private module path.  Fallback if that moves in a future JAX:
+    call ``lax.axis_size`` and catch the documented unbound-axis ``NameError``
+    ("unbound axis name: ...").  Both behaviors are pinned by
+    tests/test_comm_infra.py::test_axis_bound_probe so a JAX upgrade that
+    changes either fails loudly instead of silently rerouting every
+    in-region op through the eager path.
+    """
+    try:
+        from jax._src.core import get_axis_env
+
+        return bool(get_axis_env().axis_exists(axis_name))
+    except (ImportError, AttributeError):
+        pass
+    from jax import lax
+
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
 
 # oldest JAX with the shard_map/VMA semantics the ops rely on
 MIN_JAX_VERSION = "0.6.0"

@@ -10,7 +10,6 @@ including through jit and double transposition (SURVEY.md §2.6(3)).
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import mpi4jax_tpu as mpx
 from helpers import world

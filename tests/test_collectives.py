@@ -5,7 +5,6 @@ bcast, gather, scatter, reduce, scan, barrier) — eager + jit variants, shape
 contracts, and the rank-dependent-result contracts where preserved.
 """
 
-import time
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +46,8 @@ def test_alltoall():
         return res
 
     # rank r sends value r*size+i to rank i
-    x = per_rank(lambda r: np.arange(r * size, (r + 1) * size, dtype=np.float32)[:, None])
+    x = per_rank(
+        lambda r: np.arange(r * size, (r + 1) * size, dtype=np.float32)[:, None])
     out = np.asarray(f(x))  # (size, size, 1)
     for r in range(size):
         # rank r receives from rank i: i*size + r

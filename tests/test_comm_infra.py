@@ -12,7 +12,7 @@ import pytest
 
 import mpi4jax_tpu as mpx
 from mpi4jax_tpu.utils.config import parse_env_bool
-from helpers import per_rank, ranks_arange, world
+from helpers import ranks_arange, world
 
 
 def test_comm_size_rank():
@@ -238,3 +238,37 @@ def test_wallclock_fallback_without_native_lib(monkeypatch):
 
     a, b = jax.jit(elapsed)()
     assert float(b) >= float(a)
+
+
+def test_axis_bound_probe():
+    # Pins the two behaviors in_parallel_region relies on (a JAX upgrade
+    # that changes either must fail HERE, not silently reroute every
+    # in-region op through the eager path):
+    # 1. the private axis-env probe agrees with reality in and out of
+    #    shard_map;
+    # 2. the fallback contract — lax.axis_size raises NameError (not some
+    #    other exception) for an unbound axis.
+    from jax import lax
+
+    from mpi4jax_tpu.utils.jax_compat import axis_bound
+
+    comm, _ = world()
+    axis = comm.axes[0]
+
+    assert not axis_bound(axis)
+    assert not mpx.parallel.region.in_parallel_region(comm)
+
+    with pytest.raises(NameError, match="unbound axis"):
+        lax.axis_size("definitely-not-an-axis")
+
+    seen = {}
+
+    @mpx.spmd
+    def f(x):
+        seen["inside"] = axis_bound(axis)
+        seen["region"] = mpx.parallel.region.in_parallel_region(comm)
+        return x
+
+    f(ranks_arange((1,)))
+    assert seen["inside"] is True
+    assert seen["region"] is True

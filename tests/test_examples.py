@@ -119,9 +119,20 @@ def test_fast_step_matches_reference_step(grid, periodic):
     s0 = initial_state(cfg)
     ref = multi_ref(first_ref(s0), 20)
     fast = multi_fast(first_fast(s0), 20)
+    # On a (1,1) grid there are no subdomain seams, so the freshness
+    # artifact is absent and the remaining divergence is pure
+    # reordered-arithmetic rounding, bounded by f32 ulps at the stencil's
+    # *intermediate* scale (g·h ≈ 1e3 → ~5e-5 absolute; measured flat from
+    # step 1 to 20, i.e. non-accumulating).  Assert a 5×-tighter constant
+    # term than the seam band so a small-field regression cannot hide
+    # under the loose bound.
+    single_rank = grid == (1, 1)
     for name, a, b in zip(ref._fields, ref, fast):
         a, b = np.asarray(a), np.asarray(b)
-        bound = 1e-4 + 1e-5 * np.abs(a).max()
+        if single_rank:
+            bound = 2e-5 + 1e-5 * np.abs(a).max()
+        else:
+            bound = 1e-4 + 1e-5 * np.abs(a).max()
         assert np.abs(a - b).max() <= bound, (
             f"field {name} diverged beyond the freshness band "
             f"(grid={grid}, periodic={periodic}): "

@@ -10,7 +10,6 @@ asserted through the tokenless wrappers.
 """
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

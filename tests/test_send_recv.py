@@ -295,6 +295,107 @@ def test_status():
     assert np.allclose(np.asarray(src), np.roll(np.arange(size), 1))
 
 
+def test_status_tag_count_dtype():
+    # the full Status is filled (ref recv.py:43-48, :99-107): tag is the tag
+    # the matched message was sent with, count/dtype describe the payload
+    statuses = {}
+
+    @mpx.spmd
+    def f(x):
+        s_sr = mpx.Status()
+        y, t = mpx.sendrecv(x, x, dest=mpx.shift(1), sendtag=5, recvtag=5,
+                            status=s_sr)
+        s_rv = mpx.Status()
+        t = mpx.send(y, dest=mpx.shift(1), tag=3, token=t)
+        z, _ = mpx.recv(y, tag=3, status=s_rv, token=t)
+        statuses["sr"] = s_sr
+        statuses["rv"] = s_rv
+        return z
+
+    f(per_rank(lambda r: jnp.full((4,), float(r))))
+    assert statuses["sr"].Get_tag() == 5
+    assert statuses["sr"].Get_count() == 4
+    assert statuses["sr"].dtype == jnp.float32
+    assert statuses["rv"].Get_tag() == 3
+    assert statuses["rv"].Get_count() == 4
+
+
+def test_sendrecv_mismatched_shapes_row_for_column():
+    # exchange-row-for-column: send a (1, n) row, receive into an (n, 1)
+    # column — the output is typed by recvbuf (ref sendrecv.py:369-377)
+    _, size = world()
+    n = 3
+
+    @mpx.spmd
+    def f(x):
+        row = x.reshape(1, n)
+        col_template = jnp.zeros((n, 1), x.dtype)
+        y, _ = mpx.sendrecv(row, col_template, dest=mpx.shift(1))
+        return y
+
+    x = per_rank(lambda r: jnp.arange(float(r), float(r) + n))
+    out = np.asarray(f(x))
+    assert out.shape == (size, n, 1)
+    for r in range(size):
+        src = (r - 1) % size
+        assert np.allclose(out[r, :, 0], np.arange(src, src + n))
+
+
+def test_sendrecv_mismatched_shapes_proc_null_edge():
+    # ranks outside the routing keep the recv template, in the recv shape
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        template = jnp.full((2, 2), -1.0)
+        y, _ = mpx.sendrecv(x, template, dest=mpx.shift(1, wrap=False))
+        return y
+
+    out = np.asarray(f(per_rank(lambda r: jnp.full((4,), float(r)))))
+    assert out.shape == (size, 2, 2)
+    assert np.all(out[0] == -1.0)
+    for r in range(1, size):
+        assert np.all(out[r] == r - 1)
+
+
+def test_sendrecv_mismatched_count_raises():
+    with pytest.raises(ValueError, match="element counts match"):
+        @mpx.spmd
+        def f(x):
+            y, _ = mpx.sendrecv(x, jnp.zeros((5,)), dest=mpx.shift(1))
+            return y
+
+        f(per_rank(lambda r: jnp.full((4,), float(r))))
+
+
+def test_sendrecv_mismatched_dtype_raises():
+    with pytest.raises(ValueError, match="dtypes"):
+        @mpx.spmd
+        def f(x):
+            y, _ = mpx.sendrecv(x, jnp.zeros((4,), jnp.int32),
+                                dest=mpx.shift(1))
+            return y
+
+        f(per_rank(lambda r: jnp.full((4,), float(r))))
+
+
+def test_recv_mismatched_shape_same_count():
+    # recv types its output by the template (ref recv.py:246): a sent (1, n)
+    # row lands in an (n,) template
+    _, size = world()
+    n = 4
+
+    @mpx.spmd
+    def f(x):
+        t = mpx.send(x.reshape(1, n), dest=mpx.shift(1))
+        y, _ = mpx.recv(jnp.zeros((n,), x.dtype), token=t)
+        return y
+
+    out = np.asarray(f(per_rank(lambda r: jnp.full((n,), float(r)))))
+    assert out.shape == (size, n)
+    assert np.allclose(out[:, 0], np.roll(np.arange(size), 1))
+
+
 def test_bare_int_dest_guidance():
     with pytest.raises(TypeError, match="ambiguous"):
         @mpx.spmd

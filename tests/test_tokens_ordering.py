@@ -10,7 +10,6 @@ program); these tests pin that behavior.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import mpi4jax_tpu as mpx
 from helpers import ranks_arange, world
