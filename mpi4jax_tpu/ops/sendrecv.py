@@ -104,6 +104,14 @@ def sendrecv(
     here is positional within one traced program, so tags are not needed to
     disambiguate).
     """
+    if sendtag != recvtag:
+        raise ValueError(
+            f"sendrecv: sendtag ({sendtag}) != recvtag ({recvtag}). Under "
+            "SPMD every rank runs the same program, so the incoming message "
+            "always carries sendtag — a differing recvtag can never match "
+            "and would deadlock in MPI; this framework raises at trace time "
+            "instead (same policy as unmatched sends)."
+        )
     if sendbuf.dtype != recvbuf.dtype:
         raise ValueError(
             f"sendrecv requires matching send/recv dtypes (MPI type-signature "

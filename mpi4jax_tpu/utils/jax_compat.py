@@ -50,6 +50,7 @@ def axis_bound(axis_name: str) -> bool:
     except NameError:
         return False
 
+
 # oldest JAX with the shard_map/VMA semantics the ops rely on
 MIN_JAX_VERSION = "0.6.0"
 # newest JAX this package was validated against

@@ -1,0 +1,60 @@
+"""Exit-path regression: in-flight collectives must not wedge shutdown.
+
+Port of ref tests/collective_ops/test_common.py:91-115
+(test_deadlock_on_exit): the reference registers an atexit
+``jax.effects_barrier()`` flush so pending async MPI ops complete before
+MPI_Finalize.  Here the analog hazard is JAX async dispatch holding
+in-flight collectives at interpreter teardown; mpi4jax_tpu registers the
+same flush (mpi4jax_tpu/__init__.py + utils/flush.py).  The subprocess
+issues a chain of collectives and exits WITHOUT any explicit sync; a clean
+zero exit within the timeout is the assertion.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+
+def test_clean_exit_with_inflight_collectives():
+    script = textwrap.dedent("""
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import mpi4jax_tpu as mpx
+
+        import atexit
+        from mpi4jax_tpu.utils.flush import flush
+        # the package must have registered the flush handler at import
+        # (ref _src/__init__.py:13-17); atexit offers no public introspection,
+        # so re-registering and checking idempotence is not possible — instead
+        # assert the symbol exists and rely on the in-flight exit below.
+        assert callable(flush)
+
+        @mpx.spmd
+        def chained(x):
+            t = None
+            for _ in range(25):
+                x, t = mpx.sendrecv(x, x, dest=mpx.shift(1), token=t)
+                x, t = mpx.allreduce(x * (1.0 / 8.0), op=mpx.SUM, token=t)
+                x = mpx.varying(x)
+            return x
+
+        # launch and DO NOT sync — exit with the work still in flight
+        chained(jnp.ones((8, 256)))
+        print("DISPATCHED", flush=True)
+    """)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "DISPATCHED" in proc.stdout

@@ -1,0 +1,43 @@
+"""Smoke test for benchmarks/micro.py — it must keep producing numbers.
+
+VERDICT r2: micro.py had never been executed by CI, so it could silently
+rot.  Run both sweeps at tiny sizes on the test mesh and check the output
+schema matches what benchmarks/results/*.json commits.
+"""
+
+import os
+import sys
+
+import jax
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "benchmarks")
+)
+
+import micro  # noqa: E402
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+
+def _world_comm():
+    mesh = mpx.make_world_mesh(devices=jax.devices())
+    return mpx.Comm(mesh.axis_names[0], mesh=mesh)
+
+
+def test_bench_allreduce_schema():
+    comm = _world_comm()
+    rows = micro.bench_allreduce(comm, sizes_mb=[0.0001], iters=2)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["time_us"] > 0
+    # tiny payloads round the bandwidth to 0.0 — only presence is asserted
+    assert (r["bus_gb_s"] is None) == (comm.Get_size() == 1)
+
+
+def test_bench_sendrecv_schema():
+    comm = _world_comm()
+    rows = micro.bench_sendrecv_ring(comm, sizes_kb=[0.004], iters=2)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["hop_us"] > 0
+    assert (r["link_gb_s"] is None) == (comm.Get_size() == 1)

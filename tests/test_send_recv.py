@@ -320,6 +320,16 @@ def test_status_tag_count_dtype():
     assert statuses["rv"].Get_count() == 4
 
 
+def test_sendrecv_mismatched_tags_raise():
+    # under SPMD the incoming message always carries sendtag, so a
+    # differing recvtag could never match (MPI would deadlock); trace-time
+    # error, same policy as unmatched sends
+    world()
+    x = ranks_arange((1,))
+    with pytest.raises(ValueError, match="sendtag.*recvtag"):
+        mpx.sendrecv(x, x, dest=mpx.shift(1), sendtag=5, recvtag=7)
+
+
 def test_sendrecv_mismatched_shapes_row_for_column():
     # exchange-row-for-column: send a (1, n) row, receive into an (n, 1)
     # column — the output is typed by recvbuf (ref sendrecv.py:369-377)
