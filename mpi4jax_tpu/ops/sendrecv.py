@@ -100,18 +100,16 @@ def sendrecv(
     ``dest`` maps sender→receiver (e.g. ``shift(1)``); ``source`` is the
     receiver-centric view.  Give either (the other is inferred) or both
     (validated for consistency).  Returns ``(received, token)``
-    (ref API: sendrecv.py:46-128; tags are accepted for API parity — matching
-    here is positional within one traced program, so tags are not needed to
-    disambiguate).
+    (ref API: sendrecv.py:46-128).
+
+    Tags are accepted for API parity but are *inert* for matching: a
+    ``sendrecv`` is self-contained (one fused CollectivePermute), so the
+    incoming message always comes from this same call and always carries
+    ``sendtag``.  Ported MPI idioms with differing send/recv tags (e.g.
+    swapped-tag bidirectional exchanges) therefore route correctly;
+    ``Status.tag`` reports ``sendtag`` — the tag the message was actually
+    sent with.
     """
-    if sendtag != recvtag:
-        raise ValueError(
-            f"sendrecv: sendtag ({sendtag}) != recvtag ({recvtag}). Under "
-            "SPMD every rank runs the same program, so the incoming message "
-            "always carries sendtag — a differing recvtag can never match "
-            "and would deadlock in MPI; this framework raises at trace time "
-            "instead (same policy as unmatched sends)."
-        )
     if sendbuf.dtype != recvbuf.dtype:
         raise ValueError(
             f"sendrecv requires matching send/recv dtypes (MPI type-signature "

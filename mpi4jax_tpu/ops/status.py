@@ -6,8 +6,9 @@ statically-routed interconnect everything a Status reports is known at trace
 time, so fields are filled from the routing spec: ``source`` is a traced
 per-rank value (-1 where the rank received nothing, the MPI_PROC_NULL
 analog), ``tag``/``count``/``dtype`` are static (``tag`` is the tag the
-matched message was sent with — under SPMD matching it equals the receive
-tag, mirroring the MPI matching rule).
+matched message was *sent* with: the matched send's tag for ``recv``,
+``sendtag`` for ``sendrecv`` — whose matching is internal to the call, so
+its ``recvtag`` never participates).
 """
 
 

@@ -140,15 +140,23 @@ def test_fast_step_matches_reference_step(grid, periodic):
         )
 
 
-@pytest.mark.parametrize(
-    "ny,nx",
-    [
-        (24, 48),   # ny_local=26: single partial 32-row block
-        (30, 48),   # ny_local=32: exactly one full block
-        (62, 48),   # ny_local=64: two full blocks
-        (78, 40),   # ny_local=80: full blocks + partial, nx_local=42
-    ],
-)
+def _pallas_grid_cases():
+    """Grid sizes derived from the kernel's block size so coverage tracks
+    _PBLK: a partial single block, exactly one full block, exactly two
+    full blocks, and full blocks + a partial trailing block — the last
+    two exercise the multi-block prev/next margin index maps and their
+    clip-at-edge handling, the path the benchmark config (15 blocks) runs."""
+    from shallow_water import _PBLK
+
+    return [
+        (_PBLK - 8, 48),        # single partial block
+        (_PBLK - 2, 48),        # exactly one full block (ny_local == _PBLK)
+        (2 * _PBLK - 2, 48),    # exactly two full blocks
+        (2 * _PBLK + 14, 40),   # two full + partial trailing, nx_local=42
+    ]
+
+
+@pytest.mark.parametrize("ny,nx", _pallas_grid_cases())
 def test_pallas_step_matches_fast_step(ny, nx):
     """The fused whole-step Pallas kernel (interpret mode on CPU) must
     reproduce model_step_fast on the single-rank periodic-x configs it is

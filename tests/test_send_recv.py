@@ -320,14 +320,27 @@ def test_status_tag_count_dtype():
     assert statuses["rv"].Get_count() == 4
 
 
-def test_sendrecv_mismatched_tags_raise():
-    # under SPMD the incoming message always carries sendtag, so a
-    # differing recvtag could never match (MPI would deadlock); trace-time
-    # error, same policy as unmatched sends
-    world()
-    x = ranks_arange((1,))
-    with pytest.raises(ValueError, match="sendtag.*recvtag"):
-        mpx.sendrecv(x, x, dest=mpx.shift(1), sendtag=5, recvtag=7)
+def test_sendrecv_tags_inert_for_matching():
+    # sendrecv matching is internal to the call, so differing tags (the
+    # swapped-tag bidirectional-exchange idiom from ported MPI code) still
+    # route correctly; Status.tag reports the tag the message was SENT with
+    _, size = world()
+    statuses = {}
+
+    @mpx.spmd
+    def f(x):
+        s = mpx.Status()
+        right, t = mpx.sendrecv(x, x, dest=mpx.shift(1),
+                                sendtag=1, recvtag=2, status=s)
+        left, _ = mpx.sendrecv(x, x, dest=mpx.shift(-1),
+                               sendtag=2, recvtag=1, token=t)
+        statuses["s"] = s
+        return right, left
+
+    right, left = f(ranks_arange((1,)))
+    assert np.allclose(np.asarray(right)[:, 0], np.roll(np.arange(size), 1))
+    assert np.allclose(np.asarray(left)[:, 0], np.roll(np.arange(size), -1))
+    assert statuses["s"].Get_tag() == 1  # sendtag: what the message carried
 
 
 def test_sendrecv_mismatched_shapes_row_for_column():
