@@ -786,7 +786,8 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
         # 128 MB VMEM — raising _PBLK further requires shrinking the
         # working set first); Mosaic's default scoped limit is 16 MB
         compiler_params = pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel",),
         )
     outs = pl.pallas_call(
         lambda *refs: _sw_step_kernel(cfg, first_step, ny, refs),
