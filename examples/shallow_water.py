@@ -543,56 +543,38 @@ def model_step_fast(state: State, cfg: Config, comm: mpx.Comm,
 # ---------------------------------------------------------------------------
 
 _PBLK = 128  # output rows per grid step (multiple of 8: f32 sublane tile)
-_PMRG = 8  # margin rows each side (recompute chain needs 3; 8 = tile size)
+# margin rows each side are 8 * nsteps (one sublane tile per fused step;
+# the per-step recompute chain depth, with viscosity, is ~5 rows)
 
 
-def _sw_step_kernel(cfg: Config, first_step: bool, n_rows: int, refs):
-    """Whole-step kernel body: the entire model_step_fast computation on a
-    ``(_PBLK + 2 * _PMRG, nx_local)`` row window, margins recomputed so no
-    intermediate field ever round-trips through HBM.
+def _step_window(cfg: Config, first_step: bool, n_rows: int, iy, ix, fields):
+    """One model step on a ``(nr, nx)`` row window, entirely in registers/
+    VMEM.  ``iy``/``ix`` are the window cells' *global* row/column indices
+    (margins included); ``fields`` is the ``(h, u, v, dh, du, dv)`` window
+    tuple.  Returns the stepped window tuple — margin rows within the
+    recompute chain depth (~5) of the window edge are garbage, which the
+    caller's stored-slice/masks keep out.
 
     Valid only for the single-rank, periodic-x decomposition: x stencil
-    reads use true periodic lane rolls, and the mid-step halo refresh of
-    the integrated ``u``/``v`` (needed by the viscous fluxes) becomes an
-    in-register periodic column fix.  Wall/edge semantics are identical to
-    ``model_step_fast``'s iota masks, evaluated on global row indices.
-
-    ``refs`` is 18 input refs (6 fields x [prev-margin, main, next-margin]
-    blocks, field order h,u,v,dh,du,dv) followed by the 6 output refs; the
-    unpacking below is positional by that structure.
+    reads use true periodic lane rolls, and every halo refresh (mid-step
+    and end-of-step) becomes an in-register periodic column fix.
+    Wall/edge semantics are identical to ``model_step_fast``'s iota masks,
+    evaluated on the global indices.
     """
     from jax.experimental.pallas import tpu as pltpu
-    import jax.experimental.pallas as pl
 
-    ins, outs = refs[:18], refs[18:]
-    h_o, u_o, v_o, dho_o, duo_o, dvo_o = outs
-
-    nx = cfg.nx_local
-    nr = _PBLK + 2 * _PMRG
+    h, u, v, dh, du, dv = fields
+    nr, nx = h.shape
     dx, dy, g, dt = cfg.dx, cfg.dy, cfg.gravity, cfg.dt
-
-    def assemble(p, m, n):
-        return jnp.concatenate([p[:], m[:], n[:]], axis=0)
-
-    h, u, v, dh, du, dv = (
-        assemble(*ins[3 * k : 3 * k + 3]) for k in range(6)
-    )
 
     # periodic lane shifts; sublane shifts wrap inside the window (the
     # wrapped rows are margin garbage that the masks keep out of the
-    # stored rows — chain depth 3 < _PMRG)
+    # stored rows)
     rm1x = lambda a: pltpu.roll(a, nx - 1, 1)  # noqa: E731  a[j, i+1]
     rp1x = lambda a: pltpu.roll(a, 1, 1)  # noqa: E731      a[j, i-1]
     rm1y = lambda a: pltpu.roll(a, nr - 1, 0)  # noqa: E731  a[j+1, i]
     rp1y = lambda a: pltpu.roll(a, 1, 0)  # noqa: E731       a[j-1, i]
 
-    pid = pl.program_id(0)
-    iy = (
-        jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 0)
-        + pid * _PBLK
-        - _PMRG
-    )
-    ix = jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 1)
     kept = (iy == 0) | (iy == n_rows - 1)  # single rank: both walls
     interior = (iy > 0) & (iy < n_rows - 1) & (ix > 0) & (ix < nx - 1)
     wall_v = kept | (iy == n_rows - 2)  # kind-"v" no-flux row
@@ -683,32 +665,73 @@ def _sw_step_kernel(cfg: Config, first_step: bool, n_rows: int, refs):
     u1 = pc_fix(u1)
     v1 = pc_fix(v1)
 
-    sl = slice(_PMRG, _PMRG + _PBLK)
-    h_o[:] = h1[sl]
-    u_o[:] = u1[sl]
-    v_o[:] = v1[sl]
-    dho_o[:] = dh_new[sl]
-    duo_o[:] = du_new[sl]
-    dvo_o[:] = dv_new[sl]
+    return h1, u1, v1, dh_new, du_new, dv_new
+
+
+def _sw_steps_kernel(cfg: Config, first_step: bool, n_rows: int, mrg: int,
+                     nsteps: int, refs):
+    """Kernel body: ``nsteps`` whole model steps on a
+    ``(_PBLK + 2 * mrg, nx_local)`` row window, margins recomputed so no
+    intermediate field — nor, for ``nsteps > 1``, the intermediate *state* —
+    ever round-trips through HBM.  Each step consumes ~5 margin rows of
+    validity (recompute chain depth), so ``mrg`` must be at least
+    ``8 * nsteps`` (one sublane tile per step is ample).
+
+    ``refs`` is 18 input refs (6 fields x [prev-margin, main, next-margin]
+    blocks, field order h,u,v,dh,du,dv) followed by the 6 output refs; the
+    unpacking below is positional by that structure.
+    """
+    import jax.experimental.pallas as pl
+
+    ins, outs = refs[:18], refs[18:]
+    nx = cfg.nx_local
+    nr = _PBLK + 2 * mrg
+
+    def assemble(p, m, n):
+        return jnp.concatenate([p[:], m[:], n[:]], axis=0)
+
+    fields = tuple(
+        assemble(*ins[3 * k : 3 * k + 3]) for k in range(6)
+    )
+
+    pid = pl.program_id(0)
+    iy = (
+        jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 0)
+        + pid * _PBLK
+        - mrg
+    )
+    ix = jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 1)
+
+    first = first_step
+    for _ in range(nsteps):
+        fields = _step_window(cfg, first, n_rows, iy, ix, fields)
+        first = False
+
+    sl = slice(mrg, mrg + _PBLK)
+    for o, f in zip(outs, fields):
+        o[:] = f[sl]
 
 
 def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
-                      first_step: bool, interpret=None) -> State:
-    """``model_step_fast`` as ONE fused Pallas kernel — including the
-    end-of-step halo refresh, which on this path reduces to the in-register
-    periodic column fix (see ``_sw_step_kernel``), so there are no
-    exchanges at all.
+                      first_step: bool, interpret=None,
+                      nsteps: int = 1) -> State:
+    """``nsteps`` applications of ``model_step_fast`` as ONE fused Pallas
+    kernel — including every halo refresh, which on this path reduces to
+    the in-register periodic column fix (see ``_step_window``), so there
+    are no exchanges at all.
 
-    Every intermediate (hc, fe, fn, q, ke, viscous fluxes) lives in VMEM
-    only: per step the state is read and written once (plus a ``_PMRG``-row
-    margin per ``_PBLK``-row block), instead of materializing ~10
-    intermediate full fields through HBM.  Single-rank periodic-x
-    decompositions only
-    (the benchmark configuration); multi-rank meshes use
-    ``model_step_fast``, whose exchange structure this kernel reproduces
-    in-register (see ``_sw_step_kernel``).  Equality with the jnp step is
-    pinned by tests/test_examples.py::test_pallas_step_matches_fast_step
-    (interpret mode on CPU, compiled on TPU).
+    Every intermediate (hc, fe, fn, q, ke, viscous fluxes) — and, for
+    ``nsteps=2``, the mid-pair state itself — lives in VMEM only: per
+    kernel call the state is read and written once (plus an
+    ``8 * nsteps``-row margin per ``_PBLK``-row block), instead of
+    materializing ~10 intermediate full fields through HBM per step.
+    Single-rank periodic-x decompositions only (the benchmark
+    configuration); multi-rank meshes use ``model_step_fast``, whose
+    exchange structure this kernel reproduces in-register.  Equality with
+    the jnp step is pinned by
+    tests/test_examples.py::test_pallas_step_matches_fast_step and
+    ::test_pallas_pair_step_matches_fast_steps (interpret mode on CPU,
+    compiled on TPU).
 
     ``interpret=None`` resolves at trace time to "the comm's mesh is not
     on TPU devices", so the same call sites run the Mosaic-compiled kernel
@@ -718,6 +741,8 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     assert cfg.nproc == 1 and cfg.periodic_x, (
         "model_step_pallas: single-rank periodic-x only; use model_step_fast"
     )
+    assert nsteps in (1, 2)
+    mrg = 8 * nsteps  # one sublane tile of validity per fused step
     import jax.experimental.pallas as pl
 
     if interpret is None:
@@ -750,20 +775,20 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     h, u, v, dh, du, dv = fields
 
     grid = ((ny + _PBLK - 1) // _PBLK,)
-    n_hblocks = (ny + _PMRG - 1) // _PMRG  # 8-row halo block count
-    r = _PBLK // _PMRG
+    n_hblocks = (ny + mrg - 1) // mrg  # mrg-row halo block count
+    r = _PBLK // mrg
 
     def main_spec():
         return pl.BlockSpec((_PBLK, nx), lambda i: (i, 0))
 
     def prev_spec():
         return pl.BlockSpec(
-            (_PMRG, nx), lambda i: (jnp.clip(i * r - 1, 0, n_hblocks - 1), 0)
+            (mrg, nx), lambda i: (jnp.clip(i * r - 1, 0, n_hblocks - 1), 0)
         )
 
     def next_spec():
         return pl.BlockSpec(
-            (_PMRG, nx), lambda i: (jnp.clip(i * r + r, 0, n_hblocks - 1), 0)
+            (mrg, nx), lambda i: (jnp.clip(i * r + r, 0, n_hblocks - 1), 0)
         )
 
     in_specs = []
@@ -790,7 +815,7 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
             dimension_semantics=("parallel",),
         )
     outs = pl.pallas_call(
-        lambda *refs: _sw_step_kernel(cfg, first_step, ny, refs),
+        lambda *refs: _sw_steps_kernel(cfg, first_step, ny, mrg, nsteps, refs),
         grid=grid,
         in_specs=in_specs,
         out_specs=[main_spec() for _ in range(6)],
@@ -808,6 +833,18 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     return State(h1, u1, v1, dh_new, du_new, dv_new)
 
 
+def model_step2_pallas(state: State, cfg: Config, comm: mpx.Comm,
+                       first_step: bool, interpret=None) -> State:
+    """TWO model steps in one Pallas kernel call (``model_step_pallas``
+    with ``nsteps=2``): halves the per-step HBM traffic and the grid
+    dispatch count.  Measured effect on this chip is small (~900 steps/s
+    either way — the kernel is VPU-compute-bound, see
+    docs/shallow_water.md), but the pair costs nothing and is the shipped
+    ``"auto"`` path."""
+    return model_step_pallas(state, cfg, comm, first_step,
+                             interpret=interpret, nsteps=2)
+
+
 def select_step(fast, cfg: Config = None):
     """The model-step implementation behind ``fast``: the single source of
     truth for every driver (make_stepper, solve_fused, bench.py).
@@ -816,21 +853,36 @@ def select_step(fast, cfg: Config = None):
 
     - ``False`` — the reference-structured step (parity oracle);
     - ``True`` — ``model_step_fast`` (works on any mesh);
-    - ``"pallas"`` — the fused whole-step Pallas kernel
-      (single-rank periodic-x only; asserts otherwise);
-    - ``"auto"`` — ``"pallas"`` when ``cfg`` is a single-rank periodic-x
+    - ``"pallas"`` / ``"pallas2"`` — the fused whole-step Pallas kernel
+      (single-rank periodic-x only; asserts otherwise); ``"pallas2"``
+      additionally fuses step *pairs* (see ``select_steps``);
+    - ``"auto"`` — ``"pallas2"`` when ``cfg`` is a single-rank periodic-x
       decomposition (the benchmark configuration), else ``True``.
+
+    Returns the SINGLE-step callable; drivers that can batch steps in
+    pairs use ``select_steps`` to also obtain the pair kernel.
     """
+    return select_steps(fast, cfg)[0]
+
+
+def select_steps(fast, cfg: Config = None):
+    """``(single_step, pair_step_or_None)`` behind ``fast`` (see
+    ``select_step`` for the mode table).  ``pair_step`` advances two model
+    steps per call and is only offered for the Pallas pair mode; callers
+    use it for even runs of steps and fall back to ``single_step`` for
+    the first (Euler) step and odd remainders."""
     if fast == "auto":
         if cfg is None:
             raise ValueError(
                 "select_step('auto') needs the Config to decide kernel "
                 "eligibility — pass cfg"
             )
-        fast = "pallas" if cfg.nproc == 1 and cfg.periodic_x else True
+        fast = "pallas2" if cfg.nproc == 1 and cfg.periodic_x else True
+    if fast == "pallas2":
+        return model_step_pallas, model_step2_pallas
     if fast == "pallas":
-        return model_step_pallas
-    return model_step_fast if fast else model_step
+        return model_step_pallas, None
+    return (model_step_fast if fast else model_step), None
 
 
 def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
@@ -840,10 +892,13 @@ def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
 
     ``fast`` selects the TPU-restructured step (``model_step_fast``,
     default); ``fast=False`` keeps the reference-structured step;
-    ``"pallas"``/``"auto"`` select the fused whole-step kernel (see
-    ``select_step``) — all verified equal in tests/test_examples.py.
+    ``"pallas"``/``"pallas2"``/``"auto"`` select the fused whole-step
+    kernel (see ``select_steps``) — all verified equal in
+    tests/test_examples.py.  ``multistep`` advances exactly ``num_steps``
+    steps in every mode (the pair kernel handles even runs; an odd
+    remainder falls back to one single-step call).
     """
-    step = select_step(fast, cfg)
+    step, pair = select_steps(fast, cfg)
 
     @partial(mpx.spmd, comm=comm)
     def first_step(state: State) -> State:
@@ -851,11 +906,27 @@ def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
 
     @partial(mpx.spmd, comm=comm, static_argnums=(1,))
     def multistep(state: State, num_steps: int) -> State:
-        return jax.lax.fori_loop(
-            0, num_steps, lambda _, s: step(s, cfg, comm, False), state
-        )
+        state = _run_steps(state, num_steps, cfg, comm, step, pair)
+        return state
 
     return first_step, multistep
+
+
+def _run_steps(state: State, num_steps: int, cfg, comm, step, pair) -> State:
+    """Advance ``num_steps`` non-first steps, using the pair kernel for
+    even runs when available (``num_steps`` is static)."""
+    if pair is not None:
+        npairs, rem = divmod(num_steps, 2)
+        if npairs:  # fori_loop(0, 0) would still trace the pair kernel
+            state = jax.lax.fori_loop(
+                0, npairs, lambda _, s: pair(s, cfg, comm, False), state
+            )
+        if rem:
+            state = step(state, cfg, comm, False)
+        return state
+    return jax.lax.fori_loop(
+        0, num_steps, lambda _, s: step(s, cfg, comm, False), state
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -923,14 +994,12 @@ def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
     mesh, comm = make_mesh_and_comm(cfg, devices=devices)
     n_iters = max(0, math.ceil((t1 - cfg.dt) / (cfg.dt * num_multisteps)))
     n_steps = 1 + n_iters * num_multisteps
-    step = select_step(fast, cfg)
+    step, pair = select_steps(fast, cfg)
 
     @partial(mpx.spmd, comm=comm, static_argnums=(1,))
     def fused(state: State, total: int) -> State:
         state = step(state, cfg, comm, first_step=True)
-        return jax.lax.fori_loop(
-            0, total, lambda _, s: step(s, cfg, comm, False), state
-        )
+        return _run_steps(state, total, cfg, comm, step, pair)
 
     state = initial_state(cfg)
     # sync points fetch ONE element: on remote-attached devices a full-array
