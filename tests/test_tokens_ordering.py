@@ -55,6 +55,34 @@ def test_collective_inside_while_loop():
     assert np.allclose(out, mean)
 
 
+def test_collective_inside_while_cond():
+    # ref tests/experimental/test_notoken.py:292-313
+    # (test_while_loop_consistency): the loop PREDICATE itself contains
+    # communication.  Under SPMD this is a natural fit — a collective's
+    # replicated result is exactly the rank-uniform scalar a while_loop
+    # predicate requires.
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        def cond(v):
+            s, _ = mpx.allreduce(v, op=mpx.SUM)
+            return jnp.all(s < 10 * size)
+
+        def body(v):
+            y, _ = mpx.sendrecv(v, v, dest=mpx.shift(1))
+            return mpx.varying(y + 1.0)
+
+        return jax.lax.while_loop(cond, body, x)
+
+    out = np.asarray(f(ranks_arange((1,))))
+    # every iteration permutes (sum-preserving) then adds 1 per rank:
+    # sum grows by `size` per iteration from size*(size-1)/2 until >= 10*size
+    start = size * (size - 1) / 2
+    iters = int(np.ceil((10 * size - start) / size))
+    assert np.allclose(np.sort(out.ravel()), np.sort(np.arange(size) + iters))
+
+
 def test_collective_inside_cond():
     # both branches contain the same collective type — rank-uniform pred
     _, size = world()
