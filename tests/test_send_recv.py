@@ -381,6 +381,21 @@ def test_sendrecv_mismatched_shapes_proc_null_edge():
         assert np.all(out[r] == r - 1)
 
 
+def test_sendrecv_mismatched_shapes_eager():
+    # the eager (outside-spmd) path stacks per-field output shapes that
+    # differ from the inputs' — pin that the auto-wrapped shard_map
+    # round-trips them
+    _, size = world()
+    send = per_rank(lambda r: np.arange(3.0).reshape(1, 3) + 10 * r)
+    recv = jnp.zeros((size, 3, 1))
+    y, _ = mpx.sendrecv(send, recv, dest=mpx.shift(1))
+    y = np.asarray(y)
+    assert y.shape == (size, 3, 1)
+    for r in range(size):
+        src = (r - 1) % size
+        assert np.allclose(y[r][:, 0], np.arange(3.0) + 10 * src)
+
+
 def test_sendrecv_mismatched_count_raises():
     with pytest.raises(ValueError, match="element counts match"):
         @mpx.spmd
