@@ -51,6 +51,17 @@ def main():
                 "vs_baseline": round(ref_gpu_wall / wall, 3),
                 "state_traffic_gb_per_s": round(gbps, 1),
                 "wall_s": round(wall, 3),
+                # honesty marker for readers without docs context: one chip
+                # behind a remote-attach tunnel; ICI/interconnect numbers are
+                # unmeasurable here, and vs_baseline compares cross-era
+                # hardware (v5e-class chip vs 2016 P100)
+                "environment": (
+                    ("single-chip remote-attach (ICI unmeasurable); "
+                     if devices[0].platform == "tpu" and len(devices) == 1
+                     else f"{len(devices)}-device {devices[0].platform}; ")
+                    + "vs_baseline is cross-era hardware "
+                    "(see docs/microbenchmarks.md)"
+                ),
             }
         )
     )

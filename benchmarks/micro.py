@@ -122,6 +122,14 @@ def main():
         print(json.dumps({
             "platform": devices[0].platform,
             "n_devices": n,
+            # honesty marker (docs/microbenchmarks.md): with one
+            # remote-attached chip these numbers time the attach tunnel
+            # round-trip, not the interconnect — ICI is unmeasurable here
+            "environment": (
+                "single-chip remote-attach; tunnel-dominated timings; "
+                "ICI unmeasurable" if devices[0].platform == "tpu" and n == 1
+                else f"{n}-device {devices[0].platform}"
+            ),
             "allreduce": ar,
             "sendrecv_ring": pp,
         }))

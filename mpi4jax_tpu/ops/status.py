@@ -9,17 +9,30 @@ analog), ``tag``/``count``/``dtype`` are static (``tag`` is the tag the
 matched message was *sent* with: the matched send's tag for ``recv``,
 ``sendtag`` for ``sendrecv`` — whose matching is internal to the call, so
 its ``recvtag`` never participates).
+
+``Get_error`` always reports success: this framework keeps the reference's
+fail-fast contract (any transport error aborts the whole job,
+ref mpi_xla_bridge.pyx:67-91 → here ``native.abort_if``), so a Status that
+exists at all describes a completed, successful receive — there is no
+partially-failed state for MPI_ERROR to carry.
 """
+
+import numpy as np
+
+#: MPI_SUCCESS analog — the only error class a completed receive can have
+#: under fail-fast semantics (see module docstring).
+SUCCESS = 0
 
 
 class Status:
-    __slots__ = ("source", "tag", "count", "dtype")
+    __slots__ = ("source", "tag", "count", "dtype", "error")
 
     def __init__(self):
         self.source = None
         self.tag = None
         self.count = None
         self.dtype = None
+        self.error = SUCCESS
 
     def Get_source(self):
         return self.source
@@ -30,6 +43,33 @@ class Status:
     def Get_count(self):
         return self.count
 
+    def Get_error(self):
+        """Always ``SUCCESS`` (0) — see module docstring for why."""
+        return self.error
+
+    def Get_elements(self, dtype=None):
+        """Number of basic elements of ``dtype`` received.
+
+        MPI's ``Get_elements(datatype)`` counts in units of the given basic
+        datatype.  Messages here are never truncated or partially received,
+        so this is the byte count divided by ``dtype``'s item size; it must
+        divide evenly (MPI_UNDEFINED is represented by a ValueError, since a
+        static framework can reject the query at call time).
+        """
+        if self.count is None:
+            return None
+        if dtype is None:
+            dtype = self.dtype
+        nbytes = self.count * np.dtype(self.dtype).itemsize
+        itemsize = np.dtype(dtype).itemsize
+        if nbytes % itemsize:
+            raise ValueError(
+                f"Get_elements: {nbytes} received bytes is not a whole "
+                f"number of {np.dtype(dtype).name} elements"
+            )
+        return nbytes // itemsize
+
     def __repr__(self):
         return (f"Status(source={self.source}, tag={self.tag}, "
-                f"count={self.count}, dtype={self.dtype})")
+                f"count={self.count}, dtype={self.dtype}, "
+                f"error={self.error})")

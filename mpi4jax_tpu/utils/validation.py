@@ -11,6 +11,8 @@ static when they accidentally pass JAX tracers
 import functools
 import inspect
 
+import numpy as np
+
 import jax.core
 
 
@@ -26,12 +28,17 @@ def enforce_types(**type_specs):
     ``type_specs`` maps argument names to a type or tuple of types.  ``None``
     inside a tuple means the argument may be ``None``.
     """
-    # normalize: allow None as shorthand for NoneType
+    # normalize: allow None as shorthand for NoneType; int-typed specs also
+    # accept numpy integer scalars (np.int64(0) etc.) — the reference checks
+    # via np.issubdtype so ported MPI code passing numpy ints must keep
+    # working (ref mpi4jax/_src/validation.py:66)
     norm = {}
     for name, spec in type_specs.items():
         if not isinstance(spec, tuple):
             spec = (spec,)
         spec = tuple(type(None) if s is None else s for s in spec)
+        if int in spec:
+            spec = spec + (np.integer,)
         norm[name] = spec
 
     def decorator(fn):

@@ -8,6 +8,7 @@ r1/r2) enforceable everywhere the test suite runs.
 
 import ast
 import pathlib
+import re
 
 import pytest
 
@@ -34,11 +35,11 @@ def _imported_names(tree, src_lines):
         # only a bare noqa or an explicit F401 waives THIS check (an
         # unrelated code like "# noqa: E501" must not)
         stmt_lines = range(node.lineno, (node.end_lineno or node.lineno) + 1)
-        if any(
-            src_lines[i - 1].rstrip().endswith("# noqa")
-            or "noqa: F401" in src_lines[i - 1]
-            for i in stmt_lines
-        ):
+        # waive on a bare "# noqa" or any code list containing F401
+        # (flake8 accepts "# noqa:F401", "# noqa: F401, E501", trailing
+        # comment text, ...); an unrelated code like "# noqa: E501" must not
+        waiver = re.compile(r"#\s*noqa(\s*$|:[^#]*\bF401\b)")
+        if any(waiver.search(src_lines[i - 1]) for i in stmt_lines):
             continue
         for alias in node.names:
             if alias.name == "*":

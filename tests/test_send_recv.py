@@ -318,6 +318,23 @@ def test_status_tag_count_dtype():
     assert statuses["sr"].dtype == jnp.float32
     assert statuses["rv"].Get_tag() == 3
     assert statuses["rv"].Get_count() == 4
+    # Get_error is always SUCCESS under fail-fast semantics (any transport
+    # error aborts the job before a Status could report it); Get_elements
+    # counts in units of the queried basic dtype
+    assert statuses["sr"].Get_error() == 0
+    assert statuses["rv"].Get_error() == 0
+    assert statuses["sr"].Get_elements() == 4
+    assert statuses["sr"].Get_elements(jnp.uint8) == 16
+    assert statuses["sr"].Get_elements(jnp.float64) == 2
+
+
+def test_status_get_elements_indivisible():
+    s = mpx.Status()
+    s.count = 3
+    s.dtype = jnp.float32  # 12 bytes
+    assert s.Get_elements(jnp.uint8) == 12
+    with pytest.raises(ValueError, match="whole number"):
+        s.Get_elements(jnp.float64)  # 12 B / 8 B
 
 
 def test_sendrecv_tags_inert_for_matching():

@@ -98,6 +98,26 @@ def test_send_recv_reject_nonint_tag():
         mpx.recv(x, tag=1.5)
 
 
+def test_numpy_integer_scalars_accepted():
+    # int-typed specs must accept numpy integer scalars — the reference's
+    # enforce_types checks via np.issubdtype (ref _src/validation.py:66), so
+    # ported MPI code passing np.int64 roots/tags keeps working
+    import numpy as np
+
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        y, t = mpx.bcast(x, np.int64(0))
+        z, _ = mpx.sendrecv(x, x, dest=mpx.shift(1),
+                            sendtag=np.int32(7), recvtag=np.int32(7),
+                            token=t)
+        return y, z
+
+    y, _ = f(ranks_arange((1,)))
+    assert jnp.allclose(jnp.asarray(y), 0.0)
+
+
 def test_sendrecv_rejects_nonint_tags():
     world()
     x = ranks_arange((1,))
