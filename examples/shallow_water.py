@@ -456,8 +456,12 @@ def model_step_fast(state: State, cfg: Config, comm: mpx.Comm,
     depth_q = 0.25 * (hc + rm1x(hc) + rm1y(hc) + rm1y(rm1x(hc)))
     q = derived((coriolis + rel_vort) / depth_q)
 
+    # roll/elementwise-commutation rewrites, bit-identical to the canonical
+    # stencils — MUST stay in lockstep with _phase1_window (the halo-path
+    # equality tests pin exactness between the two)
+    u_sq, v_sq = u * u, v * v
     ke = derived(
-        0.5 * (0.5 * (u**2 + rp1x(u) ** 2) + 0.5 * (v**2 + rp1y(v) ** 2))
+        0.5 * (0.5 * (u_sq + rp1x(u_sq)) + 0.5 * (v_sq + rp1y(v_sq)))
     )
 
     # ---- tendencies (halos zeroed: matches zeros-initialized dh/du/dv) --
@@ -466,25 +470,19 @@ def model_step_fast(state: State, cfg: Config, comm: mpx.Comm,
         -(fe - rp1x(fe)) / dx - (fn - rp1y(fn)) / dy,
         0.0,
     )
+    fn_e = 0.5 * (fn + rm1x(fn))
+    fe_n = 0.5 * (fe + rm1y(fe))
     du_new = jnp.where(
         interior,
         -g * (rm1x(h) - h) / dx
-        + 0.5
-        * (
-            q * 0.5 * (fn + rm1x(fn))
-            + rp1y(q) * 0.5 * (rp1y(fn) + rp1y(rm1x(fn)))
-        )
+        + 0.5 * (q * fn_e + rp1y(q) * rp1y(fn_e))
         - (rm1x(ke) - ke) / dx,
         0.0,
     )
     dv_new = jnp.where(
         interior,
         -g * (rm1y(h) - h) / dy
-        - 0.5
-        * (
-            q * 0.5 * (fe + rm1y(fe))
-            + rp1x(q) * 0.5 * (rp1x(fe) + rp1x(rm1y(fe)))
-        )
+        - 0.5 * (q * fe_n + rp1x(q) * rp1x(fe_n))
         - (rm1y(ke) - ke) / dy,
         0.0,
     )
@@ -547,81 +545,114 @@ _PBLK = 128  # output rows per grid step (multiple of 8: f32 sublane tile)
 # the per-step recompute chain depth, with viscosity, is ~5 rows)
 
 
-def _step_window(cfg: Config, first_step: bool, n_rows: int, iy, ix, fields):
-    """One model step on a ``(nr, nx)`` row window, entirely in registers/
-    VMEM.  ``iy``/``ix`` are the window cells' *global* row/column indices
-    (margins included); ``fields`` is the ``(h, u, v, dh, du, dv)`` window
-    tuple.  Returns the stepped window tuple — margin rows within the
-    recompute chain depth (~5) of the window edge are garbage, which the
-    caller's stored-slice/masks keep out.
+def _rolls(roll, nr: int, nx: int):
+    """The four stencil shifts as positive-shift rolls (``roll`` is
+    ``pltpu.roll`` inside kernels, ``jnp.roll`` on the direct path — the
+    two agree for positive shifts)."""
+    rm1x = lambda a: roll(a, nx - 1, 1)  # noqa: E731  a[j, i+1]
+    rp1x = lambda a: roll(a, 1, 1)  # noqa: E731      a[j, i-1]
+    rm1y = lambda a: roll(a, nr - 1, 0)  # noqa: E731  a[j+1, i]
+    rp1y = lambda a: roll(a, 1, 0)  # noqa: E731       a[j-1, i]
+    return rm1x, rp1x, rm1y, rp1y
 
-    Valid only for the single-rank, periodic-x decomposition: x stencil
-    reads use true periodic lane rolls, and every halo refresh (mid-step
-    and end-of-step) becomes an in-register periodic column fix.
-    Wall/edge semantics are identical to ``model_step_fast``'s iota masks,
-    evaluated on the global indices.
+
+def _window_masks(cfg: Config, iy, ix, giy, gix):
+    """Shared wall/update masks for the phase windows (single source of
+    truth — must mirror ``model_step_fast``'s mask algebra, which the
+    equality tests pin): ``(derived, u_wall, wall_v, interior)``.
+
+    ``derived(expr, extra=None)`` zeroes the halo rows/cols a real exchange
+    would leave untouched; ``u_wall``/``wall_v`` are the no-flow wall
+    masks; ``interior`` is the local-update mask.
     """
-    from jax.experimental.pallas import tpu as pltpu
+    nyl, nxl = cfg.ny_local, cfg.nx_local
+    gy_n, gx_n = cfg.ny + 2, cfg.nx + 2
 
-    h, u, v, dh, du, dv = fields
-    nr, nx = h.shape
-    dx, dy, g, dt = cfg.dx, cfg.dy, cfg.gravity, cfg.dt
+    kept = (giy == 0) | (giy == gy_n - 1)
+    u_wall = None  # kind-"u" no-flow wall column
+    if not cfg.periodic_x:
+        kept |= (gix == 0) | (gix == gx_n - 1)
+        u_wall = gix == gx_n - 2
+    wall_v = giy == gy_n - 2  # kind-"v" no-flux row (extra mask)
+    interior = (iy > 0) & (iy < nyl - 1) & (ix > 0) & (ix < nxl - 1)
 
-    # periodic lane shifts; sublane shifts wrap inside the window (the
-    # wrapped rows are margin garbage that the masks keep out of the
-    # stored rows)
-    rm1x = lambda a: pltpu.roll(a, nx - 1, 1)  # noqa: E731  a[j, i+1]
-    rp1x = lambda a: pltpu.roll(a, 1, 1)  # noqa: E731      a[j, i-1]
-    rm1y = lambda a: pltpu.roll(a, nr - 1, 0)  # noqa: E731  a[j+1, i]
-    rp1y = lambda a: pltpu.roll(a, 1, 0)  # noqa: E731       a[j-1, i]
-
-    kept = (iy == 0) | (iy == n_rows - 1)  # single rank: both walls
-    interior = (iy > 0) & (iy < n_rows - 1) & (ix > 0) & (ix < nx - 1)
-    wall_v = kept | (iy == n_rows - 2)  # kind-"v" no-flux row
-
-    def derived(expr, mask):
+    def derived(expr, extra=None):
+        mask = kept if extra is None else (kept | extra)
         return jnp.where(mask, 0.0, expr)
 
-    def pc_fix(a):
-        # periodic column refresh: col 0 <- col -2, col -1 <- col 1 (what
-        # the single-rank wrap exchange delivers), fully in-register
-        return jnp.where(
-            ix == 0,
-            pltpu.roll(a, 2, 1),
-            jnp.where(ix == nx - 1, pltpu.roll(a, nx - 2, 1), a),
+    return derived, u_wall, wall_v, interior
+
+
+def _phase1_window(cfg: Config, first_step: bool, iy, ix, giy, gix, fields,
+                   roll):
+    """Integration phase of one model step (hc, fluxes, q, ke, tendencies,
+    AB-2/Euler update) on a ``(nr, nx)`` row window, no exchanges.
+
+    ``iy``/``ix`` are the cells' *rank-local* row/column indices (window
+    margins included, so ``iy`` may exceed the local bounds); ``giy``/
+    ``gix`` are the *domain-global* indices (``local + rank offset``) that
+    all wall masks test against — on a single-rank decomposition the two
+    coincide.  Requires the coherent-halo invariant on the input state
+    (each halo cell holds its neighbor's current interior value); returns
+    ``(h1, u1, v1, dh_new, du_new, dv_new)`` whose *local-interior* cells
+    are valid — halo cells keep their (now stale) input values, exactly
+    like ``model_step_fast`` before its mid-step exchange.  Margin rows
+    within the recompute chain depth (~5) of the window edge are garbage
+    that the caller's stored-slice keeps out.
+    """
+    h, u, v, dh, du, dv = fields
+    nr, nx = h.shape
+    gy_n, gx_n = cfg.ny + 2, cfg.nx + 2  # domain-global array heights
+    dx, dy, g, dt = cfg.dx, cfg.dy, cfg.gravity, cfg.dt
+    rm1x, rp1x, rm1y, rp1y = _rolls(roll, nr, nx)
+
+    # wall masks test GLOBAL indices (on non-wall ranks a halo row/col maps
+    # to a neighbor's interior index, so they are false there — its value
+    # is then computed via rolls, valid by halo coherence); the update mask
+    # tests LOCAL indices (every rank's own halo ring is excluded)
+    derived, u_wall, wall_v, interior = _window_masks(cfg, iy, ix, giy, gix)
+
+    # hc: edge-replicated pad rows/cols at the physical walls; elsewhere
+    # the (coherent) halo value is already the neighbor's interior
+    hc = jnp.where(giy == 0, rm1y(h), jnp.where(giy == gy_n - 1, rp1y(h), h))
+    if not cfg.periodic_x:
+        hc = jnp.where(
+            gix == 0, rm1x(hc), jnp.where(gix == gx_n - 1, rp1x(hc), hc)
         )
 
-    # hc: edge-replicated pad rows at the walls (single rank: both)
-    hc = jnp.where(iy == 0, rm1y(h), jnp.where(iy == n_rows - 1, rp1y(h), h))
-
-    fe = derived(0.5 * (hc + rm1x(hc)) * u, kept)
+    fe = derived(0.5 * (hc + rm1x(hc)) * u, u_wall)
     fn = derived(0.5 * (hc + rm1y(hc)) * v, wall_v)
 
-    cor = cfg.coriolis_f + (iy - 1).astype(jnp.float32) * cfg.dy * cfg.coriolis_beta
+    cor = cfg.coriolis_f + (giy - 1).astype(jnp.float32) * cfg.dy * cfg.coriolis_beta
     rel_vort = (rm1x(v) - v) / dx - (rm1y(u) - u) / dy
     depth_q = 0.25 * (hc + rm1x(hc) + rm1y(hc) + rm1y(rm1x(hc)))
-    q = derived((cor + rel_vort) / depth_q, kept)
+    q = derived((cor + rel_vort) / depth_q)
+    # rolls are permutations, so they commute BIT-EXACTLY with elementwise
+    # math: rp1x(u)**2 == rp1x(u*u), rp1y(a) + rp1y(b) == rp1y(a + b).
+    # Rewriting the vorticity-flux and KE stencils through that identity
+    # removes three rolls and two squarings per step at identical results
+    # (roll is the most expensive VPU op here — see docs/shallow_water.md).
+    u_sq, v_sq = u * u, v * v
     ke = derived(
-        0.5 * (0.5 * (u**2 + rp1x(u) ** 2) + 0.5 * (v**2 + rp1y(v) ** 2)),
-        kept,
+        0.5 * (0.5 * (u_sq + rp1x(u_sq)) + 0.5 * (v_sq + rp1y(v_sq)))
     )
 
     dh_new = jnp.where(
         interior, -(fe - rp1x(fe)) / dx - (fn - rp1y(fn)) / dy, 0.0
     )
+    fn_e = 0.5 * (fn + rm1x(fn))  # east-face vorticity-flux average
+    fe_n = 0.5 * (fe + rm1y(fe))  # north-face average
     du_new = jnp.where(
         interior,
         -g * (rm1x(h) - h) / dx
-        + 0.5
-        * (q * 0.5 * (fn + rm1x(fn)) + rp1y(q) * 0.5 * (rp1y(fn) + rp1y(rm1x(fn))))
+        + 0.5 * (q * fn_e + rp1y(q) * rp1y(fn_e))
         - (rm1x(ke) - ke) / dx,
         0.0,
     )
     dv_new = jnp.where(
         interior,
         -g * (rm1y(h) - h) / dy
-        - 0.5
-        * (q * 0.5 * (fe + rm1y(fe)) + rp1x(q) * 0.5 * (rp1x(fe) + rp1x(rm1y(fe))))
+        - 0.5 * (q * fe_n + rp1x(q) * rp1x(fe_n))
         - (rm1y(ke) - ke) / dy,
         0.0,
     )
@@ -635,26 +666,71 @@ def _step_window(cfg: Config, first_step: bool, n_rows: int, iy, ix, fields):
         u1 = u + dt * (cfg.ab_a * du_new + cfg.ab_b * du)
         v1 = v + dt * (cfg.ab_a * dv_new + cfg.ab_b * dv)
 
+    return h1, u1, v1, dh_new, du_new, dv_new
+
+
+def _phase2_window(cfg: Config, iy, ix, giy, gix, u, v, roll):
+    """Viscosity phase of one model step on a window: lateral friction on
+    ``u`` and ``v``, which must enter with *coherent halos* (the mid-step
+    exchange / periodic fix).  Index conventions as ``_phase1_window``;
+    recompute chain depth is 2 rows."""
+    nr, nx = u.shape
+    dx, dy, dt = cfg.dx, cfg.dy, cfg.dt
+    rm1x, rp1x, rm1y, rp1y = _rolls(roll, nr, nx)
+    derived, u_wall, wall_v, interior = _window_masks(cfg, iy, ix, giy, gix)
+
+    visc = cfg.lateral_viscosity
+    out = []
+    for f in (u, v):
+        gx = derived(visc * (rm1x(f) - f) / dx, u_wall)
+        gy = derived(visc * (rm1y(f) - f) / dy, wall_v)
+        out.append(
+            f
+            + jnp.where(
+                interior,
+                dt * ((gx - rp1x(gx)) / dx + (gy - rp1y(gy)) / dy),
+                0.0,
+            )
+        )
+    return out[0], out[1]
+
+
+def _step_window(cfg: Config, first_step: bool, n_rows: int, iy, ix, fields):
+    """One WHOLE model step on a ``(nr, nx)`` row window, entirely in
+    registers/VMEM: ``_phase1_window`` + in-register halo refreshes +
+    ``_phase2_window``.
+
+    Valid only for the single-rank, periodic-x decomposition (so global
+    and local indices coincide — ``giy = iy``): x stencil reads use true
+    periodic lane rolls, and every halo refresh (mid-step and end-of-step)
+    becomes an in-register periodic column fix.  Multi-rank meshes use the
+    split-phase path (``model_step_pallas_halo``), where the refreshes are
+    real ``sendrecv`` exchanges between the phase kernels.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    nx = fields[0].shape[1]
+
+    def pc_fix(a):
+        # periodic column refresh: col 0 <- col -2, col -1 <- col 1 (what
+        # the single-rank wrap exchange delivers), fully in-register
+        return jnp.where(
+            ix == 0,
+            pltpu.roll(a, 2, 1),
+            jnp.where(ix == nx - 1, pltpu.roll(a, nx - 2, 1), a),
+        )
+
+    h1, u1, v1, dh_new, du_new, dv_new = _phase1_window(
+        cfg, first_step, iy, ix, iy, ix, fields, pltpu.roll
+    )
+
     # mid-step halo refresh (the jnp path's enforce_boundaries between
     # integration and viscosity): periodic column fix + kind-"v" wall row
     u1 = pc_fix(u1)
     v1 = jnp.where(iy == n_rows - 2, 0.0, pc_fix(v1))
 
     if cfg.lateral_viscosity > 0:
-        visc = cfg.lateral_viscosity
-        for which in (0, 1):
-            f = u1 if which == 0 else v1
-            gx = derived(visc * (rm1x(f) - f) / dx, kept)
-            gy = derived(visc * (rm1y(f) - f) / dy, wall_v)
-            f = f + jnp.where(
-                interior,
-                dt * ((gx - rp1x(gx)) / dx + (gy - rp1y(gy)) / dy),
-                0.0,
-            )
-            if which == 0:
-                u1 = f
-            else:
-                v1 = f
+        u1, v1 = _phase2_window(cfg, iy, ix, iy, ix, u1, v1, pltpu.roll)
 
     # end-of-step halo refresh, in-register: on the single-rank periodic-x
     # decomposition the three enforce_boundaries(·, "h") exchanges reduce
@@ -712,6 +788,53 @@ def _sw_steps_kernel(cfg: Config, first_step: bool, n_rows: int, mrg: int,
         o[:] = f[sl]
 
 
+def _resolve_interpret(comm: mpx.Comm) -> bool:
+    """Whether Pallas must run in interpret mode: resolve from the mesh the
+    step actually runs on, not the process default backend (the two differ
+    when a driver places the mesh on a non-default platform's devices)."""
+    mesh = comm.mesh
+    if mesh is not None and mesh.devices.size:
+        return mesh.devices.flat[0].platform != "tpu"
+    return jax.default_backend() != "tpu"
+
+
+def _blocked_specs(ny: int, nx: int, mrg: int):
+    """``(grid, main_spec, prev_spec, next_spec)`` for ``_PBLK``-row output
+    blocks with ``mrg``-row recompute margins, clipped (duplicated) at the
+    array edges — the margin-row mislabeling this causes only ever reaches
+    rows that the wall masks zero or that no stored row reads (the same
+    one-sided-read discipline that makes ``model_step_fast`` exchange-free
+    for derived fields)."""
+    import jax.experimental.pallas as pl
+
+    grid = ((ny + _PBLK - 1) // _PBLK,)
+    n_hblocks = (ny + mrg - 1) // mrg  # mrg-row halo block count
+    r = _PBLK // mrg
+
+    main = pl.BlockSpec((_PBLK, nx), lambda i: (i, 0))
+    prev = pl.BlockSpec(
+        (mrg, nx), lambda i: (jnp.clip(i * r - 1, 0, n_hblocks - 1), 0)
+    )
+    nxt = pl.BlockSpec(
+        (mrg, nx), lambda i: (jnp.clip(i * r + r, 0, n_hblocks - 1), 0)
+    )
+    return grid, main, prev, nxt
+
+
+def _tpu_compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    # at benchmark width (nx_local=3602) the 24 window blocks plus
+    # kernel intermediates need most of the 100 MB granted here
+    # (measured: _PBLK=256 needs 165 MB and overflows the chip's
+    # 128 MB VMEM — raising _PBLK further requires shrinking the
+    # working set first); Mosaic's default scoped limit is 16 MB
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=100 * 1024 * 1024,
+        dimension_semantics=("parallel",),
+    )
+
+
 def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
                       first_step: bool, interpret=None,
                       nsteps: int = 1) -> State:
@@ -726,8 +849,9 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     ``8 * nsteps``-row margin per ``_PBLK``-row block), instead of
     materializing ~10 intermediate full fields through HBM per step.
     Single-rank periodic-x decompositions only (the benchmark
-    configuration); multi-rank meshes use ``model_step_fast``, whose
-    exchange structure this kernel reproduces in-register.  Equality with
+    configuration); multi-rank meshes use ``model_step_pallas_halo``, which
+    keeps the same kernels but splices real exchanges between the phases.
+    Equality with
     the jnp step is pinned by
     tests/test_examples.py::test_pallas_step_matches_fast_step and
     ::test_pallas_pair_step_matches_fast_steps (interpret mode on CPU,
@@ -746,14 +870,7 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     import jax.experimental.pallas as pl
 
     if interpret is None:
-        # resolve from the mesh the step actually runs on, not the process
-        # default backend (the two differ when a driver places the mesh on
-        # a non-default platform's devices)
-        mesh = comm.mesh
-        if mesh is not None and mesh.devices.size:
-            interpret = mesh.devices.flat[0].platform != "tpu"
-        else:
-            interpret = jax.default_backend() != "tpu"
+        interpret = _resolve_interpret(comm)
 
     ny, nx = cfg.ny_local, cfg.nx_local
     fields = state
@@ -774,54 +891,25 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
         out_vma = vma
     h, u, v, dh, du, dv = fields
 
-    grid = ((ny + _PBLK - 1) // _PBLK,)
-    n_hblocks = (ny + mrg - 1) // mrg  # mrg-row halo block count
-    r = _PBLK // mrg
-
-    def main_spec():
-        return pl.BlockSpec((_PBLK, nx), lambda i: (i, 0))
-
-    def prev_spec():
-        return pl.BlockSpec(
-            (mrg, nx), lambda i: (jnp.clip(i * r - 1, 0, n_hblocks - 1), 0)
-        )
-
-    def next_spec():
-        return pl.BlockSpec(
-            (mrg, nx), lambda i: (jnp.clip(i * r + r, 0, n_hblocks - 1), 0)
-        )
+    grid, main_spec, prev_spec, next_spec = _blocked_specs(ny, nx, mrg)
 
     in_specs = []
     operands = []
     for f in (h, u, v, dh, du, dv):
-        in_specs += [prev_spec(), main_spec(), next_spec()]
+        in_specs += [prev_spec, main_spec, next_spec]
         operands += [f, f, f]
 
     out_shape = [
         jax.ShapeDtypeStruct((ny, nx), jnp.float32, vma=out_vma)
     ] * 6
-    if interpret:
-        compiler_params = None
-    else:
-        from jax.experimental.pallas import tpu as pltpu
-
-        # at benchmark width (nx_local=3602) the 24 window blocks plus
-        # kernel intermediates need most of the 100 MB granted here
-        # (measured: _PBLK=256 needs 165 MB and overflows the chip's
-        # 128 MB VMEM — raising _PBLK further requires shrinking the
-        # working set first); Mosaic's default scoped limit is 16 MB
-        compiler_params = pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024,
-            dimension_semantics=("parallel",),
-        )
     outs = pl.pallas_call(
         lambda *refs: _sw_steps_kernel(cfg, first_step, ny, mrg, nsteps, refs),
         grid=grid,
         in_specs=in_specs,
-        out_specs=[main_spec() for _ in range(6)],
+        out_specs=[main_spec for _ in range(6)],
         out_shape=out_shape,
         interpret=interpret,
-        compiler_params=compiler_params,
+        compiler_params=None if interpret else _tpu_compiler_params(),
     )(*operands)
     if interpret and vma:
         outs = [jax.lax.pcast(o, axes, to="varying") for o in outs]
@@ -845,6 +933,165 @@ def model_step2_pallas(state: State, cfg: Config, comm: mpx.Comm,
                              interpret=interpret, nsteps=2)
 
 
+# ---------------------------------------------------------------------------
+# Pallas split-phase step (any mesh: kernel compute + real halo exchanges)
+# ---------------------------------------------------------------------------
+
+
+def _rank_offsets(cfg: Config):
+    """This rank's domain-global (row, col) offset as a ``(2,)`` int32
+    vector — the SMEM scalar operand that lets ONE compiled kernel serve
+    every rank position (all wall masks test ``local index + offset``)."""
+    row = jax.lax.axis_index("py") * (cfg.ny_local - 2)
+    col = jax.lax.axis_index("px") * (cfg.nx_local - 2)
+    return jnp.stack([row.astype(jnp.int32), col.astype(jnp.int32)])
+
+
+def _sw_phase_kernel(cfg: Config, mrg: int, nfields: int, window, refs):
+    """Kernel body shared by the two phase kernels: assemble ``nfields``
+    row windows from [prev-margin, main, next-margin] block triples, label
+    them with local + global indices (rank offsets from the leading SMEM
+    operand), apply ``window``, store the main rows."""
+    import jax.experimental.pallas as pl
+
+    meta = refs[0]
+    ins, outs = refs[1:1 + 3 * nfields], refs[1 + 3 * nfields:]
+    nx = cfg.nx_local
+    nr = _PBLK + 2 * mrg
+
+    fields = tuple(
+        jnp.concatenate(
+            [ins[3 * k][:], ins[3 * k + 1][:], ins[3 * k + 2][:]], axis=0
+        )
+        for k in range(nfields)
+    )
+
+    pid = pl.program_id(0)
+    iy = jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 0) + pid * _PBLK - mrg
+    ix = jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 1)
+    giy = iy + meta[0]
+    gix = ix + meta[1]
+
+    out_fields = window(iy, ix, giy, gix, fields)
+    sl = slice(mrg, mrg + _PBLK)
+    for o, f in zip(outs, out_fields):
+        o[:] = f[sl]
+
+
+def _phase_pallas_call(cfg: Config, window, meta, fields, n_out: int,
+                       out_vma):
+    """Run ``window`` (a ``_phase*_window`` closure) as a compiled blocked
+    Pallas kernel over the rank-local arrays in ``fields``."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    mrg = 8  # one sublane tile covers both phases' recompute chain depths
+    ny, nx = cfg.ny_local, cfg.nx_local
+    grid, main_spec, prev_spec, next_spec = _blocked_specs(ny, nx, mrg)
+
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    operands = [meta]
+    for f in fields:
+        in_specs += [prev_spec, main_spec, next_spec]
+        operands += [f, f, f]
+
+    out_shape = [
+        jax.ShapeDtypeStruct((ny, nx), jnp.float32, vma=out_vma)
+    ] * n_out
+    return pl.pallas_call(
+        lambda *refs: _sw_phase_kernel(cfg, mrg, len(fields), window, refs),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[main_spec for _ in range(n_out)],
+        out_shape=out_shape,
+        compiler_params=_tpu_compiler_params(),
+    )(*operands)
+
+
+def model_step_pallas_halo(state: State, cfg: Config, comm: mpx.Comm,
+                           first_step: bool, interpret=None) -> State:
+    """One model step on ANY mesh decomposition: fused Pallas compute with
+    real ``sendrecv`` halo exchanges spliced between the phases.
+
+    Where the whole-step kernel (``model_step_pallas``) folds every halo
+    refresh into an in-register periodic column fix — possible only when
+    one rank owns the whole domain — this path keeps ``model_step_fast``'s
+    exchange structure (integrate → exchange h,u,v → viscosity → exchange
+    u,v; see its docstring for why the derived fields need no exchange at
+    all) and replaces the two *compute* regions with blocked Pallas
+    kernels: ``_phase1_window`` (hc, fluxes, q, ke, tendencies, AB update
+    — every intermediate stays in VMEM) and ``_phase2_window`` (viscous
+    fluxes).  Per step the state round-trips HBM twice (once per phase)
+    instead of once (whole-step kernel) but far under the jnp path's ~10
+    intermediate full fields.  Rank position enters the compiled kernel as
+    an SMEM scalar pair (``_rank_offsets``), so one kernel serves all
+    ranks of the SPMD program.
+
+    On non-TPU backends (``interpret`` resolves true) the same window
+    functions are evaluated directly on the full local array with
+    ``jnp.roll`` — identical arithmetic, no Pallas machinery — because
+    Mosaic cannot compile there and Pallas interpret mode cannot inline
+    kernel jaxprs under shard_map's varying-axes checking on a real
+    multi-rank mesh (the single-rank psum identity used by
+    ``model_step_pallas`` has no multi-rank analog).  Equality with
+    ``model_step_fast`` on a (2, 4) mesh is pinned in
+    tests/test_examples.py; the compiled kernels are exercised on-chip by
+    the (1, 1)-mesh TPU path, which shares every line of kernel code.
+    """
+    if interpret is None:
+        interpret = _resolve_interpret(comm)
+
+    token = mpx.create_token()
+    meta = _rank_offsets(cfg)
+    nyl, nxl = cfg.ny_local, cfg.nx_local
+    vma = frozenset(getattr(jax.typeof(state.h), "vma", frozenset()))
+
+    if interpret:
+        iy = jax.lax.broadcasted_iota(jnp.int32, (nyl, nxl), 0)
+        ix = jax.lax.broadcasted_iota(jnp.int32, (nyl, nxl), 1)
+        giy, gix = iy + meta[0], ix + meta[1]
+        outs = _phase1_window(
+            cfg, first_step, iy, ix, giy, gix, tuple(state), jnp.roll
+        )
+    else:
+        outs = _phase_pallas_call(
+            cfg,
+            lambda iy, ix, giy, gix, fs: _phase1_window(
+                cfg, first_step, iy, ix, giy, gix, fs, _pltpu_roll()
+            ),
+            meta, tuple(state), 6, vma,
+        )
+    h1, u1, v1, dh_new, du_new, dv_new = outs
+
+    h1, token = enforce_boundaries(h1, "h", cfg, comm, token)
+    u1, token = enforce_boundaries(u1, "u", cfg, comm, token)
+    v1, token = enforce_boundaries(v1, "v", cfg, comm, token)
+
+    if cfg.lateral_viscosity > 0:
+        if interpret:
+            u1, v1 = _phase2_window(cfg, iy, ix, giy, gix, u1, v1, jnp.roll)
+        else:
+            u1, v1 = _phase_pallas_call(
+                cfg,
+                lambda iy, ix, giy, gix, fs: _phase2_window(
+                    cfg, iy, ix, giy, gix, fs[0], fs[1], _pltpu_roll()
+                ),
+                meta, (u1, v1), 2, vma,
+            )
+        # restore the coherent-halo invariant for the next step (pure halo
+        # refresh, kind "h" — see model_step_fast)
+        u1, token = enforce_boundaries(u1, "h", cfg, comm, token)
+        v1, token = enforce_boundaries(v1, "h", cfg, comm, token)
+
+    return State(h1, u1, v1, dh_new, du_new, dv_new)
+
+
+def _pltpu_roll():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.roll
+
+
 def select_step(fast, cfg: Config = None):
     """The model-step implementation behind ``fast``: the single source of
     truth for every driver (make_stepper, solve_fused, bench.py).
@@ -856,8 +1103,10 @@ def select_step(fast, cfg: Config = None):
     - ``"pallas"`` / ``"pallas2"`` — the fused whole-step Pallas kernel
       (single-rank periodic-x only; asserts otherwise); ``"pallas2"``
       additionally fuses step *pairs* (see ``select_steps``);
+    - ``"pallas_halo"`` — the split-phase Pallas kernels with real halo
+      exchanges between them (any mesh, ``model_step_pallas_halo``);
     - ``"auto"`` — ``"pallas2"`` when ``cfg`` is a single-rank periodic-x
-      decomposition (the benchmark configuration), else ``True``.
+      decomposition (the benchmark configuration), else ``"pallas_halo"``.
 
     Returns the SINGLE-step callable; drivers that can batch steps in
     pairs use ``select_steps`` to also obtain the pair kernel.
@@ -877,11 +1126,16 @@ def select_steps(fast, cfg: Config = None):
                 "select_step('auto') needs the Config to decide kernel "
                 "eligibility — pass cfg"
             )
-        fast = "pallas2" if cfg.nproc == 1 and cfg.periodic_x else True
+        # whole-step kernel where eligible (no exchanges at all); the
+        # split-phase kernel everywhere else (multi-rank meshes, walls)
+        fast = ("pallas2" if cfg.nproc == 1 and cfg.periodic_x
+                else "pallas_halo")
     if fast == "pallas2":
         return model_step_pallas, model_step2_pallas
     if fast == "pallas":
         return model_step_pallas, None
+    if fast == "pallas_halo":
+        return model_step_pallas_halo, None
     return (model_step_fast if fast else model_step), None
 
 

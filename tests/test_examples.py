@@ -224,21 +224,69 @@ def test_pallas_step_rejects_multirank_config():
         first(initial_state(cfg))
 
 
-def test_select_step_auto_picks_pallas_only_when_eligible():
+def test_select_step_auto_picks_kernel_by_mesh():
     from dataclasses import replace
 
     from shallow_water import (
-        model_step_fast,
         model_step_pallas,
+        model_step_pallas_halo,
         select_step,
     )
 
+    # whole-step kernel only where every refresh is an in-register periodic
+    # fix; the split-phase kernel (real exchanges) everywhere else
     single = Config(nproc_y=1, nproc_x=1, nx=48, ny=24)
     assert select_step("auto", single) is model_step_pallas
     multi = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
-    assert select_step("auto", multi) is model_step_fast
+    assert select_step("auto", multi) is model_step_pallas_halo
     walls = replace(single, periodic_x=False)
-    assert select_step("auto", walls) is model_step_fast
+    assert select_step("auto", walls) is model_step_pallas_halo
+
+
+@pytest.mark.parametrize("grid", [(1, 1), (2, 4)])
+@pytest.mark.parametrize("periodic", [True, False])
+def test_pallas_halo_step_matches_fast_step(grid, periodic):
+    """The split-phase path (``model_step_pallas_halo``) must reproduce
+    ``model_step_fast`` bit-for-bit on every mesh/boundary combination: its
+    interpret path evaluates the same window arithmetic (identical
+    expression order) on the full local array with the identical exchange
+    sequence, so there is no rounding divergence at all."""
+    from dataclasses import replace
+
+    from shallow_water import make_mesh_and_comm, make_stepper
+
+    ny_, nx_ = grid
+    cfg = replace(
+        Config(nproc_y=ny_, nproc_x=nx_, nx=48, ny=24), periodic_x=periodic
+    )
+    devices = jax.devices()[: cfg.nproc]
+    _, comm = make_mesh_and_comm(cfg, devices=devices)
+    first_fast, multi_fast = make_stepper(cfg, comm, fast=True)
+    first_halo, multi_halo = make_stepper(cfg, comm, fast="pallas_halo")
+
+    s0 = initial_state(cfg)
+    fast = multi_fast(first_fast(s0), 12)
+    halo = multi_halo(first_halo(s0), 12)
+    for name, a, b in zip(fast._fields, fast, halo):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"field {name} diverged (grid={grid}, periodic={periodic})",
+        )
+
+
+def test_pallas_halo_decomposition_invariance_exact():
+    """Like the fast step, the split-phase path is exactly decomposition-
+    invariant: same bits on one device and on a (2, 4) mesh."""
+    steps = 20
+    cfg8 = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
+    s8, _, _ = solve(cfg8, steps * cfg8.dt, num_multisteps=5,
+                     fast="pallas_halo")
+    cfg1 = Config(nproc_y=1, nproc_x=1, nx=48, ny=24)
+    s1, _, _ = solve(cfg1, steps * cfg1.dt, num_multisteps=5,
+                     fast="pallas_halo", devices=jax.devices()[:1])
+    g8 = reassemble(s8[-2], cfg8)
+    g1 = reassemble(s1[-2], cfg1)
+    np.testing.assert_array_equal(g8, g1)
 
 
 def test_fast_step_decomposition_invariance_exact():
