@@ -205,6 +205,17 @@ _eager_cache: "OrderedDict" = OrderedDict()
 _EAGER_CACHE_MAX = 128
 
 
+def check_global_shape(opname: str, a, size: int) -> None:
+    """Validate the eager global-array convention: leading axis = ranks."""
+    if getattr(a, "ndim", 0) == 0 or a.shape[0] != size:
+        raise ValueError(
+            f"{opname} (eager): expected a global array with leading rank "
+            f"axis of size {size} (global[r] = rank r's value); got shape "
+            f"{getattr(a, 'shape', None)}. Inside a parallel region, pass "
+            "rank-local arrays instead."
+        )
+
+
 def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
              static_key: Optional[tuple] = None):
     """Run op ``body`` either inline (inside a parallel region) or eagerly.
@@ -249,13 +260,7 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
 
     size = comm.Get_size()
     for a in arrays:
-        if a.ndim == 0 or a.shape[0] != size:
-            raise ValueError(
-                f"{opname} (eager): expected a global array with leading rank "
-                f"axis of size {size} (global[r] = rank r's value); got shape "
-                f"{a.shape}. Inside a parallel region, pass rank-local arrays "
-                "instead."
-            )
+        check_global_shape(opname, a, size)
 
     axes_spec = P(comm.axes if len(comm.axes) > 1 else comm.axes[0])
 

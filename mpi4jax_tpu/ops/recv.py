@@ -11,19 +11,46 @@ Wildcard semantics: the reference defaults to ``ANY_SOURCE``/``ANY_TAG``
 the reference's default-argument uses — and an explicit ``source`` spec is
 validated against it.  A ``recv`` with no queued send is a trace-time error
 (the reference would deadlock at run time).
+
+Standalone *eager* use pops the matching deferred eager ``send`` (see
+ops/send.py) and emits the fused one-CollectivePermute program right here —
+the transfer happens at the recv.
 """
 
 from typing import Optional
 
+import jax
+
 from ..parallel.comm import Comm
 from ..parallel.rankspec import normalize_source
-from ..parallel.region import current_context
+from ..parallel.region import current_context, in_parallel_region, resolve_comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
 from ._base import as_varying, dispatch
+from .send import _eager_queue
 from .sendrecv import _apply_permute, _fill_status
 from .status import Status
 from .token import Token, consume, produce
+
+
+def _check_recv_match(pending, template, source, size):
+    """Shared send↔recv compatibility checks (routing + type signature)."""
+    if source is not None:
+        pairs_s = normalize_source(source, size, what="recv")
+        if pairs_s != pending.pairs:
+            raise ValueError(
+                f"recv: source spec implies routing {pairs_s} but the "
+                f"matching send declared {pending.pairs}"
+            )
+    if pending.value.dtype != template.dtype or (
+            pending.value.size != template.size):
+        raise ValueError(
+            f"recv: template shape/dtype {template.shape}/{template.dtype} "
+            f"does not match sent {pending.value.shape}/"
+            f"{pending.value.dtype} (shapes may differ only at equal "
+            "element count; the output is typed by the template, ref "
+            "recv.py:246)"
+        )
 
 
 @enforce_types(tag=int, comm=(Comm, None), status=(Status, None),
@@ -35,6 +62,9 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
     Returns ``(received, token)`` (ref API: recv.py:43-87).  Ranks outside
     the routing receive ``x`` back unchanged (MPI_PROC_NULL semantics).
     """
+    c = resolve_comm(comm)
+    if c.mesh is not None and not in_parallel_region(c):
+        return _eager_recv(x, source, tag, c, status, token)
 
     def body(comm, arrays, token):
         (template,) = arrays
@@ -49,22 +79,7 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
                 "run time; this framework turns it into a trace error)."
             )
         pending = q.popleft()
-        if source is not None:
-            pairs_s = normalize_source(source, size, what="recv")
-            if pairs_s != pending.pairs:
-                raise ValueError(
-                    f"recv: source spec implies routing {pairs_s} but the "
-                    f"matching send declared {pending.pairs}"
-                )
-        if pending.value.dtype != template.dtype or (
-                pending.value.size != template.size):
-            raise ValueError(
-                f"recv: template shape/dtype {template.shape}/{template.dtype} "
-                f"does not match sent {pending.value.shape}/"
-                f"{pending.value.dtype} (shapes may differ only at equal "
-                "element count; the output is typed by the template, ref "
-                "recv.py:246)"
-            )
+        _check_recv_match(pending, template, source, size)
         payload = as_varying(consume(token, pending.value), comm.axes)
         log_op("MPI_Recv", comm.Get_rank(),
                f"{payload.size} items along {list(pending.pairs)} (tag {tag})")
@@ -74,3 +89,65 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
         return res, produce(token, res)
 
     return dispatch("recv", comm, body, (x,), token)
+
+
+def _eager_recv(x, source, tag, comm, status, token):
+    """Standalone eager recv: pop the matching deferred eager send and run
+    the fused send+recv as one one-op program (the transfer happens here).
+
+    ``x`` and the queued payload are GLOBAL arrays (leading axis = ranks,
+    the eager convention); matching/validation mirrors the in-region path.
+    """
+    q = _eager_queue(comm.uid, tag)
+    if not q:
+        raise RuntimeError(
+            f"recv(tag={tag}): no matching eager send queued on this comm. "
+            "Standalone eager recv pairs with a prior standalone eager send "
+            "on the same comm and tag (the reference would block here until "
+            "one arrived; this framework turns the missing-send case into "
+            "an immediate error)."
+        )
+    # peek, don't pop: a recv that fails ANY argument check must not
+    # consume the message (MPI semantics — the send stays matchable by a
+    # corrected retry); the entry is popped only after the transfer program
+    # runs, or when it is provably unreceivable (dead tracer, below)
+    pending = q[0]
+    import jax.core
+
+    from ..utils.jax_compat import tracer_is_live
+
+    if (isinstance(pending.value, jax.core.Tracer)
+            and not tracer_is_live(pending.value)):
+        q.popleft()  # can never be received — drop with a clear error
+        raise RuntimeError(_STALE_SEND_MSG.format(tag=tag))
+    size = comm.Get_size()
+    _check_recv_match(pending, x, source, size)
+    pairs = pending.pairs
+
+    def body(comm, arrays, token):
+        xl, template = arrays
+        payload = consume(token, xl)
+        log_op("MPI_Recv", comm.Get_rank(),
+               f"{payload.size} items along {list(pairs)} (tag {tag})")
+        res = _apply_permute(payload, template, pairs, comm)
+        _fill_status(status, pairs, comm, payload.size, payload.dtype, tag)
+        return res, produce(token, res)
+
+    static_key = None if status is not None else (pairs, tag, "eager_pair")
+    try:
+        out = dispatch("recv", comm, body, (pending.value, x), token,
+                       static_key=static_key)
+    except jax.errors.UnexpectedTracerError as e:
+        # backstop for liveness cases the proactive probe cannot see
+        q.popleft()
+        raise RuntimeError(_STALE_SEND_MSG.format(tag=tag)) from e
+    q.popleft()
+    return out
+
+
+_STALE_SEND_MSG = (
+    "recv(tag={tag}): the matching eager send was traced inside a jit/grad "
+    "function whose trace has ended, so its payload no longer exists. Pair "
+    "traced sends with a recv in the SAME trace, or use sendrecv / an "
+    "mpi4jax_tpu.spmd region."
+)

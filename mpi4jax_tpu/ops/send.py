@@ -16,17 +16,27 @@ matching ``recv`` emits the fused CollectivePermute.  Ordering notes:
 - a send left unmatched at region end raises (see RegionContext.check_drained)
   — the SPMD analog of the reference's deadlock-on-unmatched-send, converted
   from a hang into a trace-time error.
+
+Standalone *eager* use (outside any region) works by **deferred pairing**:
+the send queues its (global) payload and routing host-side and returns
+immediately — buffered-send (MPI_Bsend-like) semantics, where the
+reference's eager send blocks until delivery (ref send.py:41-79) — and the
+matching eager ``recv`` emits the fused one-CollectivePermute program.  A
+send still queued at ``flush()``/exit raises a clear error (the analog of
+the reference's deadlock-on-unmatched-send at MPI_Finalize).
 """
 
-from typing import NamedTuple, Optional, Tuple
+from collections import deque
+from typing import Dict, NamedTuple, Optional, Tuple
 
 from ..parallel.comm import Comm
 from ..parallel.rankspec import normalize_dest
-from ..parallel.region import current_context
+from ..parallel.region import current_context, in_parallel_region, resolve_comm
 from ..utils.debug import log_op
+from ..utils.dtypes import check_dtype
 from ..utils.validation import enforce_types
-from ._base import dispatch
-from .token import Token, consume, produce
+from ._base import check_global_shape, dispatch
+from .token import Token, consume, create_token, produce
 
 
 class PendingSend(NamedTuple):
@@ -35,14 +45,61 @@ class PendingSend(NamedTuple):
     token: Optional[Token]
 
 
+# eager (outside-any-region) deferred sends: (comm_uid, tag) -> FIFO of
+# PendingSend whose ``value`` is a GLOBAL array (leading axis = ranks, the
+# eager convention) and whose token slot is unused (ordering is carried by
+# the recv-side program)
+_eager_sends: Dict[Tuple[int, int], deque] = {}
+
+
+def _eager_queue(comm_uid: int, tag: int) -> deque:
+    return _eager_sends.setdefault((comm_uid, tag), deque())
+
+
+def check_eager_drained() -> None:
+    """Raise if any standalone eager send is still unmatched — called by
+    ``flush()`` (and thus at interpreter exit)."""
+    leftover = {k: len(q) for k, q in _eager_sends.items() if q}
+    if leftover:
+        raise RuntimeError(
+            f"unmatched eager send(s) at flush/exit: "
+            f"{{(comm_uid, tag): count}} = {leftover}. Every standalone "
+            "eager send must be matched by an eager recv on the same comm "
+            "and tag before flush/exit (deferred pairing: the transfer only "
+            "happens at the recv; the reference's blocking send would "
+            "deadlock here instead)."
+        )
+
+
 @enforce_types(tag=int, comm=(Comm, None), token=(Token, None))
 def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
          token: Optional[Token] = None) -> Token:
     """Send ``x`` along routing ``dest`` (see parallel/rankspec.py).
 
-    Must be matched by a ``recv`` on the same comm and tag later in the same
-    parallel region.  Returns a token (ref API: send.py:41-79).
+    Inside a parallel region: must be matched by a ``recv`` on the same comm
+    and tag later in the same region.  Standalone eager use queues the
+    (global) payload for the matching eager ``recv`` — deferred pairing, see
+    module docstring.  Returns a token (ref API: send.py:41-79).
     """
+    c = resolve_comm(comm)
+    if c.mesh is not None and not in_parallel_region(c):
+        # standalone eager: defer — queue payload + routing, transfer at
+        # recv.  Inside an outer jit/grad trace the queued payload is a
+        # tracer; that is fine as long as the matching recv happens in the
+        # SAME trace (e.g. grad through a send->recv pair) — a recv in a
+        # later trace/eager context gets a clear staleness error
+        # (ops/recv.py) instead of a leaked-tracer failure.
+        check_dtype(x, "send")
+        size = c.Get_size()
+        check_global_shape("send", x, size)
+        pairs = normalize_dest(dest, size, what="send")
+        log_op("MPI_Send", 0,
+               f"deferred: {x.size // size} items/rank along {list(pairs)} "
+               f"(tag {tag})")
+        _eager_queue(c.uid, tag).append(PendingSend(x, pairs, None))
+        # buffered-send semantics: nothing has moved yet, so the returned
+        # token orders nothing beyond what the caller already had
+        return token if token is not None else create_token()
 
     def body(comm, arrays, token):
         (xl,) = arrays
@@ -55,8 +112,5 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
         ctx.queue(comm.uid, tag).append(PendingSend(xl, pairs, token))
         return (produce(token, xl),)
 
-    # NOTE: send cannot run standalone in eager mode (the matching recv would
-    # be in a different one-op program) — dispatch's drained-queue check
-    # raises a clear error; use sendrecv or an spmd region for eager p2p.
     out = dispatch("send", comm, body, (x,), token)
     return out[0]

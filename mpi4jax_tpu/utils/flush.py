@@ -13,5 +13,15 @@ import jax
 
 
 def flush() -> None:
-    """Wait for all pending XLA operations (incl. collectives) to complete."""
+    """Wait for all pending XLA operations (incl. collectives) to complete.
+
+    Also raises if a standalone eager ``send`` is still unmatched (deferred
+    pairing, ops/send.py): its transfer can never happen after exit, which
+    in the reference would be a silent deadlock at MPI_Finalize.
+    """
+    from ..ops.send import check_eager_drained
+
+    # barrier FIRST: even on the unmatched-send error path the process must
+    # quiesce in-flight collectives (the module's clean-shutdown guarantee)
     jax.effects_barrier()
+    check_eager_drained()

@@ -51,6 +51,35 @@ def axis_bound(axis_name: str) -> bool:
         return False
 
 
+def tracer_is_live(tracer) -> bool:
+    """True iff ``tracer`` belongs to a trace that is still active (the
+    ambient trace or one of its parents) — i.e. using it now is legal.
+
+    Used by the eager deferred send/recv pairing (ops/recv.py) to convert
+    a dead queued payload into a clear staleness error *before* JAX's own
+    leak detection produces an opaque UnexpectedTracerError at a much later
+    point (outer-jit argument checking).  Probe: walk ``parent_trace`` from
+    ``jax._src.core.trace_ctx.trace``; if the internals move in a future
+    JAX, fall back to "assume live" — the recv-side UnexpectedTracerError
+    backstop still fires, just less prettily.  Pinned by
+    tests/test_send_recv.py::test_eager_send_traced_then_recv_outside_raises_clearly.
+    """
+    try:
+        from jax._src.core import trace_ctx
+
+        target = tracer._trace
+        cur = trace_ctx.trace
+    except (ImportError, AttributeError):
+        return True
+    seen = set()
+    while cur is not None and id(cur) not in seen:
+        if cur is target:
+            return True
+        seen.add(id(cur))
+        cur = getattr(cur, "parent_trace", None)
+    return False
+
+
 # oldest JAX with the shard_map/VMA semantics the ops rely on
 MIN_JAX_VERSION = "0.6.0"
 # newest JAX this package was validated against

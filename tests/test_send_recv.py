@@ -459,3 +459,129 @@ def test_bare_int_dest_guidance():
             return y
 
         f(ranks_arange((1,)))
+
+
+# --- standalone eager send/recv (deferred pairing) -------------------------
+# Ports the eager portions of ref tests/collective_ops/test_send_and_recv.py
+# (each process sends/recvs outside jit) to the SPMD eager convention:
+# global arrays with a leading rank axis, transfer emitted at the recv.
+
+
+def test_eager_send_recv_ring():
+    _, size = world()
+    x = ranks_arange((3,))
+    tok = mpx.send(x, dest=mpx.shift(1), tag=11)
+    res, _ = mpx.recv(x, tag=11, token=tok)
+    out = np.asarray(res)
+    assert out.shape == x.shape
+    assert np.allclose(out[:, 0], np.roll(np.arange(size), 1))
+
+
+def test_eager_send_recv_fifo_and_tag_isolation():
+    _, size = world()
+    a = per_rank(lambda r: jnp.full((2,), float(r)))
+    b = per_rank(lambda r: jnp.full((2,), 100.0 + r))
+    c = per_rank(lambda r: jnp.full((2,), 200.0 + r))
+    # two sends on tag 1 (FIFO) interleaved with one on tag 2
+    mpx.send(a, dest=mpx.shift(1), tag=1)
+    mpx.send(c, dest=mpx.shift(-1), tag=2)
+    mpx.send(b, dest=mpx.shift(1), tag=1)
+    ra, _ = mpx.recv(a, tag=1)
+    rc, _ = mpx.recv(c, tag=2)
+    rb, _ = mpx.recv(b, tag=1)
+    assert np.allclose(np.asarray(ra)[:, 0], np.roll(np.arange(size), 1))
+    assert np.allclose(np.asarray(rb)[:, 0], 100 + np.roll(np.arange(size), 1))
+    assert np.allclose(np.asarray(rc)[:, 0], 200 + np.roll(np.arange(size), -1))
+
+
+def test_eager_recv_adopts_routing_and_fills_status():
+    _, size = world()
+    x = ranks_arange((4,))
+    mpx.send(x, dest=mpx.shift(1), tag=3)
+    s = mpx.Status()
+    # source=None adopts the queued send's routing; explicit source is
+    # validated against it
+    res, _ = mpx.recv(x, source=mpx.shift(-1), tag=3, status=s)
+    assert np.allclose(np.asarray(res)[:, 0], np.roll(np.arange(size), 1))
+    assert s.Get_tag() == 3
+    assert s.Get_count() == 4
+    assert s.Get_error() == 0
+
+
+def test_eager_recv_source_mismatch_raises():
+    _, size = world()
+    x = ranks_arange((1,))
+    mpx.send(x, dest=mpx.shift(1), tag=4)
+    with pytest.raises(ValueError, match="source spec"):
+        mpx.recv(x, source=mpx.shift(1), tag=4)
+    # a failed recv must NOT consume the message (MPI semantics): the
+    # corrected retry still matches the queued send
+    res, _ = mpx.recv(x, source=mpx.shift(-1), tag=4)
+    assert np.allclose(np.asarray(res)[:, 0], np.roll(np.arange(size), 1))
+    mpx.flush()
+
+
+def test_eager_send_traced_then_recv_outside_raises_clearly():
+    # a send traced inside jit whose trace has ended queues a dead tracer;
+    # a later recv — eager OR in a different trace — must raise the clear
+    # staleness error (and drop the unreceivable entry), not an opaque
+    # leaked-tracer failure
+    world()
+    x = ranks_arange((1,))
+
+    jax.jit(lambda a: (mpx.send(a, dest=mpx.shift(1), tag=77), a)[1])(x)
+    with pytest.raises(RuntimeError, match="trace has ended"):
+        mpx.recv(x, tag=77)
+    mpx.flush()  # the dead entry was dropped; nothing lingers
+
+    jax.jit(lambda a: (mpx.send(a, dest=mpx.shift(1), tag=78), a)[1])(x)
+    with pytest.raises(RuntimeError, match="trace has ended"):
+        jax.jit(lambda a: mpx.recv(a, tag=78)[0])(x)
+    mpx.flush()
+
+
+def test_eager_recv_bad_template_does_not_consume():
+    # a recv failing ANY argument check (here: dispatch's global-shape
+    # validation — element count matches but the leading rank axis is
+    # folded away) must leave the send matchable by a corrected retry
+    _, size = world()
+    x = ranks_arange((3,))
+    mpx.send(x, dest=mpx.shift(1), tag=21)
+    with pytest.raises(ValueError, match="leading rank axis"):
+        mpx.recv(jnp.zeros((size * 3,)), tag=21)
+    res, _ = mpx.recv(x, tag=21)
+    assert np.allclose(np.asarray(res)[:, 0], np.roll(np.arange(size), 1))
+    mpx.flush()
+
+
+def test_eager_recv_without_send_raises():
+    world()
+    x = ranks_arange((1,))
+    with pytest.raises(RuntimeError, match="no matching eager send"):
+        mpx.recv(x, tag=55)
+
+
+def test_eager_unmatched_send_raises_at_flush():
+    world()
+    x = ranks_arange((1,))
+    mpx.send(x, dest=mpx.shift(1), tag=66)
+    with pytest.raises(RuntimeError, match="unmatched eager send"):
+        mpx.flush()
+    # drain so the suite's own exit-time flush stays clean
+    mpx.recv(x, tag=66)
+    mpx.flush()
+
+
+def test_eager_send_recv_grad():
+    # the deferred pair is differentiable end-to-end like eager sendrecv:
+    # transpose of the emitted permute swaps source/dest
+    _, size = world()
+
+    def loss(x):
+        mpx.send(x, dest=mpx.shift(1), tag=9)
+        y, _ = mpx.recv(x, tag=9)
+        return (y**2).sum()
+
+    x = ranks_arange((2,))
+    g = jax.grad(loss)(x)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x))
