@@ -22,6 +22,14 @@ fully- and partially-masked rows, and the blockwise-merge invariant).
 Measured on one v5e chip (B=4, T=4096, H=8, D=128, causal, f32):
 9.5 ms/block = 28.8 TFLOP/s vs 15.8 ms for the XLA einsum+softmax path —
 1.66x, from keeping the 4096x4096 score tile out of HBM.
+
+End-to-end, the causal ring (examples/long_context_attention.py) skips
+fully-masked ring steps per rank (lax.cond) and drops masking on fully-
+visible blocks, so total causal FLOPs are n(n+1)/2 blocks instead of n^2.
+Measured 2.10x end-to-end speedup on the 8-rank test mesh (CPU — a ring
+needs multiple devices, which the single-chip TPU attach cannot host;
+per-block kernel throughput above is the on-chip number and is unchanged
+by the skip), with outputs within 1 ulp of the always-masked path.
 """
 
 import functools
