@@ -30,8 +30,16 @@ def main():
     t1 = 0.1 * DAY_IN_SECONDS
 
     # fast="auto": single-device runs use the fused whole-step Pallas
-    # kernel (model_step_pallas); multi-device meshes use model_step_fast
+    # kernel (model_step_pallas); multi-device meshes use the split-phase
+    # Pallas kernels with halo exchanges (model_step_pallas_halo)
     wall, n_steps = solve_fused(cfg, t1, devices=devices, fast="auto")
+
+    # second, 5x-longer run: the slope between the two cancels the fixed
+    # per-dispatch overhead (on a remote-attached chip the round-trip can
+    # reach ~0.1 s, a fifth of the short run's wall), giving the true
+    # on-chip per-step time — see docs/shallow_water.md "Roofline"
+    wall5, n_steps5 = solve_fused(cfg, 5 * t1, devices=devices, fast="auto")
+    per_step = (wall5 - wall) / (n_steps5 - n_steps)
 
     steps_per_sec_per_chip = n_steps / wall / len(devices)
     ref_gpu_wall = 6.28  # Tesla P100, 1 process (BASELINE.md)
@@ -51,15 +59,29 @@ def main():
                 "vs_baseline": round(ref_gpu_wall / wall, 3),
                 "state_traffic_gb_per_s": round(gbps, 1),
                 "wall_s": round(wall, 3),
-                # honesty marker for readers without docs context: one chip
-                # behind a remote-attach tunnel; ICI/interconnect numbers are
-                # unmeasurable here, and vs_baseline compares cross-era
-                # hardware (v5e-class chip vs 2016 P100)
+                **(
+                    {
+                        "onchip_steps_per_s_per_chip": round(
+                            1 / per_step / len(devices), 2
+                        ),
+                        "dispatch_overhead_s": round(
+                            wall - n_steps * per_step, 3
+                        ),
+                    }
+                    if per_step > 0
+                    else {}
+                ),
+                # honesty marker for readers without docs context: only
+                # observable facts about THIS run, plus the standing caveat
+                # that vs_baseline compares cross-era hardware (v5e-class
+                # chip vs 2016 P100); single-device runs add that no
+                # interconnect was measured (this repo's published numbers
+                # came from a remote-attached chip — docs/microbenchmarks.md)
                 "environment": (
-                    ("single-chip remote-attach (ICI unmeasurable); "
-                     if devices[0].platform == "tpu" and len(devices) == 1
-                     else f"{len(devices)}-device {devices[0].platform}; ")
-                    + "vs_baseline is cross-era hardware "
+                    f"{len(devices)}-device {devices[0].platform}"
+                    + ("; no interconnect measured"
+                       if len(devices) == 1 else "")
+                    + "; vs_baseline is cross-era hardware "
                     "(see docs/microbenchmarks.md)"
                 ),
             }

@@ -122,13 +122,15 @@ def main():
         print(json.dumps({
             "platform": devices[0].platform,
             "n_devices": n,
-            # honesty marker (docs/microbenchmarks.md): with one
-            # remote-attached chip these numbers time the attach tunnel
-            # round-trip, not the interconnect — ICI is unmeasurable here
+            # honesty marker (docs/microbenchmarks.md): with a single
+            # device there is no interconnect to measure, and dispatch/
+            # attach overhead can dominate the timings — never read 1-device
+            # numbers as link bandwidth or latency
             "environment": (
-                "single-chip remote-attach; tunnel-dominated timings; "
-                "ICI unmeasurable" if devices[0].platform == "tpu" and n == 1
-                else f"{n}-device {devices[0].platform}"
+                f"{n}-device {devices[0].platform}"
+                + ("; no interconnect to measure — timings may be "
+                   "dispatch/attach-dominated (docs/microbenchmarks.md)"
+                   if n == 1 else "")
             ),
             "allreduce": ar,
             "sendrecv_ring": pp,
