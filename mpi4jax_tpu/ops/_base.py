@@ -103,24 +103,56 @@ def combine_fn(op: OpLike) -> Callable:
     )
 
 
-def apply_allreduce(x, op: OpLike, axes: Tuple[str, ...]):
-    """All-reduce ``x`` over mesh axes with reduction ``op``.
+def apply_allreduce(x, op: OpLike, comm: Comm):
+    """All-reduce ``x`` over ``comm`` with reduction ``op``.
 
-    SUM/MIN/MAX: one native AllReduce HLO.  Others: AllGather + local reduce
-    (bandwidth-optimal on ICI for small payloads; XLA fuses the local
-    reduction).
+    Whole-axes comm, SUM/MIN/MAX: one native AllReduce HLO.  Other ops:
+    AllGather + local reduce (bandwidth-optimal on ICI for small payloads;
+    XLA fuses the local reduction).  Color-split comm (``comm.groups``):
+    AllGather over the full axes + a per-group masked fold — correct for
+    any partition incl. unequal group sizes, at O(world) bandwidth
+    (``axis_index_groups`` is unavailable under shard_map; see
+    ``Comm.Split``).
     """
+    axes = comm.axes
     x = as_varying(x, axes)
-    if isinstance(op, Op) and op in _NATIVE_COLLECTIVE:
-        return _NATIVE_COLLECTIVE[op](x, axes)
+    if comm.groups is None:
+        if isinstance(op, Op) and op in _NATIVE_COLLECTIVE:
+            return _NATIVE_COLLECTIVE[op](x, axes)
+        fn = combine_fn(op)
+        axis = axes[0] if len(axes) == 1 else axes
+        gathered = lax.all_gather(x, axis, axis=0, tiled=False)
+        # reduce over the leading (ranks) axis with a static fold; XLA
+        # unrolls and fuses this into vector ops
+        out = gathered[0]
+        for i in range(1, gathered.shape[0]):
+            out = fn(out, gathered[i])
+        return out
+
     fn = combine_fn(op)
     axis = axes[0] if len(axes) == 1 else axes
     gathered = lax.all_gather(x, axis, axis=0, tiled=False)
-    # reduce over the leading (ranks) axis with a static fold; XLA unrolls
-    # and fuses this into vector ops
-    out = gathered[0]
-    for i in range(1, gathered.shape[0]):
-        out = fn(out, gathered[i])
+    size = gathered.shape[0]
+    gid = [0] * size
+    first = [0] * size  # lowest global rank of each rank's group
+    for g, members in enumerate(comm.groups):
+        for r in members:
+            gid[r] = g
+            first[r] = min(members)
+    gid_t = jnp.asarray(gid)
+    grank = comm.global_rank()
+    my_gid = gid_t[grank]
+    my_first = jnp.asarray(first)[grank]
+    # fold the group's members in ascending GLOBAL rank order, seeded from
+    # the group's lowest rank — the identical sequence on every member, so
+    # non-commutative callable ops give one group-wide result (like the
+    # whole-axes fold above; MPI requires this determinism).  jnp.where
+    # keeps other groups' values — NaN included — out of the result.
+    out = jnp.take(gathered, my_first, axis=0)
+    for r in range(size):
+        contrib = fn(out, gathered[r])
+        same = (gid_t[r] == my_gid) & (my_first != r)
+        out = jnp.where(same, contrib, out)
     return out
 
 
@@ -205,6 +237,13 @@ _eager_cache: "OrderedDict" = OrderedDict()
 _EAGER_CACHE_MAX = 128
 
 
+# ops usable on a color-split comm (GroupComm): masked/gathered lowerings
+# exist and the output shape does not depend on the group size
+_GROUP_CAPABLE = frozenset(
+    {"allreduce", "reduce", "bcast", "barrier", "sendrecv", "send", "recv"}
+)
+
+
 def check_global_shape(opname: str, a, size: int) -> None:
     """Validate the eager global-array convention: leading axis = ranks."""
     if getattr(a, "ndim", 0) == 0 or a.shape[0] != size:
@@ -232,6 +271,15 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     comm = resolve_comm(comm)
     for a in arrays:
         check_dtype(a, opname)
+    if comm.groups is not None and opname not in _GROUP_CAPABLE:
+        raise NotImplementedError(
+            f"{opname} is not supported on a color-split comm: its output "
+            "shape would depend on the group size, which one SPMD program "
+            "cannot express per rank (same restriction as rank-dependent "
+            "shapes, docs/sharp_bits.md). Supported there: "
+            f"{sorted(_GROUP_CAPABLE)}. For grid-shaped groups use "
+            "comm.sub()/Split('axis') instead, which supports every op."
+        )
     if in_parallel_region(comm):
         # a pending tokenless barrier (see RegionContext.pending_sync) is
         # folded into this op's token so the op is ordered after it
@@ -258,7 +306,7 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
             "jax.shard_map, or bind the comm to a mesh (comm.bind(mesh))."
         )
 
-    size = comm.Get_size()
+    size = comm.world_size()
     for a in arrays:
         check_global_shape(opname, a, size)
 

@@ -40,7 +40,7 @@ def allreduce(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
         (xl,) = arrays
         xl = consume(token, xl)
         log_op("MPI_Allreduce", comm.Get_rank(), f"with {xl.size} items")
-        res = apply_allreduce(xl, op, comm.axes)
+        res = apply_allreduce(xl, op, comm)
         return res, produce(token, res)
 
     # custom callable ops are uncacheable: their captured state can change

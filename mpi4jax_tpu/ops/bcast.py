@@ -34,13 +34,26 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
 
     def body(comm, arrays, token):
         (xl,) = arrays
-        size = comm.Get_size()
+        size = comm.min_size()  # on a color split, root must fit EVERY group
         if not 0 <= root < size:
             raise ValueError(f"bcast root {root} out of range for size {size}")
         xl = consume(token, xl)
         rank = comm.Get_rank()
         log_op("MPI_Bcast", rank, f"{xl.size} items from root {root}")
-        if jnp.issubdtype(xl.dtype, jnp.bool_):
+        if comm.groups is not None:
+            # color split: AllGather over the full axes, then every rank
+            # picks its own group's root (static table, traced index) —
+            # one collective, any partition, no cross-group mixing
+            axes = comm.axes
+            axis = axes[0] if len(axes) == 1 else axes
+            gathered = lax.all_gather(xl, axis, axis=0, tiled=False)
+            root_glob = [0] * gathered.shape[0]
+            for members in comm.groups:
+                for r in members:
+                    root_glob[r] = members[root]
+            my_root = jnp.asarray(root_glob)[comm.global_rank()]
+            res = jnp.take(gathered, my_root, axis=0)
+        elif jnp.issubdtype(xl.dtype, jnp.bool_):
             masked = jnp.where(rank == root, xl.astype(jnp.uint8), 0)
             res = lax.psum(masked, comm.axes).astype(jnp.bool_)
         else:

@@ -83,9 +83,9 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
         payload = as_varying(consume(token, pending.value), comm.axes)
         log_op("MPI_Recv", comm.Get_rank(),
                f"{payload.size} items along {list(pending.pairs)} (tag {tag})")
-        res = _apply_permute(payload, template, pending.pairs, comm)
-        _fill_status(status, pending.pairs, comm, payload.size,
-                     payload.dtype, tag)
+        pairs = comm.expand_pairs(pending.pairs)  # local -> global
+        res = _apply_permute(payload, template, pairs, comm)
+        _fill_status(status, pairs, comm, payload.size, payload.dtype, tag)
         return res, produce(token, res)
 
     return dispatch("recv", comm, body, (x,), token)
@@ -122,7 +122,7 @@ def _eager_recv(x, source, tag, comm, status, token):
         raise RuntimeError(_STALE_SEND_MSG.format(tag=tag))
     size = comm.Get_size()
     _check_recv_match(pending, x, source, size)
-    pairs = pending.pairs
+    pairs = comm.expand_pairs(pending.pairs)  # local -> global
 
     def body(comm, arrays, token):
         xl, template = arrays

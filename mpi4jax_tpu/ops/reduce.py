@@ -31,13 +31,13 @@ def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None,
 
     def body(comm, arrays, token):
         (xl,) = arrays
-        size = comm.Get_size()
+        size = comm.min_size()  # on a color split, root must fit EVERY group
         if not 0 <= root < size:
             raise ValueError(f"reduce root {root} out of range for size {size}")
         xl = consume(token, xl)
-        rank = comm.Get_rank()
+        rank = comm.Get_rank()  # group-local on a color split, like the root
         log_op("MPI_Reduce", rank, f"{xl.size} items to root {root}")
-        reduced = apply_allreduce(xl, op, comm.axes)
+        reduced = apply_allreduce(xl, op, comm)
         res = jnp.where(rank == root, reduced, xl)
         return res, produce(token, res)
 

@@ -90,12 +90,13 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
         # later trace/eager context gets a clear staleness error
         # (ops/recv.py) instead of a leaked-tracer failure.
         check_dtype(x, "send")
-        size = c.Get_size()
-        check_global_shape("send", x, size)
-        pairs = normalize_dest(dest, size, what="send")
+        # global arrays span ALL ranks (world) even on a color-split comm;
+        # the routing spec is comm-local (group size)
+        check_global_shape("send", x, c.world_size())
+        pairs = normalize_dest(dest, c.Get_size(), what="send")
         log_op("MPI_Send", 0,
-               f"deferred: {x.size // size} items/rank along {list(pairs)} "
-               f"(tag {tag})")
+               f"deferred: {x.size // c.world_size()} items/rank along "
+               f"{list(pairs)} (tag {tag})")
         _eager_queue(c.uid, tag).append(PendingSend(x, pairs, None))
         # buffered-send semantics: nothing has moved yet, so the returned
         # token orders nothing beyond what the caller already had

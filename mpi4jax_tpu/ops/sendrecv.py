@@ -51,27 +51,31 @@ def _resolve_pairs(source, dest, size, what):
 
 
 def _apply_permute(xl, recvbuf, pairs, comm):
+    """Run one CollectivePermute along GLOBAL pairs (comm-local routing
+    specs are translated through ``comm.expand_pairs`` before this)."""
     permuted = lax.ppermute(xl, comm.axis, list(pairs))
     # the output is typed by the recv buffer (ref sendrecv.py:369-377
     # abstract eval): a message with a matching element count but different
     # shape — e.g. exchange-row-for-column — lands in recvbuf's shape
     permuted = permuted.reshape(recvbuf.shape)
     receivers = sorted(d for _, d in pairs)
-    if len(receivers) == comm.Get_size():
+    if len(receivers) == comm.world_size():
         return permuted
-    rank = comm.Get_rank()
+    rank = comm.global_rank()
     is_recv = jnp.isin(rank, jnp.asarray(receivers))
     return jnp.where(is_recv, permuted, recvbuf)
 
 
 def _fill_status(status, pairs, comm, count, dtype, tag):
+    """``pairs`` are GLOBAL; ``Status.source`` reports the comm-local rank
+    of the sender (on a color-split comm the two differ, per MPI)."""
     if status is None:
         return
-    rank = comm.Get_rank()
-    size = comm.Get_size()
+    rank = comm.global_rank()
+    size = comm.world_size()
     src_table = [-1] * size  # MPI_PROC_NULL analog for no-source ranks
     for s, d in pairs:
-        src_table[d] = s
+        src_table[d] = comm.local_rank_of(s)
     status.source = jnp.asarray(src_table)[rank]
     # the tag the matched message was sent with (ref recv.py:43-48 fills the
     # full MPI.Status); matching is SPMD-uniform so this is static
@@ -147,6 +151,7 @@ def sendrecv(
         pairs = resolved_pairs
         if pairs is None:
             pairs = _resolve_pairs(source, dest, comm.Get_size(), "sendrecv")
+        pairs = comm.expand_pairs(pairs)  # comm-local -> global (color split)
         xl = consume(token, xl)
         log_op("MPI_Sendrecv", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)}")

@@ -1,0 +1,272 @@
+"""Arbitrary communicator color splits (MPI_Comm_split parity).
+
+The reference marshals any mpi4py comm, including color splits
+(ref mpi4jax/_src/utils.py:80-96); the grid form was already covered by
+``comm.sub``.  This file pins the color form: ``comm.Split(colors, key)``
+returns a GroupComm whose collectives are masked/gathered over the full
+mesh axes (``axis_index_groups`` is unavailable under shard_map — verified
+NotImplementedError on jax 0.9, see parallel/comm.py), correct for
+non-Cartesian and unequal-sized groups.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.parallel.comm import GroupComm
+from helpers import per_rank, ranks_arange, world
+
+# the VERDICT-shaped example: a non-Cartesian, UNEQUAL 2-group partition
+GROUPS_2 = ((0, 3, 5), (1, 2, 4, 6, 7))
+COLORS_2 = [0, 1, 1, 0, 1, 0, 1, 1]
+# a uniform non-Cartesian partition (evens/odds)
+COLORS_EO = [r % 2 for r in range(8)]
+
+
+def _expected_groupwise(vals, groups, fn):
+    out = np.empty_like(np.asarray(vals))
+    for g in groups:
+        red = fn([vals[r] for r in g])
+        for r in g:
+            out[r] = red
+    return out
+
+
+def test_split_returns_groupcomm_with_mpi_ordering():
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+    assert isinstance(split, GroupComm)
+    assert split.groups == GROUPS_2
+    # key reorders within a group, ties broken by rank (MPI rule)
+    keyed = comm.Split([0] * size, key=list(range(size))[::-1])
+    assert keyed.groups == (tuple(range(size))[::-1],)
+
+
+def test_split_allreduce_nonuniform_groups():
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+
+    @mpx.spmd
+    def f(x):
+        s, _ = mpx.allreduce(x, op=mpx.SUM, comm=split)
+        m, _ = mpx.allreduce(x, op=mpx.MAX, comm=split)
+        return s, m
+
+    x = ranks_arange((2,))
+    s, m = f(x)
+    vals = np.arange(size, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(s)[:, 0], _expected_groupwise(vals, GROUPS_2, sum))
+    np.testing.assert_allclose(
+        np.asarray(m)[:, 0], _expected_groupwise(vals, GROUPS_2, max))
+
+
+def test_split_bcast_and_reduce_nonuniform():
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+
+    @mpx.spmd
+    def f(x):
+        b, t = mpx.bcast(x, 1, comm=split)  # group-local root 1
+        r, _ = mpx.reduce(x, mpx.SUM, 0, comm=split, token=t)
+        return b, r
+
+    x = ranks_arange((1,))
+    b, r = f(x)
+    # bcast: every rank gets its group's local-rank-1 member's value
+    exp_b = np.empty(size, np.float32)
+    exp_r = np.arange(size, dtype=np.float32)  # non-root keeps input
+    for g in GROUPS_2:
+        exp_b[list(g)] = g[1]
+        exp_r[g[0]] = sum(g)  # local root 0 gets the group sum
+    np.testing.assert_allclose(np.asarray(b)[:, 0], exp_b)
+    np.testing.assert_allclose(np.asarray(r)[:, 0], exp_r)
+
+
+def test_split_rank_size_and_barrier():
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+    with pytest.raises(RuntimeError, match="unequal group sizes"):
+        split.Get_size()
+    uniform = comm.Split(COLORS_EO)
+    assert uniform.Get_size() == size // 2
+
+    @mpx.spmd
+    def f(x):
+        t = mpx.barrier(comm=split)
+        r = split.Get_rank()
+        return mpx.varying(jnp.asarray(r, jnp.float32))[None], t.value
+
+    r, _ = f(ranks_arange((1,)))
+    exp = np.empty(size, np.float32)
+    for g in GROUPS_2:
+        for i, rank in enumerate(g):
+            exp[rank] = i
+    np.testing.assert_allclose(np.asarray(r)[:, 0], exp)
+
+
+def test_split_sendrecv_ring_within_groups():
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+
+    @mpx.spmd
+    def f(x):
+        y, _ = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=split)
+        return y
+
+    out = np.asarray(f(ranks_arange((1,))))[:, 0]
+    # each group is an independent ring: evens rotate among evens, odds
+    # among odds
+    exp = np.empty(size, np.float32)
+    for g in ((0, 2, 4, 6), (1, 3, 5, 7)):
+        for i, rank in enumerate(g):
+            exp[g[(i + 1) % len(g)]] = rank
+    np.testing.assert_allclose(out, exp)
+
+
+def test_split_send_recv_and_status():
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+
+    @mpx.spmd
+    def f(x):
+        s = mpx.Status()
+        t = mpx.send(x, dest=mpx.shift(1), comm=split, tag=2)
+        y, _ = mpx.recv(x, comm=split, tag=2, status=s, token=t)
+        return y, s.Get_source()
+
+    y, src = f(ranks_arange((1,)))
+    n_loc = size // 2
+    # Status.source is the GROUP-LOCAL rank of the sender (MPI semantics):
+    # rank at local index i received from local index (i - 1) % n_loc
+    exp_src = np.empty(size, np.int64)
+    exp = np.empty(size, np.float32)
+    for g in ((0, 2, 4, 6), (1, 3, 5, 7)):
+        for i, rank in enumerate(g):
+            exp_src[rank] = (i - 1) % n_loc
+            exp[g[(i + 1) % len(g)]] = rank
+    np.testing.assert_allclose(np.asarray(src), exp_src)
+    np.testing.assert_allclose(np.asarray(y)[:, 0], exp)
+
+
+def test_split_eager_allreduce():
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+    s, _ = mpx.allreduce(ranks_arange((1,)), op=mpx.SUM, comm=split)
+    vals = np.arange(size, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(s)[:, 0], _expected_groupwise(vals, GROUPS_2, sum))
+
+
+def test_split_grad_through_group_allreduce():
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+
+    def loss(x):
+        @mpx.spmd
+        def f(xl):
+            s, _ = mpx.allreduce(xl, op=mpx.SUM, comm=split)
+            return jnp.sum(s ** 2)
+
+        return jnp.sum(f(x))
+
+    x = per_rank(lambda r: jnp.full((1,), float(r + 1)))
+    g = np.asarray(jax.grad(loss)(x))[:, 0]
+    # d/dx_r sum_ranks (group_sum)^2 = 2 * |group| * group_sum
+    vals = np.arange(1, size + 1, dtype=np.float32)
+    exp = np.empty(size, np.float32)
+    for grp in GROUPS_2:
+        s = sum(vals[r] for r in grp)
+        for r in grp:
+            exp[r] = 2 * len(grp) * s
+    np.testing.assert_allclose(g, exp, rtol=1e-6)
+
+
+def test_split_gather_family_raises():
+    comm, _ = world()
+    split = comm.Split(COLORS_EO)
+    with pytest.raises(NotImplementedError, match="color-split"):
+        mpx.allgather(ranks_arange((1,)), comm=split)
+    with pytest.raises(NotImplementedError, match="color-split"):
+        mpx.scan(ranks_arange((1,)), mpx.SUM, comm=split)
+
+
+def test_split_validation_errors():
+    comm, size = world()
+    with pytest.raises(ValueError, match="every rank's color"):
+        comm.Split([0, 1])
+    with pytest.raises(ValueError, match="one entry per rank"):
+        comm.Split([0] * size, key=[0])
+    split = comm.Split(COLORS_EO)
+    with pytest.raises(ValueError, match="nested Split"):
+        split.Split([0] * (size // 2))
+    with pytest.raises(ValueError, match="sub\\(\\) on a color-split"):
+        split.sub("x")
+
+
+def test_split_axis_string_form_unchanged():
+    # the pre-existing Cartesian form must keep working
+    mesh = mpx.make_world_mesh((2, 4), ("sy", "sx"))
+    comm = mpx.Comm(("sy", "sx"), mesh=mesh)
+    rows = comm.Split("sy")  # drop sy -> row comm over sx
+    assert rows.axes == ("sx",)
+    assert not isinstance(rows, GroupComm)
+
+
+def test_split_clone_isolates_matching():
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+    clone = split.Clone()
+    assert isinstance(clone, GroupComm)
+    assert clone.groups == split.groups
+    assert clone.uid != split.uid
+
+
+def test_split_eager_send_recv():
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+    # eager global arrays span ALL ranks even on a color-split comm; the
+    # routing spec is group-local
+    x = ranks_arange((1,))
+    t = mpx.send(x, dest=mpx.shift(1), comm=split, tag=5)
+    y, _ = mpx.recv(x, comm=split, tag=5, token=t)
+    exp = np.empty(size, np.float32)
+    for g in ((0, 2, 4, 6), (1, 3, 5, 7)):
+        for i, rank in enumerate(g):
+            exp[g[(i + 1) % len(g)]] = rank
+    np.testing.assert_allclose(np.asarray(y)[:, 0], exp)
+
+
+def test_split_bind_preserves_groups():
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+    bound = split.bind(split.mesh)
+    assert isinstance(bound, GroupComm)
+    assert bound.groups == split.groups
+    assert bound.uid == split.uid
+    s, _ = mpx.allreduce(ranks_arange((1,)), op=mpx.SUM, comm=bound)
+    vals = np.arange(size, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(s)[:, 0], _expected_groupwise(vals, GROUPS_2, sum))
+
+
+def test_split_allreduce_noncommutative_op_group_consistent():
+    # a callable op need not be commutative; every member of a group must
+    # still receive the SAME result (fold in a fixed group-wide order,
+    # seeded from the group's lowest rank — like the whole-axes path)
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+
+    @mpx.spmd
+    def f(x):
+        s, _ = mpx.allreduce(x, op=lambda a, b: a - b, comm=split)
+        return s
+
+    out = np.asarray(f(ranks_arange((1,))))[:, 0]
+    for g in ((0, 2, 4, 6), (1, 3, 5, 7)):
+        acc = float(g[0])
+        for r in g[1:]:
+            acc -= r
+        np.testing.assert_allclose(out[list(g)], acc)
