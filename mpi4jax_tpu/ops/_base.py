@@ -237,11 +237,15 @@ _eager_cache: "OrderedDict" = OrderedDict()
 _EAGER_CACHE_MAX = 128
 
 
-# ops usable on a color-split comm (GroupComm): masked/gathered lowerings
-# exist and the output shape does not depend on the group size
-_GROUP_CAPABLE = frozenset(
-    {"allreduce", "reduce", "bcast", "barrier", "sendrecv", "send", "recv"}
-)
+def group_select_gather(comm: Comm, xl):
+    """AllGather over the comm's FULL mesh axes, then select this rank's
+    group members in group order: output ``(group_size, *xl.shape)``.
+
+    The shared first step of every gather-family group lowering on a
+    color-split comm (uniform group sizes only — ``my_group_members``
+    raises the clear error otherwise)."""
+    full = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
+    return jnp.take(full, comm.my_group_members(), axis=0)
 
 
 def check_global_shape(opname: str, a, size: int) -> None:
@@ -271,15 +275,6 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     comm = resolve_comm(comm)
     for a in arrays:
         check_dtype(a, opname)
-    if comm.groups is not None and opname not in _GROUP_CAPABLE:
-        raise NotImplementedError(
-            f"{opname} is not supported on a color-split comm: its output "
-            "shape would depend on the group size, which one SPMD program "
-            "cannot express per rank (same restriction as rank-dependent "
-            "shapes, docs/sharp_bits.md). Supported there: "
-            f"{sorted(_GROUP_CAPABLE)}. For grid-shaped groups use "
-            "comm.sub()/Split('axis') instead, which supports every op."
-        )
     if in_parallel_region(comm):
         # a pending tokenless barrier (see RegionContext.pending_sync) is
         # folded into this op's token so the op is ordered after it

@@ -12,7 +12,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch
+from ._base import dispatch, group_select_gather
 from .token import Token, consume, produce
 
 
@@ -27,7 +27,11 @@ def allgather(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
         (xl,) = arrays
         xl = consume(token, xl)
         log_op("MPI_Allgather", comm.Get_rank(), f"sending {xl.size} items")
-        res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
+        if comm.groups is not None:
+            # color split (uniform group sizes): output (group_size, *s)
+            res = group_select_gather(comm, xl)
+        else:
+            res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
         return res, produce(token, res)
 
     return dispatch("allgather", comm, body, (x,), token, static_key=())

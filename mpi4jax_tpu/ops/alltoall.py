@@ -15,7 +15,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch
+from ._base import dispatch, group_select_gather
 from .token import Token, consume, produce
 
 
@@ -37,7 +37,15 @@ def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
             )
         xl = consume(token, xl)
         log_op("MPI_Alltoall", comm.Get_rank(), f"sending {xl.size} items")
-        res = lax.all_to_all(xl, comm.axis, split_axis=0, concat_axis=0)
+        if comm.groups is not None:
+            # color split (uniform): out[j] = group-member j's row
+            # addressed to this rank's group-local index
+            import jax.numpy as jnp
+
+            sel = group_select_gather(comm, xl)
+            res = jnp.take(sel, comm.Get_rank(), axis=1)
+        else:
+            res = lax.all_to_all(xl, comm.axis, split_axis=0, concat_axis=0)
         return res, produce(token, res)
 
     return dispatch("alltoall", comm, body, (x,), token, static_key=())

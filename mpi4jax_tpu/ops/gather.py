@@ -18,7 +18,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch
+from ._base import dispatch, group_select_gather
 from .token import Token, consume, produce
 
 
@@ -39,9 +39,14 @@ def gather(x, root: int, *, comm: Optional[Comm] = None,
         xl = consume(token, xl)
         log_op("MPI_Gather", comm.Get_rank(),
                f"sending {xl.size} items to root {root}")
-        # multi-axis comms gather in row-major rank order (axis tuples are
-        # supported natively by the AllGather lowering)
-        res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
+        if comm.groups is not None:
+            # color split (uniform): same uniform-shape divergence as the
+            # whole-axes form, selected per group
+            res = group_select_gather(comm, xl)
+        else:
+            # multi-axis comms gather in row-major rank order (axis tuples
+            # are supported natively by the AllGather lowering)
+            res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
         return res, produce(token, res)
 
     return dispatch("gather", comm, body, (x,), token, static_key=(root,))

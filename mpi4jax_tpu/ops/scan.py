@@ -42,8 +42,11 @@ def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
         acc = xl
         d = 1
         while d < size:
-            # rank r-d sends its accumulator to rank r (for r >= d)
-            perm = [(r - d, r) for r in range(d, size)]
+            # rank r-d sends its accumulator to rank r (for r >= d); on a
+            # color split the pairs are group-local and expand to one
+            # global permute per round (rank is group-local there too, so
+            # the participation mask needs no change)
+            perm = comm.expand_pairs([(r - d, r) for r in range(d, size)])
             recvd = lax.ppermute(acc, comm.axis, perm)
             acc = jnp.where(rank >= d, fn(acc, recvd), acc)
             d *= 2

@@ -16,7 +16,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch
+from ._base import dispatch, group_select_gather
 from .token import Token, consume, produce
 
 
@@ -42,9 +42,20 @@ def scatter(x, root: int, *, comm: Optional[Comm] = None,
         xl = consume(token, xl)
         log_op("MPI_Scatter", comm.Get_rank(),
                f"receiving {xl.size // size} items from root {root}")
-        # all_to_all: out[i] = rank i's slice addressed to us; keep root's
-        exchanged = lax.all_to_all(xl, comm.axis, split_axis=0, concat_axis=0)
-        res = exchanged[root]
+        if comm.groups is not None:
+            # color split (uniform): pick the group root's buffer, then
+            # this rank's group-local row
+            import jax.numpy as jnp
+
+            sel = group_select_gather(comm, xl)
+            res = jnp.take(jnp.take(sel, root, axis=0),
+                           comm.Get_rank(), axis=0)
+        else:
+            # all_to_all: out[i] = rank i's slice addressed to us; keep
+            # root's
+            exchanged = lax.all_to_all(xl, comm.axis, split_axis=0,
+                                       concat_axis=0)
+            res = exchanged[root]
         return res, produce(token, res)
 
     return dispatch("scatter", comm, body, (x,), token, static_key=(root,))

@@ -255,11 +255,13 @@ class GroupComm(Comm):
     the parent's full mesh axes with masking/gathering, so any partition
     works — including non-Cartesian and unequal-sized groups — at O(world)
     bandwidth.  ``Get_rank``/``Get_size`` follow MPI: group-local rank and
-    group size.  Supported ops: allreduce, reduce, bcast, barrier, and the
-    point-to-point family (uniform group sizes only, since routing specs
-    are group-local and static); the gather family raises (its output
-    shape would have to vary per group, which one SPMD program cannot
-    express — the same restriction documented for rank-dependent shapes).
+    group size.  All 12 ops work on UNIFORM group sizes;
+    allreduce/reduce/bcast/barrier additionally work on unequal-sized
+    partitions.  Ops whose routing or output shape needs a static group
+    size (point-to-point, the gather family, scan) raise ``Get_size``'s
+    clear error on unequal groups — one SPMD program cannot express a
+    per-group shape (the rank-dependent-shape restriction,
+    docs/sharp_bits.md).
     """
 
     def __init__(self, parent: Comm, groups):
@@ -317,6 +319,16 @@ class GroupComm(Comm):
 
     def local_rank_of(self, r: int) -> int:
         return self._lrank[r]
+
+    def my_group_members(self):
+        """Traced ``(group_size,)`` vector of this rank's group's global
+        ranks, in group order — the index table the gather-family group
+        lowerings select with (uniform group sizes only)."""
+        import jax.numpy as jnp
+
+        self.Get_size()  # uniform-size check with the clear error
+        mat = jnp.asarray(self._groups)
+        return mat[jnp.asarray(self._gid)[self.global_rank()]]
 
     def expand_pairs(self, pairs):
         """Group-local (send, recv) pairs -> global pairs, applied to every

@@ -184,12 +184,62 @@ def test_split_grad_through_group_allreduce():
     np.testing.assert_allclose(g, exp, rtol=1e-6)
 
 
-def test_split_gather_family_raises():
-    comm, _ = world()
+def test_split_gather_family_uniform_groups():
+    # on UNIFORM groups every op works: the gathered output shape
+    # (group_size, *s) is the same on all ranks
+    comm, size = world()
     split = comm.Split(COLORS_EO)
-    with pytest.raises(NotImplementedError, match="color-split"):
+    gs = size // 2
+    groups = ((0, 2, 4, 6), (1, 3, 5, 7))
+
+    @mpx.spmd
+    def f(x):
+        ag, t = mpx.allgather(x, comm=split)
+        g, t = mpx.gather(x, 1, comm=split, token=t)
+        sc, t = mpx.scan(x, mpx.SUM, comm=split, token=t)
+        return ag, g, sc
+
+    ag, g, sc = f(ranks_arange((1,)))
+    for grp in groups:
+        for i, rank in enumerate(grp):
+            np.testing.assert_allclose(np.asarray(ag)[rank, :, 0], grp)
+            np.testing.assert_allclose(np.asarray(g)[rank, :, 0], grp)
+            # inclusive prefix over group order
+            np.testing.assert_allclose(
+                np.asarray(sc)[rank, 0], sum(grp[: i + 1]))
+
+
+def test_split_alltoall_and_scatter_uniform_groups():
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+    gs = size // 2
+    groups = ((0, 2, 4, 6), (1, 3, 5, 7))
+    # x[r, j] = 10*r + j: rank r's slice addressed to group-local index j
+    x = per_rank(lambda r: 10.0 * r + np.arange(gs, dtype=np.float32))
+
+    @mpx.spmd
+    def f(x):
+        a2a, t = mpx.alltoall(x, comm=split)
+        sct, _ = mpx.scatter(x, 2, comm=split, token=t)  # group root 2
+        return a2a, sct
+
+    a2a, sct = f(x)
+    for grp in groups:
+        for i, rank in enumerate(grp):
+            # alltoall: out[j] = member j's row i
+            np.testing.assert_allclose(
+                np.asarray(a2a)[rank, :, ], [10.0 * m + i for m in grp])
+            # scatter from group-local root 2: out = root's row i
+            np.testing.assert_allclose(
+                np.asarray(sct)[rank], 10.0 * grp[2] + i)
+
+
+def test_split_gather_family_nonuniform_raises():
+    comm, _ = world()
+    split = comm.Split(COLORS_2)
+    with pytest.raises(RuntimeError, match="unequal group sizes"):
         mpx.allgather(ranks_arange((1,)), comm=split)
-    with pytest.raises(NotImplementedError, match="color-split"):
+    with pytest.raises(RuntimeError, match="unequal group sizes"):
         mpx.scan(ranks_arange((1,)), mpx.SUM, comm=split)
 
 
