@@ -865,8 +865,15 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     assert cfg.nproc == 1 and cfg.periodic_x, (
         "model_step_pallas: single-rank periodic-x only; use model_step_fast"
     )
-    assert nsteps in (1, 2)
-    mrg = 8 * nsteps  # one sublane tile of validity per fused step
+    # nsteps=4 exceeds the chip's VMEM/compiler limits at benchmark width
+    assert nsteps in (1, 2, 3)
+    # one sublane tile of validity per fused step, rounded up to a divisor
+    # of _PBLK — the prev/next margin index maps address mrg-row blocks as
+    # i * (_PBLK // mrg), which only lands on block starts when mrg
+    # divides _PBLK (nsteps=3: 24 -> 32)
+    mrg = 8 * nsteps
+    while _PBLK % mrg:
+        mrg += 8
     import jax.experimental.pallas as pl
 
     if interpret is None:
@@ -925,12 +932,24 @@ def model_step2_pallas(state: State, cfg: Config, comm: mpx.Comm,
                        first_step: bool, interpret=None) -> State:
     """TWO model steps in one Pallas kernel call (``model_step_pallas``
     with ``nsteps=2``): halves the per-step HBM traffic and the grid
-    dispatch count.  Measured effect on this chip is small (~900 steps/s
-    either way — the kernel is VPU-compute-bound, see
-    docs/shallow_water.md), but the pair costs nothing and is the shipped
-    ``"auto"`` path."""
+    dispatch count.  Amortized (dispatch-constant-cancelled) measurement:
+    992 -> 870 µs/step over the single-step kernel."""
     return model_step_pallas(state, cfg, comm, first_step,
                              interpret=interpret, nsteps=2)
+
+
+def model_step3_pallas(state: State, cfg: Config, comm: mpx.Comm,
+                       first_step: bool, interpret=None) -> State:
+    """THREE model steps per kernel call.  NOT the shipped depth: the
+    margin must divide ``_PBLK`` so three steps need 32 margin rows (not
+    24), and the measured margin-recompute overhead (192-row windows per
+    128 stored rows) outweighs the HBM saving — narrower blocks
+    (96 + 2·24) measured 859 µs/step vs the pair kernel's 870, within
+    noise, and the 192-row window fails to compile at benchmark width.
+    Kept as an explicit mode because the depth generalization is tested
+    and useful at smaller widths; ``"auto"`` ships the pair."""
+    return model_step_pallas(state, cfg, comm, first_step,
+                             interpret=interpret, nsteps=3)
 
 
 # ---------------------------------------------------------------------------
@@ -1100,26 +1119,27 @@ def select_step(fast, cfg: Config = None):
 
     - ``False`` — the reference-structured step (parity oracle);
     - ``True`` — ``model_step_fast`` (works on any mesh);
-    - ``"pallas"`` / ``"pallas2"`` — the fused whole-step Pallas kernel
-      (single-rank periodic-x only; asserts otherwise); ``"pallas2"``
-      additionally fuses step *pairs* (see ``select_steps``);
+    - ``"pallas"`` / ``"pallas2"`` / ``"pallas3"`` — the fused whole-step
+      Pallas kernel (single-rank periodic-x only; asserts otherwise);
+      ``"pallas2"``/``"pallas3"`` additionally fuse 2/3 steps per kernel
+      call (see ``select_steps``);
     - ``"pallas_halo"`` — the split-phase Pallas kernels with real halo
       exchanges between them (any mesh, ``model_step_pallas_halo``);
     - ``"auto"`` — ``"pallas2"`` when ``cfg`` is a single-rank periodic-x
       decomposition (the benchmark configuration), else ``"pallas_halo"``.
 
-    Returns the SINGLE-step callable; drivers that can batch steps in
-    pairs use ``select_steps`` to also obtain the pair kernel.
+    Returns the SINGLE-step callable; drivers that can batch steps use
+    ``select_steps`` to also obtain the multi-step chunk kernel.
     """
     return select_steps(fast, cfg)[0]
 
 
 def select_steps(fast, cfg: Config = None):
-    """``(single_step, pair_step_or_None)`` behind ``fast`` (see
-    ``select_step`` for the mode table).  ``pair_step`` advances two model
-    steps per call and is only offered for the Pallas pair mode; callers
-    use it for even runs of steps and fall back to ``single_step`` for
-    the first (Euler) step and odd remainders."""
+    """``(single_step, chunk_step_or_None, chunk_size)`` behind ``fast``
+    (see ``select_step`` for the mode table).  ``chunk_step`` advances
+    ``chunk_size`` model steps per call and is only offered for the fused
+    Pallas chunk modes; callers use it for whole chunks and fall back to
+    ``single_step`` for the first (Euler) step and remainders."""
     if fast == "auto":
         if cfg is None:
             raise ValueError(
@@ -1127,16 +1147,20 @@ def select_steps(fast, cfg: Config = None):
                 "eligibility — pass cfg"
             )
         # whole-step kernel where eligible (no exchanges at all); the
-        # split-phase kernel everywhere else (multi-rank meshes, walls)
+        # split-phase kernel everywhere else (multi-rank meshes, walls).
+        # Pair depth: deeper fusion measured no better (see
+        # model_step3_pallas) and fails to compile at benchmark width.
         fast = ("pallas2" if cfg.nproc == 1 and cfg.periodic_x
                 else "pallas_halo")
+    if fast == "pallas3":
+        return model_step_pallas, model_step3_pallas, 3
     if fast == "pallas2":
-        return model_step_pallas, model_step2_pallas
+        return model_step_pallas, model_step2_pallas, 2
     if fast == "pallas":
-        return model_step_pallas, None
+        return model_step_pallas, None, 1
     if fast == "pallas_halo":
-        return model_step_pallas_halo, None
-    return (model_step_fast if fast else model_step), None
+        return model_step_pallas_halo, None, 1
+    return (model_step_fast if fast else model_step), None, 1
 
 
 def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
@@ -1146,13 +1170,13 @@ def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
 
     ``fast`` selects the TPU-restructured step (``model_step_fast``,
     default); ``fast=False`` keeps the reference-structured step;
-    ``"pallas"``/``"pallas2"``/``"auto"`` select the fused whole-step
-    kernel (see ``select_steps``) — all verified equal in
+    ``"pallas"``/``"pallas2"``/``"pallas3"``/``"auto"`` select the fused
+    whole-step kernel (see ``select_steps``) — all verified equal in
     tests/test_examples.py.  ``multistep`` advances exactly ``num_steps``
-    steps in every mode (the pair kernel handles even runs; an odd
-    remainder falls back to one single-step call).
+    steps in every mode (the chunk kernel handles whole chunks; the
+    remainder falls back to single-step calls).
     """
-    step, pair = select_steps(fast, cfg)
+    step, chunk, chunk_size = select_steps(fast, cfg)
 
     @partial(mpx.spmd, comm=comm)
     def first_step(state: State) -> State:
@@ -1160,22 +1184,25 @@ def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
 
     @partial(mpx.spmd, comm=comm, static_argnums=(1,))
     def multistep(state: State, num_steps: int) -> State:
-        state = _run_steps(state, num_steps, cfg, comm, step, pair)
+        state = _run_steps(state, num_steps, cfg, comm, step, chunk,
+                           chunk_size)
         return state
 
     return first_step, multistep
 
 
-def _run_steps(state: State, num_steps: int, cfg, comm, step, pair) -> State:
-    """Advance ``num_steps`` non-first steps, using the pair kernel for
-    even runs when available (``num_steps`` is static)."""
-    if pair is not None:
-        npairs, rem = divmod(num_steps, 2)
-        if npairs:  # fori_loop(0, 0) would still trace the pair kernel
+def _run_steps(state: State, num_steps: int, cfg, comm, step, chunk,
+               chunk_size: int) -> State:
+    """Advance ``num_steps`` non-first steps, using the chunk kernel for
+    whole ``chunk_size``-step runs when available (``num_steps`` is
+    static; the remainder is at most ``chunk_size - 1`` single steps)."""
+    if chunk is not None:
+        nchunks, rem = divmod(num_steps, chunk_size)
+        if nchunks:  # fori_loop(0, 0) would still trace the chunk kernel
             state = jax.lax.fori_loop(
-                0, npairs, lambda _, s: pair(s, cfg, comm, False), state
+                0, nchunks, lambda _, s: chunk(s, cfg, comm, False), state
             )
-        if rem:
+        for _ in range(rem):
             state = step(state, cfg, comm, False)
         return state
     return jax.lax.fori_loop(
@@ -1248,12 +1275,12 @@ def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
     mesh, comm = make_mesh_and_comm(cfg, devices=devices)
     n_iters = max(0, math.ceil((t1 - cfg.dt) / (cfg.dt * num_multisteps)))
     n_steps = 1 + n_iters * num_multisteps
-    step, pair = select_steps(fast, cfg)
+    step, chunk, chunk_size = select_steps(fast, cfg)
 
     @partial(mpx.spmd, comm=comm, static_argnums=(1,))
     def fused(state: State, total: int) -> State:
         state = step(state, cfg, comm, first_step=True)
-        return _run_steps(state, total, cfg, comm, step, pair)
+        return _run_steps(state, total, cfg, comm, step, chunk, chunk_size)
 
     state = initial_state(cfg)
     # sync points fetch ONE element: on remote-attached devices a full-array

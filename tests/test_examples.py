@@ -157,22 +157,26 @@ def _pallas_grid_cases():
     ]
 
 
+@pytest.mark.parametrize("mode,steps", [
+    ("pallas2", (10, 11)),  # whole pairs; pair + odd single remainder
+    ("pallas3", (9, 11)),   # whole triples; triples + 2-single remainder
+])
 @pytest.mark.parametrize("ny,nx", _pallas_grid_cases())
-def test_pallas_pair_step_matches_fast_steps(ny, nx):
-    """The pair kernel (2 fused steps per call, 16-row margins) must
-    reproduce model_step_fast over runs that mix the single first step,
-    pair calls, and an odd-remainder single call — 11 steps = 1 first +
-    5 pairs; 12 steps adds the odd fallback inside multistep."""
+def test_pallas_chunk_step_matches_fast_steps(ny, nx, mode, steps):
+    """The chunk kernels (2 or 3 fused steps per call; margins of 8 rows
+    per fused step rounded up to a divisor of _PBLK — 16 for pairs, 32
+    for triples) must reproduce model_step_fast over runs that mix the
+    single first step, whole chunk calls, and single-step remainders."""
     from shallow_water import make_mesh_and_comm, make_stepper
 
     cfg = Config(nproc_y=1, nproc_x=1, nx=nx, ny=ny)
     devices = jax.devices()[:1]
     _, comm = make_mesh_and_comm(cfg, devices=devices)
     first_fast, multi_fast = make_stepper(cfg, comm, fast=True)
-    first_pal, multi_pal = make_stepper(cfg, comm, fast="pallas2")
+    first_pal, multi_pal = make_stepper(cfg, comm, fast=mode)
 
     s0 = initial_state(cfg)
-    for nsteps in (10, 11):  # even (pairs only) and odd (pair + single)
+    for nsteps in steps:
         fast = multi_fast(first_fast(s0), nsteps)
         pal = multi_pal(first_pal(s0), nsteps)
         for name, a, b in zip(fast._fields, fast, pal):

@@ -267,3 +267,16 @@ def test_prefer_notoken_skips_token_chains(monkeypatch):
 
     out = np.asarray(f(jnp.ones((SIZE, 1), jnp.float32)))
     assert (out == SIZE).all()
+
+
+def test_notoken_eager_send_recv_deferred_pairing():
+    # the tokenless API inherits standalone eager send/recv (deferred
+    # pairing, ops/send.py): send queues, recv emits the fused permute
+    from helpers import ranks_arange, world
+
+    _, size = world()
+    x = ranks_arange((2,))
+    notoken.send(x, dest=mpx.shift(1), tag=31)
+    res = notoken.recv(x, tag=31)
+    assert np.allclose(np.asarray(res)[:, 0], np.roll(np.arange(size), 1))
+    mpx.flush()
