@@ -189,7 +189,6 @@ def test_split_gather_family_uniform_groups():
     # (group_size, *s) is the same on all ranks
     comm, size = world()
     split = comm.Split(COLORS_EO)
-    gs = size // 2
     groups = ((0, 2, 4, 6), (1, 3, 5, 7))
 
     @mpx.spmd
