@@ -94,7 +94,11 @@ WORKER = textwrap.dedent(
         nx=4 * (size // nproc_y), ny=8 * nproc_y,
     )
     mesh, comm = make_mesh_and_comm(cfg)
-    first, multi = make_stepper(cfg, comm)
+    # fast="auto" selects the shipped multi-rank mode
+    # (model_step_pallas_halo; on this CPU worker its interpret fallback —
+    # same math, no Pallas machinery) with the sendrecv halo exchanges
+    # crossing real process boundaries
+    first, multi = make_stepper(cfg, comm, fast="auto")
     state = multi(first(initial_state(cfg)), 3)
     for s in state.h.addressable_shards:
         block = np.asarray(s.data)
