@@ -181,3 +181,41 @@ def test_merge_with_fully_masked_block_is_identity():
     np.testing.assert_allclose(np.asarray(acc), np.asarray(o1), rtol=1e-7)
     np.testing.assert_array_equal(np.asarray(m), np.asarray(m1))
     np.testing.assert_allclose(np.asarray(l), np.asarray(l1), rtol=1e-7)
+
+
+@pytest.mark.parametrize(
+    "b,t,h,d",
+    [
+        (2, 16, 4, 32),     # single q tile
+        (1, 1024, 1, 32),   # two full tiles (exercises the fori_loop)
+        (1, 1100, 1, 32),   # ragged final tile (K/V padding + kpos guard)
+    ],
+)
+def test_causal_kernel_matches_tril_mask(b, t, h, d):
+    """causal=True (the key-tile-skipping kernel) must equal the general
+    kernel/jnp path given the equivalent triangular mask, including across
+    tile boundaries and ragged tails."""
+    q, k, v = _qkv(3, b, t, t, h, d)
+    scale = 1.0 / math.sqrt(d)
+    o_c, m_c, l_c = flash_block_partials(q, k, v, None, scale=scale,
+                                         causal=True, interpret=True)
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    o_j, m_j, l_j = flash_block_partials(q, k, v, mask, scale=scale,
+                                         force_jnp=True)
+    np.testing.assert_allclose(np.asarray(m_c), np.asarray(m_j),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(l_c), np.asarray(l_j),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o_c), np.asarray(o_j),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_causal_kernel_validation():
+    q, k, v = _qkv(4, 1, 16, 24, 1, 32)
+    with pytest.raises(ValueError, match="Tq == Tk"):
+        flash_block_partials(q, k, v, None, scale=1.0, causal=True,
+                             interpret=True)
+    q2, k2, v2 = _qkv(4, 1, 16, 16, 1, 32)
+    with pytest.raises(ValueError, match="replaces mask"):
+        flash_block_partials(q2, k2, v2, jnp.ones((16, 16), bool),
+                             scale=1.0, causal=True, interpret=True)
