@@ -136,12 +136,56 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
     return (acc / jnp.moveaxis(l_safe, 1, 2)[..., None]).astype(q.dtype)
 
 
+# the non-causal kernel computes one (512, Tk) score tile at a time with
+# K/V resident in VMEM — fine at ring-block sizes, but a monolithic global
+# sequence beyond this bound would overflow VMEM (the causal kernel tiles
+# keys and scales much further)
+_FLASH_MAX_UNTILED_TK = 4096
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, causal=False):
+    """Single-device attention via the fused flash kernel: block partials +
+    normalization, so the (T, T) score matrix never reaches HBM (the
+    ``reference_attention`` einsum materializes it).  Causal uses the
+    key-tile-skipping kernel on TPU; very long NON-causal sequences fall
+    back to the einsum (the untiled kernel would overflow VMEM).
+
+    Differentiable: the backward pass recomputes through the einsum
+    reference (a ``custom_vjp`` — the Pallas forward has no transpose
+    rule), so gradients match ``reference_attention``'s.
+    """
+    if not causal and q.shape[1] > _FLASH_MAX_UNTILED_TK:
+        return reference_attention(q, k, v, causal=causal)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    o, _, l = flash_block_partials(q, k, v, None, scale=scale, causal=causal)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / jnp.moveaxis(l_safe, 1, 2)[..., None]).astype(q.dtype)
+
+
+def _flash_attention_fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal), (q, k, v)
+
+
+def _flash_attention_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
 def ulysses_attention(q, k, v, *, comm=None, causal=False):
     """Exact attention via all-to-all head exchange (Ulysses).
 
     Input shards ``(B, T_local, H, D)`` with ``H % size == 0``: re-shard to
     ``(B, T_global, H/size, D)`` with one ``alltoall``, run full-sequence
-    local attention on the head group, and re-shard back.
+    local flash attention on the head group (fused kernel — the global
+    score matrix never hits HBM), and re-shard back.
     """
     comm = comm if comm is not None else mpx.get_default_comm()
     size = comm.Get_size()
@@ -163,7 +207,7 @@ def ulysses_attention(q, k, v, *, comm=None, causal=False):
         return x.transpose(1, 2, 0, 3, 4).reshape(b, t_loc, h, d)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = reference_attention(qh, kh, vh, causal=causal)
+    out = flash_attention(qh, kh, vh, causal)
     return heads_to_seq(out)
 
 

@@ -77,6 +77,8 @@ def test_ring_attention_differentiable():
         out = reference_attention(q, k, v, causal=True)
         return (out**2).sum()
 
+    # the allreduced loss is replicated per rank, so summing the stacked
+    # outputs counts it SIZE times — divide back out
     g_sharded = jax.grad(lambda q: jnp.sum(loss_sharded(q, k, v)) / SIZE)(q)
     g_full = jax.grad(
         lambda qg: loss_full(qg, jnp.asarray(_global(k)), jnp.asarray(_global(v)))
@@ -96,3 +98,33 @@ def test_ulysses_rejects_bad_head_count():
 
     with pytest.raises(ValueError, match="divisible"):
         f(q)
+
+
+def test_ulysses_attention_differentiable():
+    """ulysses now runs its local attention through the flash kernel, whose
+    forward has no transpose rule — the custom_vjp (backward through the
+    einsum reference) must keep jax.grad working and matching the
+    single-device gradient."""
+    comm = mpx.get_default_comm()
+    q, k, v = _data(3)
+
+    def loss_sharded(q, k, v):
+        @mpx.spmd
+        def f(q, k, v):
+            out = ulysses_attention(q, k, v, comm=comm, causal=True)
+            return jnp.sum(out**2)
+
+        return f(q, k, v)
+
+    def loss_full(qg, kg, vg):
+        return jnp.sum(reference_attention(qg, kg, vg, causal=True) ** 2)
+
+    # each rank's scalar here is a rank-local partial sum (no allreduce in
+    # the loss), so summing the stacked outputs IS the global loss
+    g_sharded = jax.grad(lambda q: jnp.sum(loss_sharded(q, k, v)))(q)
+    g_full = jax.grad(
+        lambda qg: loss_full(qg, jnp.asarray(_global(k)), jnp.asarray(_global(v)))
+    )(jnp.asarray(_global(q)))
+    np.testing.assert_allclose(
+        _global(g_sharded), np.asarray(g_full), rtol=2e-3, atol=2e-4
+    )
