@@ -30,8 +30,11 @@ def main():
     t1 = 0.1 * DAY_IN_SECONDS
 
     # fast="auto": single-device runs use the fused whole-step Pallas
-    # kernel (model_step_pallas); multi-device meshes use the split-phase
-    # Pallas kernels with halo exchanges (model_step_pallas_halo)
+    # kernel (model_step_pallas); multi-device meshes use the carried-
+    # frame wide-halo kernel (model_step_pallas_wide: widen once, 4
+    # margin-band messages per pair of steps), falling back to the
+    # split-phase kernels (model_step_pallas_halo) only below its
+    # 16-cell minimum local interior
     wall, n_steps = solve_fused(cfg, t1, devices=devices, fast="auto")
 
     # second, 5x-longer run: the slope between the two cancels the fixed

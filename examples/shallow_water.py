@@ -556,25 +556,47 @@ def _rolls(roll, nr: int, nx: int):
     return rm1x, rp1x, rm1y, rp1y
 
 
-def _window_masks(cfg: Config, iy, ix, giy, gix):
+def _window_masks(cfg: Config, iy, ix, giy, gix, wide=False):
     """Shared wall/update masks for the phase windows (single source of
     truth — must mirror ``model_step_fast``'s mask algebra, which the
     equality tests pin): ``(derived, u_wall, wall_v, interior)``.
 
     ``derived(expr, extra=None)`` zeroes the halo rows/cols a real exchange
     would leave untouched; ``u_wall``/``wall_v`` are the no-flow wall
-    masks; ``interior`` is the local-update mask.
+    masks; ``interior`` is the update mask.
+
+    ``wide`` selects the wide-halo frame (``model_step_pallas_wide``):
+    there every cell is computed exactly as its *owning* rank computes it,
+    so the update mask tests DOMAIN-GLOBAL interiority (a seam cell is
+    some rank's interior and is updated in place — the recomputed value is
+    bit-identical to what an exchange would deliver), and the kept masks
+    use inequalities so the beyond-wall garbage rows of the widened frame
+    are zeroed in every derived field.  In the default frame the update
+    mask tests LOCAL indices: the rank's own halo ring is excluded and
+    later refreshed by a real exchange (or the periodic in-register fix).
     """
     nyl, nxl = cfg.ny_local, cfg.nx_local
     gy_n, gx_n = cfg.ny + 2, cfg.nx + 2
 
-    kept = (giy == 0) | (giy == gy_n - 1)
     u_wall = None  # kind-"u" no-flow wall column
-    if not cfg.periodic_x:
-        kept |= (gix == 0) | (gix == gx_n - 1)
-        u_wall = gix == gx_n - 2
     wall_v = giy == gy_n - 2  # kind-"v" no-flux row (extra mask)
-    interior = (iy > 0) & (iy < nyl - 1) & (ix > 0) & (ix < nxl - 1)
+    if wide:
+        # kept uses inequalities so beyond-wall garbage rows of the widened
+        # frame are zeroed too; for periodic x the widened columns beyond
+        # the global extent are wrap images of far-side interior columns —
+        # their owner updates them, so no x constraint enters the masks
+        kept = (giy <= 0) | (giy >= gy_n - 1)
+        interior = (giy >= 1) & (giy <= gy_n - 2)
+        if not cfg.periodic_x:
+            kept |= (gix <= 0) | (gix >= gx_n - 1)
+            interior &= (gix >= 1) & (gix <= gx_n - 2)
+            u_wall = gix == gx_n - 2
+    else:
+        kept = (giy == 0) | (giy == gy_n - 1)
+        if not cfg.periodic_x:
+            kept |= (gix == 0) | (gix == gx_n - 1)
+            u_wall = gix == gx_n - 2
+        interior = (iy > 0) & (iy < nyl - 1) & (ix > 0) & (ix < nxl - 1)
 
     def derived(expr, extra=None):
         mask = kept if extra is None else (kept | extra)
@@ -584,7 +606,7 @@ def _window_masks(cfg: Config, iy, ix, giy, gix):
 
 
 def _phase1_window(cfg: Config, first_step: bool, iy, ix, giy, gix, fields,
-                   roll):
+                   roll, wide=False):
     """Integration phase of one model step (hc, fluxes, q, ke, tendencies,
     AB-2/Euler update) on a ``(nr, nx)`` row window, no exchanges.
 
@@ -610,7 +632,9 @@ def _phase1_window(cfg: Config, first_step: bool, iy, ix, giy, gix, fields,
     # to a neighbor's interior index, so they are false there — its value
     # is then computed via rolls, valid by halo coherence); the update mask
     # tests LOCAL indices (every rank's own halo ring is excluded)
-    derived, u_wall, wall_v, interior = _window_masks(cfg, iy, ix, giy, gix)
+    derived, u_wall, wall_v, interior = _window_masks(
+        cfg, iy, ix, giy, gix, wide
+    )
 
     # hc: edge-replicated pad rows/cols at the physical walls; elsewhere
     # the (coherent) halo value is already the neighbor's interior
@@ -669,7 +693,7 @@ def _phase1_window(cfg: Config, first_step: bool, iy, ix, giy, gix, fields,
     return h1, u1, v1, dh_new, du_new, dv_new
 
 
-def _phase2_window(cfg: Config, iy, ix, giy, gix, u, v, roll):
+def _phase2_window(cfg: Config, iy, ix, giy, gix, u, v, roll, wide=False):
     """Viscosity phase of one model step on a window: lateral friction on
     ``u`` and ``v``, which must enter with *coherent halos* (the mid-step
     exchange / periodic fix).  Index conventions as ``_phase1_window``;
@@ -677,7 +701,9 @@ def _phase2_window(cfg: Config, iy, ix, giy, gix, u, v, roll):
     nr, nx = u.shape
     dx, dy, dt = cfg.dx, cfg.dy, cfg.dt
     rm1x, rp1x, rm1y, rp1y = _rolls(roll, nr, nx)
-    derived, u_wall, wall_v, interior = _window_masks(cfg, iy, ix, giy, gix)
+    derived, u_wall, wall_v, interior = _window_masks(
+        cfg, iy, ix, giy, gix, wide
+    )
 
     visc = cfg.lateral_viscosity
     out = []
@@ -865,15 +891,12 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     assert cfg.nproc == 1 and cfg.periodic_x, (
         "model_step_pallas: single-rank periodic-x only; use model_step_fast"
     )
-    # nsteps=4 exceeds the chip's VMEM/compiler limits at benchmark width
-    assert nsteps in (1, 2, 3)
     # one sublane tile of validity per fused step, rounded up to a divisor
     # of _PBLK — the prev/next margin index maps address mrg-row blocks as
     # i * (_PBLK // mrg), which only lands on block starts when mrg
-    # divides _PBLK (nsteps=3: 24 -> 32)
-    mrg = 8 * nsteps
-    while _PBLK % mrg:
-        mrg += 8
+    # divides _PBLK (nsteps=3: 24 -> 32); nsteps=4 exceeds the chip's
+    # VMEM/compiler limits at benchmark width (asserted in _margin_rows)
+    mrg = _margin_rows(nsteps)
     import jax.experimental.pallas as pl
 
     if interpret is None:
@@ -1105,6 +1128,396 @@ def model_step_pallas_halo(state: State, cfg: Config, comm: mpx.Comm,
     return State(h1, u1, v1, dh_new, du_new, dv_new)
 
 
+# ---------------------------------------------------------------------------
+# Pallas wide-halo step (any mesh: communication-avoiding fused kernel)
+# ---------------------------------------------------------------------------
+
+
+def _wide_exchange(fields, cfg: Config, comm: mpx.Comm, m: int, token):
+    """Build the widened frame for ``model_step_pallas_wide``: every side
+    gains ``m - 1`` rows/cols of neighbor data beyond the existing 1-cell
+    halo, so ``nsteps`` whole model steps can be recomputed locally with no
+    further exchange (a communication-avoiding halo exchange).
+
+    Exchanges ``m``-deep strips of all six fields, batched as ONE
+    ``sendrecv`` per direction — 4 messages per multi-step kernel call,
+    where the split-phase path sends 4 messages per ``enforce_boundaries``
+    round and needs 5 rounds per step.  Corner (diagonal-neighbor) data
+    arrives via the standard two-phase trick: x strips first, then y
+    strips *of the x-widened arrays*.
+
+    Assembly differs by field class, preserving each class's invariant:
+
+    - state (``h``/``u``/``v``): the local array is kept whole — its halo
+      ring already holds the correct value everywhere (coherent at seams;
+      the *initial-condition* value at physical walls, which an exchanged
+      strip could not supply) — and the strips contribute only the
+      ``m - 1`` extra rows/cols beyond it;
+    - tendencies (``dh``/``du``/``dv``): their local halo ring is zero by
+      invariant, but in the widened frame the seam position must hold the
+      *owning* rank's value (the AB-2 update reads it there), so the full
+      ``m``-deep strip replaces the halo position; at walls the zeros
+      template reproduces the invariant exactly.
+
+    Edge ranks of non-wrapping directions get a zeros template
+    (``MPI_PROC_NULL`` semantics); those cells are beyond-wall garbage
+    that the wide masks keep out of every valid cell.
+    """
+    nyl, nxl = cfg.ny_local, cfg.nx_local
+    commx, commy = comm.sub("px"), comm.sub("py")
+    wrap_x = cfg.periodic_x
+
+    def exch(payload, template, route, c, token):
+        # all four exchanges get the CALLER's token, not a chain: they are
+        # mutually independent (the x -> y ordering is a data dependency
+        # already), and chaining would serialize what XLA can overlap
+        if c.Get_size() == 1:
+            # no neighbor (template) or self-wrap (a CollectivePermute
+            # along a size-1 axis is the identity: skip the collective)
+            return (payload if route.wrap else template), token
+        return mpx.sendrecv(payload, template, dest=route, comm=c,
+                            token=token)
+
+    # ---- x phase: (6, nyl, m) strips --------------------------------
+    lo = jnp.stack([f[:, 1:m + 1] for f in fields])
+    hi = jnp.stack([f[:, nxl - 1 - m:nxl - 1] for f in fields])
+    zs = jnp.zeros_like(lo)
+    # high-side strips travel east (shift +1): each rank receives its WEST
+    # neighbor's easternmost interior columns, and vice versa
+    from_west, _ = exch(hi, zs, shift(+1, wrap=wrap_x), commx, token)
+    from_east, _ = exch(lo, zs, shift(-1, wrap=wrap_x), commx, token)
+    wx = []
+    for k, f in enumerate(fields):
+        w, e = from_west[k], from_east[k]
+        if k < 3:  # state: local halo ring kept in place
+            wx.append(jnp.concatenate([w[:, :m - 1], f, e[:, 1:]], axis=1))
+        else:  # tendency: the strip supplies the halo position
+            wx.append(jnp.concatenate([w, f[:, 1:-1], e], axis=1))
+
+    # ---- y phase: (6, m, nx_w) strips of the x-widened arrays -------
+    lo = jnp.stack([f[1:m + 1] for f in wx])
+    hi = jnp.stack([f[nyl - 1 - m:nyl - 1] for f in wx])
+    zs = jnp.zeros_like(lo)
+    from_south, _ = exch(hi, zs, shift(+1, wrap=False), commy, token)
+    from_north, _ = exch(lo, zs, shift(-1, wrap=False), commy, token)
+    out = []
+    for k, f in enumerate(wx):
+        s, n = from_south[k], from_north[k]
+        if k < 3:
+            out.append(jnp.concatenate([s[:m - 1], f, n[1:]], axis=0))
+        else:
+            out.append(jnp.concatenate([s, f[1:-1], n], axis=0))
+    return tuple(out), token
+
+
+def _wide_step_window(cfg: Config, first_step: bool, giy, gix, fields, roll):
+    """One WHOLE model step on the widened frame: ``_phase1_window`` with
+    the wide masks, the post-integration wall conditions as global-index
+    ``where``s (the only thing the mid-step exchange does *beyond* halo
+    refresh — which the wide frame gets by recompute), then
+    ``_phase2_window``.  No exchanges and no periodic fixes: x wrap data
+    is real far-side data sitting in the widened margins.  Validity
+    shrinks by the recompute chain depth (~5 cells) per step from the
+    widened edges inward."""
+    gy_n, gx_n = cfg.ny + 2, cfg.nx + 2
+    h1, u1, v1, dh_n, du_n, dv_n = _phase1_window(
+        cfg, first_step, giy, gix, giy, gix, fields, roll, wide=True
+    )
+    # post-integration wall conditions (enforce_boundaries kinds "u"/"v";
+    # global-index masks, so a rank whose widened frame reaches a wall row
+    # applies the same zeroing the wall rank applies)
+    if not cfg.periodic_x:
+        u1 = jnp.where(gix == gx_n - 2, 0.0, u1)
+    v1 = jnp.where(giy == gy_n - 2, 0.0, v1)
+    if cfg.lateral_viscosity > 0:
+        u1, v1 = _phase2_window(
+            cfg, giy, gix, giy, gix, u1, v1, roll, wide=True
+        )
+    # end-of-step kind-"h" refreshes are pure halo refresh: nothing to do
+    return h1, u1, v1, dh_n, du_n, dv_n
+
+
+def _sw_wide_kernel(cfg: Config, first_step: bool, mrg: int, nsteps: int,
+                    refs):
+    """Kernel body for the wide-halo step: like ``_sw_steps_kernel`` but on
+    the widened frame — global indices come from the SMEM offset pair (one
+    compiled kernel serves every rank) and the step windows use the wide
+    masks, so there are no periodic fixes."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    meta = refs[0]
+    ins, outs = refs[1:19], refs[19:]
+    nx_w = ins[1].shape[1]
+    nr = _PBLK + 2 * mrg
+
+    fields = tuple(
+        jnp.concatenate(
+            [ins[3 * k][:], ins[3 * k + 1][:], ins[3 * k + 2][:]], axis=0
+        )
+        for k in range(6)
+    )
+
+    pid = pl.program_id(0)
+    wy = (
+        jax.lax.broadcasted_iota(jnp.int32, (nr, nx_w), 0)
+        + pid * _PBLK
+        - mrg
+    )
+    wx = jax.lax.broadcasted_iota(jnp.int32, (nr, nx_w), 1)
+    giy = wy + meta[0]
+    gix = wx + meta[1]
+
+    first = first_step
+    for _ in range(nsteps):
+        fields = _wide_step_window(cfg, first, giy, gix, fields, pltpu.roll)
+        first = False
+
+    sl = slice(mrg, mrg + _PBLK)
+    for o, f in zip(outs, fields):
+        o[:] = f[sl]
+
+
+def model_step_pallas_wide(state: State, cfg: Config, comm: mpx.Comm,
+                           first_step: bool, interpret=None,
+                           nsteps: int = 2) -> State:
+    """``nsteps`` whole model steps on ANY mesh as ONE fused Pallas kernel
+    between communication-avoiding wide halo exchanges.
+
+    Where ``model_step_pallas_halo`` splices a real 1-cell exchange
+    between the two phase kernels of every step (5 exchange rounds and two
+    state HBM round-trips per step), this path exchanges ``8 * nsteps``
+    -deep strips of all six fields ONCE (4 batched messages), then runs
+    the whole multi-step chain in VMEM: every halo value a step would have
+    received is instead *recomputed locally* from the widened margins —
+    bit-identical to the exchange, because the seam cell is computed by
+    the identical expression tree on the identical operand values its
+    owning rank uses (``_window_masks(wide=True)``).  The cropped result
+    therefore equals ``model_step_fast`` exactly, which
+    tests/test_examples.py pins on (1,1) and (2,4) meshes in both
+    boundary modes.
+
+    This brings the single-rank pair kernel's economics (state reads HBM
+    once per ``nsteps``, all intermediates in VMEM) to multi-rank meshes:
+    the reference's scaling story (ref docs/shallow-water.rst:56-94) with
+    the fused-kernel per-chip speed.  Requires a local interior of at
+    least ``8 * nsteps`` cells per dimension (strips must come from the
+    immediate neighbor only); ``select_steps("auto")`` falls back to the
+    split-phase path below that.
+    """
+    m = _margin_rows(nsteps)
+    assert cfg.ny_local - 2 >= m and cfg.nx_local - 2 >= m, (
+        "model_step_pallas_wide: local interior must be >= the exchange "
+        f"depth ({m}) in both dimensions; use model_step_pallas_halo"
+    )
+    if interpret is None:
+        interpret = _resolve_interpret(comm)
+    token = mpx.create_token()
+    wfields, token = _wide_exchange(tuple(state), cfg, comm, m, token)
+    outs = _wide_kernel_call(wfields, cfg, first_step, nsteps, m, interpret)
+    return _wide_crop(outs, cfg, m)
+
+
+def _margin_rows(nsteps: int) -> int:
+    """Margin / exchange depth for ``nsteps`` fused steps: 8 rows/cols of
+    validity per step (chain depth ~5), rounded up to a divisor of
+    ``_PBLK`` (the block-margin index maps need ``mrg | _PBLK``).  The
+    single source of this invariant for both the whole-step chunk kernels
+    and the wide-halo path."""
+    assert 1 <= nsteps <= 3, nsteps  # deeper fusion exceeds VMEM/compiler
+    m = 8 * nsteps
+    while _PBLK % m:
+        m += 8
+    return m
+
+
+def _wide_kernel_call(wfields, cfg: Config, first_step: bool, nsteps: int,
+                      m: int, interpret: bool):
+    """``nsteps`` step windows on the widened frame: the compiled blocked
+    Pallas kernel, or direct ``jnp.roll`` evaluation where Mosaic cannot
+    compile (same rationale as ``model_step_pallas_halo``)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ny_w, nx_w = wfields[0].shape
+    off = _rank_offsets(cfg) - (m - 1)  # widened-frame global offsets
+    vma = frozenset(getattr(jax.typeof(wfields[0]), "vma", frozenset()))
+
+    if interpret:
+        iy = jax.lax.broadcasted_iota(jnp.int32, (ny_w, nx_w), 0)
+        ix = jax.lax.broadcasted_iota(jnp.int32, (ny_w, nx_w), 1)
+        giy, gix = iy + off[0], ix + off[1]
+        outs = tuple(wfields)
+        first = first_step
+        for _ in range(nsteps):
+            outs = _wide_step_window(cfg, first, giy, gix, outs, jnp.roll)
+            first = False
+        return outs
+
+    grid, main_spec, prev_spec, next_spec = _blocked_specs(ny_w, nx_w, m)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    operands = [off]
+    for f in wfields:
+        in_specs += [prev_spec, main_spec, next_spec]
+        operands += [f, f, f]
+    out_shape = [
+        jax.ShapeDtypeStruct((ny_w, nx_w), jnp.float32, vma=vma)
+    ] * 6
+    return pl.pallas_call(
+        lambda *refs: _sw_wide_kernel(cfg, first_step, m, nsteps, refs),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[main_spec for _ in range(6)],
+        out_shape=out_shape,
+        compiler_params=_tpu_compiler_params(),
+    )(*operands)
+
+
+def _wide_crop(outs, cfg: Config, m: int) -> State:
+    """Crop a widened frame back to the local layout: the state's halo
+    ring lands coherent (seam cells were updated exactly as their owner
+    updates them; wall halo cells kept their values — update masked,
+    tendency position zero), and the tendency ring is re-zeroed (in the
+    widened frame it holds the neighbor's values at seams)."""
+    nyl, nxl = cfg.ny_local, cfg.nx_local
+    sl = (slice(m - 1, m - 1 + nyl), slice(m - 1, m - 1 + nxl))
+    h1, u1, v1 = (o[sl] for o in outs[:3])
+    liy = jax.lax.broadcasted_iota(jnp.int32, (nyl, nxl), 0)
+    lix = jax.lax.broadcasted_iota(jnp.int32, (nyl, nxl), 1)
+    ring = (liy == 0) | (liy == nyl - 1) | (lix == 0) | (lix == nxl - 1)
+    dh_n, du_n, dv_n = (jnp.where(ring, 0.0, o[sl]) for o in outs[3:])
+    return State(h1, u1, v1, dh_n, du_n, dv_n)
+
+
+def _wide_refresh(wf, cfg: Config, comm: mpx.Comm, m: int, token):
+    """Refresh the margin bands of a CARRIED widened frame between
+    multi-step kernel calls.
+
+    After a kernel call the local frame (crop region, halo ring included)
+    is valid but the ``m - 1``-deep margins are recompute garbage.  The
+    carried-frame driver (``solve_fused`` wide modes) therefore never
+    crops between calls: it exchanges just the margin bands — four
+    messages of ``(6, ·, m-1)`` — and updates them in place with
+    ``.at[].set`` (inside the ``fori_loop`` XLA updates the carried
+    buffers without copying the untouched interior), so the full-array
+    concat/crop copies of ``model_step_pallas_wide`` happen once per RUN
+    instead of once per pair of steps.
+
+    Two-phase for corners: x bands first (their corner rows are the
+    sender's own garbage y-margins), then y bands at full widened width —
+    sliced *after* the x update, so their corner columns carry the
+    y-neighbor's freshly refreshed x margins (= diagonal-neighbor data).
+    In the carried frame the state/tendency assembly distinction of
+    ``_wide_exchange`` disappears: the halo-position ring is valid
+    post-kernel (computed as the owner computes it) and is not touched.
+    """
+    e = m - 1
+    nyl, nxl = cfg.ny_local, cfg.nx_local
+    ny_w, nx_w = wf[0].shape
+    commx, commy = comm.sub("px"), comm.sub("py")
+    wrap_x = cfg.periodic_x
+
+    def exch(payload, route, c):
+        if c.Get_size() == 1:
+            return payload if route.wrap else jnp.zeros_like(payload)
+        out, _ = mpx.sendrecv(payload, jnp.zeros_like(payload), dest=route,
+                              comm=c, token=token)
+        return out
+
+    # ---- x bands: (6, ny_w, e) ----
+    # west margin <- west neighbor's easternmost interior (its widened
+    # cols [nxl-2, nxl-2+e)); east margin <- east neighbor's westernmost
+    # (its widened cols [e+2, 2e+2))
+    from_west = exch(
+        jnp.stack([f[:, nxl - 2:nxl - 2 + e] for f in wf]),
+        shift(+1, wrap=wrap_x), commx,
+    )
+    from_east = exch(
+        jnp.stack([f[:, e + 2:2 * e + 2] for f in wf]),
+        shift(-1, wrap=wrap_x), commx,
+    )
+    wf = tuple(
+        f.at[:, :e].set(from_west[k]).at[:, e + nxl:].set(from_east[k])
+        for k, f in enumerate(wf)
+    )
+
+    # ---- y bands: (6, e, nx_w), full width (corners now valid) ----
+    from_south = exch(
+        jnp.stack([f[nyl - 2:nyl - 2 + e] for f in wf]),
+        shift(+1, wrap=False), commy,
+    )
+    from_north = exch(
+        jnp.stack([f[e + 2:2 * e + 2] for f in wf]),
+        shift(-1, wrap=False), commy,
+    )
+    return tuple(
+        f.at[:e, :].set(from_south[k]).at[e + nyl:, :].set(from_north[k])
+        for k, f in enumerate(wf)
+    )
+
+
+def _wide_run(state: State, num_steps: int, cfg: Config, comm: mpx.Comm,
+              chunk_size: int, m: int, interpret: bool,
+              euler_first: bool) -> State:
+    """Advance ``num_steps`` model steps on the CARRIED widened frame:
+    build the frame once (``_wide_exchange``), run ``chunk_size``-step
+    kernel calls with only a margin-band refresh between them
+    (``_wide_refresh``), crop once at the end.  ``euler_first`` makes the
+    first advanced step the forward-Euler one (a 1-step kernel call).
+    This is the hot path behind every wide-mode driver (``make_stepper``
+    and ``solve_fused``)."""
+    assert cfg.ny_local - 2 >= m and cfg.nx_local - 2 >= m, (
+        "wide-halo path: local interior must be >= the exchange depth "
+        f"({m}) in both dimensions; use model_step_pallas_halo"
+    )
+    if num_steps <= 0:
+        return state
+    token = mpx.create_token()
+    wf, token = _wide_exchange(tuple(state), cfg, comm, m, token)
+    rest = num_steps
+    # `fresh` tracks whether the margins are still the just-exchanged ones
+    # (a kernel call invalidates them); the first call after the build can
+    # then skip its redundant refresh
+    fresh = True
+    if euler_first:
+        wf = _wide_kernel_call(wf, cfg, True, 1, m, interpret)
+        rest -= 1
+        fresh = False
+    nchunks, rem = divmod(rest, chunk_size)
+
+    def body(_, wf):
+        wf = _wide_refresh(wf, cfg, comm, m, token)
+        return _wide_kernel_call(wf, cfg, False, chunk_size, m, interpret)
+
+    if nchunks and fresh:
+        wf = _wide_kernel_call(wf, cfg, False, chunk_size, m, interpret)
+        nchunks -= 1
+        fresh = False
+    if nchunks:  # fori_loop(0, 0) would still trace the chunk kernel
+        wf = jax.lax.fori_loop(0, nchunks, body, tuple(wf))
+    for _ in range(rem):
+        if fresh:
+            fresh = False
+        else:
+            wf = _wide_refresh(wf, cfg, comm, m, token)
+        wf = _wide_kernel_call(wf, cfg, False, 1, m, interpret)
+    return _wide_crop(wf, cfg, m)
+
+
+def model_step_wide(state: State, cfg: Config, comm: mpx.Comm,
+                    first_step: bool, interpret=None) -> State:
+    """One model step via the wide-halo kernel (``nsteps=1``)."""
+    return model_step_pallas_wide(state, cfg, comm, first_step,
+                                  interpret=interpret, nsteps=1)
+
+
+def model_step2_wide(state: State, cfg: Config, comm: mpx.Comm,
+                     first_step: bool, interpret=None) -> State:
+    """TWO model steps per wide-halo kernel call + exchange round."""
+    return model_step_pallas_wide(state, cfg, comm, first_step,
+                                  interpret=interpret, nsteps=2)
+
+
 def _pltpu_roll():
     from jax.experimental.pallas import tpu as pltpu
 
@@ -1125,8 +1538,12 @@ def select_step(fast, cfg: Config = None):
       call (see ``select_steps``);
     - ``"pallas_halo"`` — the split-phase Pallas kernels with real halo
       exchanges between them (any mesh, ``model_step_pallas_halo``);
+    - ``"wide"`` / ``"wide2"`` — the communication-avoiding wide-halo
+      kernel (any mesh with local interior >= 8/16 cells per dimension,
+      ``model_step_pallas_wide``); ``"wide2"`` fuses 2 steps per exchange;
     - ``"auto"`` — ``"pallas2"`` when ``cfg`` is a single-rank periodic-x
-      decomposition (the benchmark configuration), else ``"pallas_halo"``.
+      decomposition (the benchmark configuration); else ``"wide2"`` when
+      the local interior fits its exchange depth; else ``"pallas_halo"``.
 
     Returns the SINGLE-step callable; drivers that can batch steps use
     ``select_steps`` to also obtain the multi-step chunk kernel.
@@ -1147,11 +1564,20 @@ def select_steps(fast, cfg: Config = None):
                 "eligibility — pass cfg"
             )
         # whole-step kernel where eligible (no exchanges at all); the
-        # split-phase kernel everywhere else (multi-rank meshes, walls).
+        # wide-halo pair kernel everywhere else (multi-rank meshes, walls)
+        # unless the local interior is smaller than its exchange depth.
         # Pair depth: deeper fusion measured no better (see
         # model_step3_pallas) and fails to compile at benchmark width.
-        fast = ("pallas2" if cfg.nproc == 1 and cfg.periodic_x
-                else "pallas_halo")
+        if cfg.nproc == 1 and cfg.periodic_x:
+            fast = "pallas2"
+        elif min(cfg.ny_local, cfg.nx_local) - 2 >= _margin_rows(2):
+            fast = "wide2"
+        else:
+            fast = "pallas_halo"
+    if fast == "wide2":
+        return model_step_wide, model_step2_wide, 2
+    if fast == "wide":
+        return model_step_wide, None, 1
     if fast == "pallas3":
         return model_step_pallas, model_step3_pallas, 3
     if fast == "pallas2":
@@ -1177,6 +1603,24 @@ def make_stepper(cfg: Config, comm: mpx.Comm, *, fast=True):
     remainder falls back to single-step calls).
     """
     step, chunk, chunk_size = select_steps(fast, cfg)
+
+    if step is model_step_wide:
+        # wide modes run on the carried widened frame (margin-band refresh
+        # between kernel calls instead of crop + re-widen per call)
+        m = _margin_rows(chunk_size)
+        interpret = _resolve_interpret(comm)
+
+        @partial(mpx.spmd, comm=comm)
+        def first_step(state: State) -> State:
+            return _wide_run(state, 1, cfg, comm, chunk_size, m, interpret,
+                             euler_first=True)
+
+        @partial(mpx.spmd, comm=comm, static_argnums=(1,))
+        def multistep(state: State, num_steps: int) -> State:
+            return _wide_run(state, num_steps, cfg, comm, chunk_size, m,
+                             interpret, euler_first=False)
+
+        return first_step, multistep
 
     @partial(mpx.spmd, comm=comm)
     def first_step(state: State) -> State:
@@ -1265,22 +1709,43 @@ def solve(cfg: Config, t1: float, *, num_multisteps: int = 10, devices=None,
 
 
 def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
-                devices=None, fast=True):
+                devices=None, fast=True, return_state=False):
     """Benchmark-mode solve: the ENTIRE simulation is one XLA program
     (first Euler step + a ``fori_loop`` over all remaining steps), so the
     host dispatches once instead of once per multistep.  Runs the same
     number of steps as ``solve(collect=False)``; returns
     ``(wall_time_s, n_steps)`` with compile excluded (reference protocol,
-    ref examples/shallow_water.py:449-450)."""
+    ref examples/shallow_water.py:449-450), plus the final stacked state
+    when ``return_state`` is set (equality tests).
+
+    The wide-halo modes get a dedicated fused program that carries the
+    state in WIDENED form across the whole run: the widened frame is built
+    once, each pair of steps exchanges only the thin margin bands
+    (``_wide_refresh``) before its kernel call, and the crop back to the
+    local layout happens once at the end — per pair this costs four
+    band messages and zero full-array copies, where cropping and
+    re-widening every call costs two extra full-state HBM round-trips.
+    """
     mesh, comm = make_mesh_and_comm(cfg, devices=devices)
     n_iters = max(0, math.ceil((t1 - cfg.dt) / (cfg.dt * num_multisteps)))
     n_steps = 1 + n_iters * num_multisteps
     step, chunk, chunk_size = select_steps(fast, cfg)
 
-    @partial(mpx.spmd, comm=comm, static_argnums=(1,))
-    def fused(state: State, total: int) -> State:
-        state = step(state, cfg, comm, first_step=True)
-        return _run_steps(state, total, cfg, comm, step, chunk, chunk_size)
+    if step is model_step_wide:
+        m = _margin_rows(chunk_size)
+        interpret = _resolve_interpret(comm)
+
+        @partial(mpx.spmd, comm=comm, static_argnums=(1,))
+        def fused(state: State, total: int) -> State:
+            return _wide_run(state, total + 1, cfg, comm, chunk_size, m,
+                             interpret, euler_first=True)
+
+    else:
+        @partial(mpx.spmd, comm=comm, static_argnums=(1,))
+        def fused(state: State, total: int) -> State:
+            state = step(state, cfg, comm, first_step=True)
+            return _run_steps(state, total, cfg, comm, step, chunk,
+                              chunk_size)
 
     state = initial_state(cfg)
     # sync points fetch ONE element: on remote-attached devices a full-array
@@ -1295,6 +1760,8 @@ def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
         out = fused(state, n_steps - 1)
         np.asarray(out.h[0, 0, 0])  # device->host sync
         wall = min(wall, time.perf_counter() - start)
+    if return_state:
+        return wall, n_steps, out
     return wall, n_steps
 
 

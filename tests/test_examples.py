@@ -235,17 +235,148 @@ def test_select_step_auto_picks_kernel_by_mesh():
     from shallow_water import (
         model_step_pallas,
         model_step_pallas_halo,
+        model_step_wide,
         select_step,
     )
 
     # whole-step kernel only where every refresh is an in-register periodic
-    # fix; the split-phase kernel (real exchanges) everywhere else
+    # fix; the wide-halo kernel everywhere else, unless the local interior
+    # is smaller than its 16-cell exchange depth (then split-phase)
     single = Config(nproc_y=1, nproc_x=1, nx=48, ny=24)
     assert select_step("auto", single) is model_step_pallas
-    multi = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)
+    multi = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)  # 12x12 interior
     assert select_step("auto", multi) is model_step_pallas_halo
-    walls = replace(single, periodic_x=False)
-    assert select_step("auto", walls) is model_step_pallas_halo
+    big_multi = Config(nproc_y=2, nproc_x=4, nx=64, ny=32)  # 16x16 interior
+    assert select_step("auto", big_multi) is model_step_wide
+    walls = replace(single, periodic_x=False)  # 24x48 interior
+    assert select_step("auto", walls) is model_step_wide
+    small_walls = replace(Config(nproc_y=1, nproc_x=1, nx=48, ny=12),
+                          periodic_x=False)
+    assert select_step("auto", small_walls) is model_step_pallas_halo
+
+
+@pytest.mark.parametrize("grid", [(1, 1), (2, 4), (2, 2)])
+@pytest.mark.parametrize("periodic", [True, False])
+def test_wide_step_matches_fast_step(grid, periodic):
+    """The communication-avoiding wide-halo path (``wide2``: pair kernel +
+    16-deep exchange) must reproduce ``model_step_fast`` on every mesh and
+    boundary mode, over a run mixing the single first step, whole pair
+    calls, and a single-step remainder (11 steps).  Seam cells recomputed
+    in the widened frame use the identical expression tree on the
+    identical operand values the owning rank uses, so the only divergence
+    is fusion-order (FMA-grouping) rounding from the differently-shaped
+    program — ~1 ulp/step, the same class and bound as the single-rank
+    chunk-kernel tests (measured worst 0.47x this bound after 11 steps)."""
+    from dataclasses import replace
+
+    from shallow_water import make_mesh_and_comm, make_stepper
+
+    ny_, nx_ = grid
+    cfg = replace(
+        Config(nproc_y=ny_, nproc_x=nx_, nx=64, ny=32), periodic_x=periodic
+    )
+    devices = jax.devices()[: cfg.nproc]
+    _, comm = make_mesh_and_comm(cfg, devices=devices)
+    first_fast, multi_fast = make_stepper(cfg, comm, fast=True)
+    first_wide, multi_wide = make_stepper(cfg, comm, fast="wide2")
+
+    s0 = initial_state(cfg)
+    fast = multi_fast(first_fast(s0), 11)
+    wide = multi_wide(first_wide(s0), 11)
+    for name, a, b in zip(fast._fields, fast, wide):
+        a, b = np.asarray(a), np.asarray(b)
+        bound = 5e-6 + 1e-6 * np.abs(a).max()
+        assert np.abs(a - b).max() <= bound, (
+            f"field {name} diverged (grid={grid}, periodic={periodic}): "
+            f"max abs {np.abs(a - b).max():.3e} > {bound:.3e}"
+        )
+
+
+def test_wide_step_decomposition_invariance_ulp():
+    """Decomposition invariance of the wide-halo path, to ~1 ulp: the
+    carried widened frame's shape depends on the decomposition (local
+    interior + 2x15 margins), so XLA's FMA grouping can differ between
+    the (1,1) and (2,4) programs — unlike the fast/split-phase paths,
+    whose per-rank arrays it keeps bit-exact.  Measured: exactly 1 f32
+    ulp of the field scale after 20 steps (7.6e-6 at h ~ 100); a halo
+    or mask bug would be O(field-scale)."""
+    steps = 20
+    cfg8 = Config(nproc_y=2, nproc_x=4, nx=64, ny=32)
+    s8, _, _ = solve(cfg8, steps * cfg8.dt, num_multisteps=5, fast="wide2")
+    cfg1 = Config(nproc_y=1, nproc_x=1, nx=64, ny=32)
+    s1, _, _ = solve(cfg1, steps * cfg1.dt, num_multisteps=5, fast="wide2",
+                     devices=jax.devices()[:1])
+    g8 = reassemble(s8[-2], cfg8)
+    g1 = reassemble(s1[-2], cfg1)
+    bound = 2e-6 * max(1.0, float(np.abs(g1).max()))
+    assert np.abs(g8 - g1).max() <= bound, (
+        f"{np.abs(g8 - g1).max():.3e} > {bound:.3e}"
+    )
+
+
+def test_wide_fused_driver_matches_fast_end_state():
+    """``solve_fused``'s wide modes run a dedicated carried-frame program
+    (widen once, margin-band refresh per pair, crop once): its end state
+    must match the fast path's fused program over a run with first step,
+    whole pairs and a remainder (26 steps; bound scaled for the longer
+    accumulation, measured worst 1.01x the 11-step band)."""
+    cfg = Config(nproc_y=2, nproc_x=4, nx=64, ny=32)
+    t1 = 23 * cfg.dt
+    _, n_a, sa = solve_fused(cfg, t1, num_multisteps=5, fast=True,
+                             return_state=True)
+    _, n_b, sb = solve_fused(cfg, t1, num_multisteps=5, fast="wide2",
+                             return_state=True)
+    assert n_a == n_b
+    for name, a, b in zip(sa._fields, sa, sb):
+        a, b = np.asarray(a), np.asarray(b)
+        bound = 1e-5 + 2e-6 * np.abs(a).max()
+        assert np.abs(a - b).max() <= bound, (
+            f"field {name} diverged: {np.abs(a - b).max():.3e} > {bound:.3e}"
+        )
+
+
+def test_wide_standalone_step_matches_stepper():
+    """The standalone per-step form (``model_step_wide``: exchange + one
+    kernel call + crop, at its own exchange depth 8) must agree with the
+    carried-frame stepper's first step (depth 16) — same arithmetic on
+    differently-sized frames, so up to ~1 ulp of fusion-order rounding."""
+    from functools import partial
+
+    import mpi4jax_tpu as mpx
+    from shallow_water import (
+        make_mesh_and_comm,
+        make_stepper,
+        model_step_wide,
+    )
+
+    cfg = Config(nproc_y=2, nproc_x=4, nx=64, ny=32)
+    _, comm = make_mesh_and_comm(cfg)
+    s0 = initial_state(cfg)
+
+    @partial(mpx.spmd, comm=comm)
+    def one(state):
+        return model_step_wide(state, cfg, comm, first_step=True)
+
+    a = make_stepper(cfg, comm, fast="wide2")[0](s0)
+    b = one(s0)
+    for name, x, y in zip(a._fields, a, b):
+        x, y = np.asarray(x), np.asarray(y)
+        bound = 5e-6 + 1e-6 * np.abs(x).max()
+        assert np.abs(x - y).max() <= bound, (
+            f"field {name}: {np.abs(x - y).max():.3e} > {bound:.3e}"
+        )
+
+
+def test_wide_step_rejects_small_interior():
+    from shallow_water import make_mesh_and_comm, make_stepper
+
+    cfg = Config(nproc_y=2, nproc_x=4, nx=48, ny=24)  # 12x12 < 16
+    _, comm = make_mesh_and_comm(cfg)
+    # the carried frame is sized for the pair chunk (exchange depth 16),
+    # which a 12-cell interior cannot supply from its immediate neighbor
+    first, _ = make_stepper(cfg, comm, fast="wide2")
+    with pytest.raises(AssertionError, match="local interior"):
+        first(initial_state(cfg))
 
 
 @pytest.mark.parametrize("grid", [(1, 1), (2, 4)])
@@ -310,7 +441,7 @@ def test_fast_step_decomposition_invariance_exact():
     np.testing.assert_array_equal(g8, g1)
 
 
-@pytest.mark.parametrize("fast", [True, "pallas_halo"])
+@pytest.mark.parametrize("fast", [True, "pallas_halo", "wide2"])
 def test_grad_through_full_multistep(fast):
     """Reverse-mode through the WHOLE flagship workload — first step +
     fori_loop multistep with all halo sendrecvs inside — the composition
@@ -322,10 +453,12 @@ def test_grad_through_full_multistep(fast):
     from shallow_water import make_mesh_and_comm, make_stepper
 
     steps = 6
+    # wide2 needs a 16-cell local interior on the (2, 4) mesh
+    gny, gnx = (32, 64) if fast == "wide2" else (8, 16)
     # ONE decomposition-independent perturbation field, shared by both mesh
     # configurations (drawn once — the gradients can only be compared if
     # both losses perturb the same global field)
-    bump_global = np.random.RandomState(0).randn(8 + 2, 16 + 2).astype(
+    bump_global = np.random.RandomState(0).randn(gny + 2, gnx + 2).astype(
         np.float32)
 
     def make_loss(cfg):
@@ -355,7 +488,7 @@ def test_grad_through_full_multistep(fast):
 
         return loss
 
-    cfg1 = Config(nproc_y=1, nproc_x=1, nx=16, ny=8)
+    cfg1 = Config(nproc_y=1, nproc_x=1, nx=gnx, ny=gny)
     loss1 = make_loss(cfg1)
     g1 = jax.grad(loss1)(0.0)
 
@@ -364,7 +497,7 @@ def test_grad_through_full_multistep(fast):
     fd = (loss1(eps) - loss1(-eps)) / (2 * eps)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(fd), rtol=2e-2)
 
-    cfg8 = Config(nproc_y=2, nproc_x=4, nx=16, ny=8)
+    cfg8 = Config(nproc_y=2, nproc_x=4, nx=gnx, ny=gny)
     g8 = jax.grad(make_loss(cfg8))(0.0)
     # the fast path is exactly decomposition-invariant, so its gradient is
     # too (up to f32 reduction-order rounding in the loss sum)

@@ -105,6 +105,23 @@ WORKER = textwrap.dedent(
         assert np.isfinite(block).all(), (proc_id, s.index)
         assert 50 < block.mean() < 150  # height near resting depth
 
+    # --- 4. wide-halo carried frame across real process boundaries --------
+    # 16-cell local interiors: "auto" ships the communication-avoiding
+    # wide path, whose margin-band sendrecvs here cross processes
+    cfg_w = Config(
+        nproc_y=nproc_y, nproc_x=size // nproc_y,
+        nx=16 * (size // nproc_y), ny=16 * nproc_y,
+    )
+    _, comm_w = make_mesh_and_comm(cfg_w)
+    from shallow_water import model_step_wide, select_step
+    assert select_step("auto", cfg_w) is model_step_wide
+    first_w, multi_w = make_stepper(cfg_w, comm_w, fast="auto")
+    state_w = multi_w(first_w(initial_state(cfg_w)), 3)
+    for s in state_w.h.addressable_shards:
+        block = np.asarray(s.data)
+        assert np.isfinite(block).all(), (proc_id, s.index)
+        assert 50 < block.mean() < 150
+
     print(f"MULTIPROC_OK {proc_id}", flush=True)
     """
 )
