@@ -52,8 +52,21 @@ def _resolve_pairs(source, dest, size, what):
 
 def _apply_permute(xl, recvbuf, pairs, comm):
     """Run one CollectivePermute along GLOBAL pairs (comm-local routing
-    specs are translated through ``comm.expand_pairs`` before this)."""
-    permuted = lax.ppermute(xl, comm.axis, list(pairs))
+    specs are translated through ``comm.expand_pairs`` before this).
+
+    An identity routing — every pair ``(r, r)``, e.g. any wrapping
+    ``shift`` on a size-1 axis — skips the collective entirely: the
+    permutation is a per-rank no-op, and CollectivePermute is far from
+    free on real interconnects (and costs ~100 us per MB on the
+    single-chip attach platform, docs/shallow_water.md "Roofline").
+    Empty pairs (a non-wrapping shift on a size-1 axis) elide the same
+    way — the receiver mask below already hands every rank its recvbuf.
+    Transpose/AD semantics are unchanged (the inverse of the identity is
+    the identity, matching ppermute's transpose rule)."""
+    if all(s == d for s, d in pairs):
+        permuted = xl
+    else:
+        permuted = lax.ppermute(xl, comm.axis, list(pairs))
     # the output is typed by the recv buffer (ref sendrecv.py:369-377
     # abstract eval): a message with a matching element count but different
     # shape — e.g. exchange-row-for-column — lands in recvbuf's shape
