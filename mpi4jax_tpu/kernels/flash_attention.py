@@ -33,6 +33,15 @@ f32 matmul-precision noise of the masked path (normalized attention
 ~6e-4 abs on this chip, where f32 dots use the MXU's bf16-multiply
 default in both kernels).
 
+A later session measured the same kernels at 2.1-2.2 ms/block (~125
+TFLOP/s) — the attach tunnel makes absolute figures session-dependent
+(docs/microbenchmarks.md), so read the numbers above as a conservative
+band and the 2.6x-vs-einsum ratio as the stable claim.  ``bfloat16``
+inputs measure within the same band as f32 (2.09-2.17 ms/block,
+interleaved same-session comparison): the MXU already multiplies in
+bf16 for f32 dots by default, and operand traffic is not the
+bottleneck, so bf16 here saves memory, not time.
+
 End-to-end, the causal ring (examples/long_context_attention.py) skips
 fully-masked ring steps per rank (lax.cond) and drops masking on fully-
 visible blocks, so total causal FLOPs are n(n+1)/2 blocks instead of n^2.
