@@ -10,16 +10,27 @@ collective code paths that run on ICI, without TPU hardware
 
 import os
 
-# Must be set before jax initializes. JAX_PLATFORMS=cpu also overrides the
-# axon TPU plugin, whose sitecustomize would otherwise claim the backend.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
+# MPI4JAX_TPU_TEST_PLATFORM=ambient keeps the process's own backend (e.g.
+# a real TPU) instead of forcing the virtual CPU mesh — the opt-in lane
+# for tests/test_tpu_compiled.py, which exercises the Mosaic-COMPILED
+# Pallas kernels that interpret mode cannot (docs/developers.md).  Run it
+# against that file only: the rest of the suite assumes 8 devices.
+_AMBIENT = os.environ.get("MPI4JAX_TPU_TEST_PLATFORM") == "ambient"
+
+if not _AMBIENT:
+    # Must be set before jax initializes. JAX_PLATFORMS=cpu also overrides
+    # the axon TPU plugin, whose sitecustomize would otherwise claim the
+    # backend.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _AMBIENT:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

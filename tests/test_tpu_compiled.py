@@ -1,0 +1,165 @@
+"""Opt-in real-accelerator lane: the Mosaic-COMPILED Pallas kernels.
+
+The normal suite runs on the forced 8-device virtual CPU mesh, where
+every Pallas path takes its interpret/jnp form — identical arithmetic,
+but the compiled kernels themselves (Mosaic lowering, VMEM blocking,
+SMEM scalar operands, in-kernel rolls) are never built.  This file is
+the chip-side complement, the analog of the reference suite's second
+execution mode (``mpirun -np N pytest``, ref docs/developers.rst:15-27 —
+same tests, realer substrate):
+
+    MPI4JAX_TPU_TEST_PLATFORM=ambient python -m pytest \
+        tests/test_tpu_compiled.py -q
+
+With the env var set, conftest.py keeps the process's own backend (the
+attached TPU) instead of forcing CPU; without it — i.e. in the normal
+suite — every test here skips.  Run it against this file only: the rest
+of the suite assumes 8 devices.
+
+Each test compares a compiled kernel path against the fast jnp step on
+the SAME chip, so the assertion bounds are the fusion-order rounding
+bands established by the interpret-mode equality tests, not looser
+device tolerances.  Grids are kept small (a few kernel blocks) so the
+whole lane is a handful of compiles (~30 s each, first run).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+_AMBIENT = os.environ.get("MPI4JAX_TPU_TEST_PLATFORM") == "ambient"
+if _AMBIENT and jax.default_backend() != "tpu":
+    # the operator explicitly asked for the chip lane: a silent all-skip
+    # green run would mask a broken TPU attach — fail loudly instead
+    raise RuntimeError(
+        "MPI4JAX_TPU_TEST_PLATFORM=ambient is set but the backend is "
+        f"'{jax.default_backend()}', not 'tpu' — the accelerator plugin "
+        "did not claim the process; fix the attach before trusting this "
+        "lane"
+    )
+
+pytestmark = pytest.mark.skipif(
+    not _AMBIENT,
+    reason="real-TPU lane (MPI4JAX_TPU_TEST_PLATFORM=ambient)",
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+_RUNS = {}  # (cfg, fast, steps) -> State; Config is frozen/hashable
+
+
+def _run(cfg, fast, steps):
+    """Stepper runs, cached: the fast-step baseline for the periodic
+    config is shared by two tests, and each make_stepper costs a fresh
+    ~30 s XLA compile on chip."""
+    key = (cfg, fast, steps)
+    if key not in _RUNS:
+        from shallow_water import (
+            initial_state, make_mesh_and_comm, make_stepper,
+        )
+
+        _, comm = make_mesh_and_comm(cfg, devices=jax.devices()[:1])
+        first, multi = make_stepper(cfg, comm, fast=fast)
+        _RUNS[key] = multi(first(initial_state(cfg)), steps)
+    return _RUNS[key]
+
+
+def _assert_fields_close(a, b, what):
+    for name, x, y in zip(a._fields, a, b):
+        x, y = np.asarray(x), np.asarray(y)
+        bound = 5e-6 + 1e-6 * np.abs(x).max()
+        assert np.abs(x - y).max() <= bound, (
+            f"{what}: field {name} diverged on chip: "
+            f"{np.abs(x - y).max():.3e} > {bound:.3e}"
+        )
+
+
+def test_whole_step_pair_kernel_compiled():
+    """The benchmark path: the fused whole-step pair kernel, Mosaic-
+    compiled (multi-block grid: ny_local = 2 x _PBLK)."""
+    from shallow_water import Config, model_step_pallas, select_step
+
+    cfg = Config(nproc_y=1, nproc_x=1, nx=512, ny=254)
+    assert select_step("auto", cfg) is model_step_pallas
+    _assert_fields_close(
+        _run(cfg, "pallas2", 7), _run(cfg, True, 7), "pallas2"
+    )
+
+
+def test_wide_halo_kernel_compiled():
+    """The multi-rank path's kernels (wide masks, SMEM offsets, carried
+    frame with margin refresh), compiled on the single chip — walls
+    config, which 'auto' routes to the wide path."""
+    from dataclasses import replace
+
+    from shallow_water import Config, model_step_wide, select_step
+
+    cfg = replace(
+        Config(nproc_y=1, nproc_x=1, nx=512, ny=254), periodic_x=False
+    )
+    assert select_step("auto", cfg) is model_step_wide
+    _assert_fields_close(_run(cfg, "auto", 7), _run(cfg, True, 7), "wide")
+
+
+def test_wide_halo_kernel_compiled_periodic():
+    """Wide path on a periodic config: the wrap self-exchanges are elided
+    to identity routings; the compiled kernel must agree with the
+    specialist whole-step kernel's physics."""
+    from shallow_water import Config
+
+    cfg = Config(nproc_y=1, nproc_x=1, nx=512, ny=254)
+    _assert_fields_close(
+        _run(cfg, "wide2", 7), _run(cfg, True, 7), "wide-periodic"
+    )
+
+
+def test_flash_attention_kernel_compiled():
+    """The flash block kernel (masked, unmasked, and causal tile-skipping
+    forms) vs the jnp reference path, on chip."""
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.kernels.flash_attention import flash_block_partials
+
+    b, t, h, d = 2, 1024, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, t, h, d), jnp.float32) for kk in ks)
+    scale = 1.0 / np.sqrt(d)
+
+    tril = jnp.tril(jnp.ones((t, t), bool))
+    for kernel_kwargs, jnp_kwargs in (
+        (dict(mask=None), dict(mask=None)),
+        (dict(mask=tril), dict(mask=tril)),
+        (dict(mask=None, causal=True), dict(mask=tril)),
+    ):
+        o1, m1, l1 = flash_block_partials(
+            q, k, v, scale=scale, **kernel_kwargs
+        )
+        o2, m2, l2 = flash_block_partials(
+            q, k, v, scale=scale, force_jnp=True, **jnp_kwargs
+        )
+        # f32 dots ride the MXU's bf16-multiply default on chip, and the
+        # kernel and einsum accumulate in different orders, so scores —
+        # and everything downstream — agree to matmul (bf16-epsilon)
+        # precision, not CPU 1-ulp: observed ~1e-3 relative on m
+        np.testing.assert_allclose(
+            np.asarray(m1), np.asarray(m2), rtol=2e-2, atol=2e-2
+        )
+        np.testing.assert_allclose(
+            np.asarray(l1), np.asarray(l2), rtol=5e-2, atol=5e-2
+        )
+        # compare NORMALIZED attention (o / l): unnormalized partials have
+        # per-row magnitudes spanning orders of magnitude, so elementwise
+        # relative error is meaningless there (observed ~3e3 relative on
+        # near-zero partials that are ~0.3% of their row's scale)
+        def norm(o, l):
+            return np.asarray(o) / np.moveaxis(
+                np.maximum(np.asarray(l), 1e-6), 1, 2
+            )[..., None]
+
+        np.testing.assert_allclose(
+            norm(o1, l1), norm(o2, l2), atol=2e-2
+        )
