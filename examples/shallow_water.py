@@ -545,6 +545,31 @@ _PBLK = 128  # output rows per grid step (multiple of 8: f32 sublane tile)
 # the per-step recompute chain depth, with viscosity, is ~5 rows)
 
 
+def _margin_rows(nsteps: int) -> int:
+    """Margin / exchange depth for ``nsteps`` fused steps: 8 rows/cols of
+    validity per step (chain depth ~5), rounded up to a divisor of
+    ``_PBLK`` (the block-margin index maps need ``mrg | _PBLK``).  The
+    single source of this invariant for both the whole-step chunk kernels
+    and the wide-halo path."""
+    assert 1 <= nsteps <= 3, nsteps  # deeper fusion exceeds VMEM/compiler
+    m = 8 * nsteps
+    while _PBLK % m:
+        m += 8
+    return m
+
+
+def _window_fields(ins, nfields: int):
+    """Assemble ``nfields`` row windows from [prev-margin, main,
+    next-margin] block-ref triples — shared by every blocked kernel
+    body."""
+    return tuple(
+        jnp.concatenate(
+            [ins[3 * k][:], ins[3 * k + 1][:], ins[3 * k + 2][:]], axis=0
+        )
+        for k in range(nfields)
+    )
+
+
 def _rolls(roll, nr: int, nx: int):
     """The four stencil shifts as positive-shift rolls (``roll`` is
     ``pltpu.roll`` inside kernels, ``jnp.roll`` on the direct path — the
@@ -788,13 +813,7 @@ def _sw_steps_kernel(cfg: Config, first_step: bool, n_rows: int, mrg: int,
     ins, outs = refs[:18], refs[18:]
     nx = cfg.nx_local
     nr = _PBLK + 2 * mrg
-
-    def assemble(p, m, n):
-        return jnp.concatenate([p[:], m[:], n[:]], axis=0)
-
-    fields = tuple(
-        assemble(*ins[3 * k : 3 * k + 3]) for k in range(6)
-    )
+    fields = _window_fields(ins, 6)
 
     pid = pl.program_id(0)
     iy = (
@@ -1000,13 +1019,7 @@ def _sw_phase_kernel(cfg: Config, mrg: int, nfields: int, window, refs):
     ins, outs = refs[1:1 + 3 * nfields], refs[1 + 3 * nfields:]
     nx = cfg.nx_local
     nr = _PBLK + 2 * mrg
-
-    fields = tuple(
-        jnp.concatenate(
-            [ins[3 * k][:], ins[3 * k + 1][:], ins[3 * k + 2][:]], axis=0
-        )
-        for k in range(nfields)
-    )
+    fields = _window_fields(ins, nfields)
 
     pid = pl.program_id(0)
     iy = jax.lax.broadcasted_iota(jnp.int32, (nr, nx), 0) + pid * _PBLK - mrg
@@ -1133,6 +1146,22 @@ def model_step_pallas_halo(state: State, cfg: Config, comm: mpx.Comm,
 # ---------------------------------------------------------------------------
 
 
+def _strip_exch(payload, route, c, token):
+    """Exchange one batched halo strip along a direction: a single
+    ``sendrecv``, with a zeros recv template (``MPI_PROC_NULL``: edge
+    ranks of non-wrapping directions keep zeros).  Size-1 axes resolve
+    without any collective — identity for a wrapping route, zeros for a
+    non-wrapping one.  Every strip exchange gets the CALLER's token, not
+    a chain: the exchanges of one widening/refresh are mutually
+    independent (the x -> y phase ordering is a data dependency already),
+    and chaining would serialize what XLA can overlap."""
+    if c.Get_size() == 1:
+        return payload if route.wrap else jnp.zeros_like(payload)
+    out, _ = mpx.sendrecv(payload, jnp.zeros_like(payload), dest=route,
+                          comm=c, token=token)
+    return out
+
+
 def _wide_exchange(fields, cfg: Config, comm: mpx.Comm, m: int, token):
     """Build the widened frame for ``model_step_pallas_wide``: every side
     gains ``m - 1`` rows/cols of neighbor data beyond the existing 1-cell
@@ -1167,25 +1196,13 @@ def _wide_exchange(fields, cfg: Config, comm: mpx.Comm, m: int, token):
     commx, commy = comm.sub("px"), comm.sub("py")
     wrap_x = cfg.periodic_x
 
-    def exch(payload, template, route, c, token):
-        # all four exchanges get the CALLER's token, not a chain: they are
-        # mutually independent (the x -> y ordering is a data dependency
-        # already), and chaining would serialize what XLA can overlap
-        if c.Get_size() == 1:
-            # no neighbor (template) or self-wrap (a CollectivePermute
-            # along a size-1 axis is the identity: skip the collective)
-            return (payload if route.wrap else template), token
-        return mpx.sendrecv(payload, template, dest=route, comm=c,
-                            token=token)
-
     # ---- x phase: (6, nyl, m) strips --------------------------------
     lo = jnp.stack([f[:, 1:m + 1] for f in fields])
     hi = jnp.stack([f[:, nxl - 1 - m:nxl - 1] for f in fields])
-    zs = jnp.zeros_like(lo)
     # high-side strips travel east (shift +1): each rank receives its WEST
     # neighbor's easternmost interior columns, and vice versa
-    from_west, _ = exch(hi, zs, shift(+1, wrap=wrap_x), commx, token)
-    from_east, _ = exch(lo, zs, shift(-1, wrap=wrap_x), commx, token)
+    from_west = _strip_exch(hi, shift(+1, wrap=wrap_x), commx, token)
+    from_east = _strip_exch(lo, shift(-1, wrap=wrap_x), commx, token)
     wx = []
     for k, f in enumerate(fields):
         w, e = from_west[k], from_east[k]
@@ -1197,9 +1214,8 @@ def _wide_exchange(fields, cfg: Config, comm: mpx.Comm, m: int, token):
     # ---- y phase: (6, m, nx_w) strips of the x-widened arrays -------
     lo = jnp.stack([f[1:m + 1] for f in wx])
     hi = jnp.stack([f[nyl - 1 - m:nyl - 1] for f in wx])
-    zs = jnp.zeros_like(lo)
-    from_south, _ = exch(hi, zs, shift(+1, wrap=False), commy, token)
-    from_north, _ = exch(lo, zs, shift(-1, wrap=False), commy, token)
+    from_south = _strip_exch(hi, shift(+1, wrap=False), commy, token)
+    from_north = _strip_exch(lo, shift(-1, wrap=False), commy, token)
     out = []
     for k, f in enumerate(wx):
         s, n = from_south[k], from_north[k]
@@ -1250,13 +1266,7 @@ def _sw_wide_kernel(cfg: Config, first_step: bool, mrg: int, nsteps: int,
     ins, outs = refs[1:19], refs[19:]
     nx_w = ins[1].shape[1]
     nr = _PBLK + 2 * mrg
-
-    fields = tuple(
-        jnp.concatenate(
-            [ins[3 * k][:], ins[3 * k + 1][:], ins[3 * k + 2][:]], axis=0
-        )
-        for k in range(6)
-    )
+    fields = _window_fields(ins, 6)
 
     pid = pl.program_id(0)
     wy = (
@@ -1316,19 +1326,6 @@ def model_step_pallas_wide(state: State, cfg: Config, comm: mpx.Comm,
     wfields, token = _wide_exchange(tuple(state), cfg, comm, m, token)
     outs = _wide_kernel_call(wfields, cfg, first_step, nsteps, m, interpret)
     return _wide_crop(outs, cfg, m)
-
-
-def _margin_rows(nsteps: int) -> int:
-    """Margin / exchange depth for ``nsteps`` fused steps: 8 rows/cols of
-    validity per step (chain depth ~5), rounded up to a divisor of
-    ``_PBLK`` (the block-margin index maps need ``mrg | _PBLK``).  The
-    single source of this invariant for both the whole-step chunk kernels
-    and the wide-halo path."""
-    assert 1 <= nsteps <= 3, nsteps  # deeper fusion exceeds VMEM/compiler
-    m = 8 * nsteps
-    while _PBLK % m:
-        m += 8
-    return m
 
 
 def _wide_kernel_call(wfields, cfg: Config, first_step: bool, nsteps: int,
@@ -1417,24 +1414,17 @@ def _wide_refresh(wf, cfg: Config, comm: mpx.Comm, m: int, token):
     commx, commy = comm.sub("px"), comm.sub("py")
     wrap_x = cfg.periodic_x
 
-    def exch(payload, route, c):
-        if c.Get_size() == 1:
-            return payload if route.wrap else jnp.zeros_like(payload)
-        out, _ = mpx.sendrecv(payload, jnp.zeros_like(payload), dest=route,
-                              comm=c, token=token)
-        return out
-
     # ---- x bands: (6, ny_w, e) ----
     # west margin <- west neighbor's easternmost interior (its widened
     # cols [nxl-2, nxl-2+e)); east margin <- east neighbor's westernmost
     # (its widened cols [e+2, 2e+2))
-    from_west = exch(
+    from_west = _strip_exch(
         jnp.stack([f[:, nxl - 2:nxl - 2 + e] for f in wf]),
-        shift(+1, wrap=wrap_x), commx,
+        shift(+1, wrap=wrap_x), commx, token,
     )
-    from_east = exch(
+    from_east = _strip_exch(
         jnp.stack([f[:, e + 2:2 * e + 2] for f in wf]),
-        shift(-1, wrap=wrap_x), commx,
+        shift(-1, wrap=wrap_x), commx, token,
     )
     wf = tuple(
         f.at[:, :e].set(from_west[k]).at[:, e + nxl:].set(from_east[k])
@@ -1442,13 +1432,13 @@ def _wide_refresh(wf, cfg: Config, comm: mpx.Comm, m: int, token):
     )
 
     # ---- y bands: (6, e, nx_w), full width (corners now valid) ----
-    from_south = exch(
+    from_south = _strip_exch(
         jnp.stack([f[nyl - 2:nyl - 2 + e] for f in wf]),
-        shift(+1, wrap=False), commy,
+        shift(+1, wrap=False), commy, token,
     )
-    from_north = exch(
+    from_north = _strip_exch(
         jnp.stack([f[e + 2:2 * e + 2] for f in wf]),
-        shift(-1, wrap=False), commy,
+        shift(-1, wrap=False), commy, token,
     )
     return tuple(
         f.at[:e, :].set(from_south[k]).at[e + nyl:, :].set(from_north[k])

@@ -94,13 +94,9 @@ def test_wide_halo_kernel_compiled():
     """The multi-rank path's kernels (wide masks, SMEM offsets, carried
     frame with margin refresh), compiled on the single chip — walls
     config, which 'auto' routes to the wide path."""
-    from dataclasses import replace
-
     from shallow_water import Config, model_step_wide, select_step
 
-    cfg = replace(
-        Config(nproc_y=1, nproc_x=1, nx=512, ny=254), periodic_x=False
-    )
+    cfg = Config(nproc_y=1, nproc_x=1, nx=512, ny=254, periodic_x=False)
     assert select_step("auto", cfg) is model_step_wide
     _assert_fields_close(_run(cfg, "auto", 7), _run(cfg, True, 7), "wide")
 
