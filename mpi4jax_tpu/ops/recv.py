@@ -22,7 +22,7 @@ from typing import Optional
 import jax
 
 from ..parallel.comm import Comm
-from ..parallel.rankspec import normalize_source
+from ..parallel.rankspec import resolve_routing
 from ..parallel.region import current_context, in_parallel_region, resolve_comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
@@ -33,10 +33,11 @@ from .status import Status
 from .token import Token, consume, produce
 
 
-def _check_recv_match(pending, template, source, size):
-    """Shared send↔recv compatibility checks (routing + type signature)."""
+def _check_recv_match(pending, template, source, comm):
+    """Shared send↔recv compatibility checks (routing + type signature).
+    ``pending.pairs`` are GLOBAL (resolved by the send side)."""
     if source is not None:
-        pairs_s = normalize_source(source, size, what="recv")
+        pairs_s = resolve_routing(comm, source, None, what="recv")
         if pairs_s != pending.pairs:
             raise ValueError(
                 f"recv: source spec implies routing {pairs_s} but the "
@@ -68,7 +69,6 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
 
     def body(comm, arrays, token):
         (template,) = arrays
-        size = comm.Get_size()
         ctx = current_context()
         q = ctx.queue(comm.uid, tag)
         if not q:
@@ -79,11 +79,11 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
                 "run time; this framework turns it into a trace error)."
             )
         pending = q.popleft()
-        _check_recv_match(pending, template, source, size)
+        _check_recv_match(pending, template, source, comm)
         payload = as_varying(consume(token, pending.value), comm.axes)
         log_op("MPI_Recv", comm.Get_rank(),
                f"{payload.size} items along {list(pending.pairs)} (tag {tag})")
-        pairs = comm.expand_pairs(pending.pairs)  # local -> global
+        pairs = pending.pairs  # GLOBAL (resolved by the send side)
         res = _apply_permute(payload, template, pairs, comm)
         _fill_status(status, pairs, comm, payload.size, payload.dtype, tag)
         return res, produce(token, res)
@@ -120,9 +120,8 @@ def _eager_recv(x, source, tag, comm, status, token):
             and not tracer_is_live(pending.value)):
         q.popleft()  # can never be received — drop with a clear error
         raise RuntimeError(_STALE_SEND_MSG.format(tag=tag))
-    size = comm.Get_size()
-    _check_recv_match(pending, x, source, size)
-    pairs = comm.expand_pairs(pending.pairs)  # local -> global
+    _check_recv_match(pending, x, source, comm)
+    pairs = pending.pairs  # GLOBAL (resolved by the send side)
 
     def body(comm, arrays, token):
         xl, template = arrays

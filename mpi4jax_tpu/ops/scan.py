@@ -34,20 +34,32 @@ def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
 
     def body(comm, arrays, token):
         (xl,) = arrays
-        size = comm.Get_size()
         xl = consume(token, xl)
         rank = comm.Get_rank()
         log_op("MPI_Scan", rank, f"with {xl.size} items")
         fn = combine_fn(op)
         acc = xl
+        groups = comm.groups
+        if groups is None:
+            groups = [tuple(range(comm.Get_size()))]
+            expand = comm.expand_pairs
+        else:
+            # color split: group tables are static, so the per-group pairs
+            # are built directly — UNEQUAL group sizes included (each
+            # group runs its own prefix; rounds beyond a group's size
+            # simply contribute no pairs for it).  ``rank`` is group-local
+            # here, so the participation mask needs no change.
+            expand = tuple
         d = 1
-        while d < size:
-            # rank r-d sends its accumulator to rank r (for r >= d); on a
-            # color split the pairs are group-local and expand to one
-            # global permute per round (rank is group-local there too, so
-            # the participation mask needs no change)
-            perm = comm.expand_pairs([(r - d, r) for r in range(d, size)])
-            recvd = lax.ppermute(acc, comm.axis, perm)
+        while d < max(len(g) for g in groups):
+            # rank r-d sends its accumulator to rank r (for r >= d), one
+            # global permute per round across all groups
+            perm = expand(
+                (members[r - d], members[r])
+                for members in groups
+                for r in range(d, len(members))
+            )
+            recvd = lax.ppermute(acc, comm.axis, list(perm))
             acc = jnp.where(rank >= d, fn(acc, recvd), acc)
             d *= 2
         return acc, produce(token, acc)

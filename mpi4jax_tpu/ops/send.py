@@ -30,7 +30,7 @@ from collections import deque
 from typing import Dict, NamedTuple, Optional, Tuple
 
 from ..parallel.comm import Comm
-from ..parallel.rankspec import normalize_dest
+from ..parallel.rankspec import resolve_routing
 from ..parallel.region import current_context, in_parallel_region, resolve_comm
 from ..utils.debug import log_op
 from ..utils.dtypes import check_dtype
@@ -91,9 +91,10 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
         # (ops/recv.py) instead of a leaked-tracer failure.
         check_dtype(x, "send")
         # global arrays span ALL ranks (world) even on a color-split comm;
-        # the routing spec is comm-local (group size)
+        # the routing spec is comm-local (per-group on a split) and
+        # resolves to GLOBAL pairs
         check_global_shape("send", x, c.world_size())
-        pairs = normalize_dest(dest, c.Get_size(), what="send")
+        pairs = resolve_routing(c, None, dest, what="send")
         log_op("MPI_Send", 0,
                f"deferred: {x.size // c.world_size()} items/rank along "
                f"{list(pairs)} (tag {tag})")
@@ -104,8 +105,7 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
 
     def body(comm, arrays, token):
         (xl,) = arrays
-        size = comm.Get_size()
-        pairs = normalize_dest(dest, size, what="send")
+        pairs = resolve_routing(comm, None, dest, what="send")  # GLOBAL
         xl = consume(token, xl)
         log_op("MPI_Send", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)} (tag {tag})")

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..parallel.comm import Comm
-from ..parallel.rankspec import normalize_dest, normalize_source
+from ..parallel.rankspec import resolve_routing
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
 from ._base import dispatch
@@ -34,25 +34,9 @@ from .status import Status
 from .token import Token, consume, produce
 
 
-def _resolve_pairs(source, dest, size, what):
-    if dest is None and source is None:
-        raise ValueError(
-            f"{what}: provide a routing spec via dest= and/or source= "
-            "(e.g. dest=shift(1) for a ring)"
-        )
-    pairs_d = normalize_dest(dest, size, what=what) if dest is not None else None
-    pairs_s = normalize_source(source, size, what=what) if source is not None else None
-    if pairs_d is not None and pairs_s is not None and pairs_d != pairs_s:
-        raise ValueError(
-            f"{what}: inconsistent routing — dest spec gives pairs {pairs_d} "
-            f"but source spec gives pairs {pairs_s}"
-        )
-    return pairs_d if pairs_d is not None else pairs_s
-
-
 def _apply_permute(xl, recvbuf, pairs, comm):
-    """Run one CollectivePermute along GLOBAL pairs (comm-local routing
-    specs are translated through ``comm.expand_pairs`` before this).
+    """Run one CollectivePermute along GLOBAL pairs (routing specs are
+    resolved through ``rankspec.resolve_routing`` before this).
 
     An identity routing — every pair ``(r, r)``, e.g. any wrapping
     ``shift`` on a size-1 axis — skips the collective entirely: the
@@ -156,15 +140,14 @@ def sendrecv(
 
         c = resolve_comm(comm)
         if c.mesh is not None and not in_parallel_region(c):
-            resolved_pairs = _resolve_pairs(source, dest, c.Get_size(), "sendrecv")
+            resolved_pairs = resolve_routing(c, source, dest, what="sendrecv")
             static_key = (resolved_pairs, sendtag, recvtag)
 
     def body(comm, arrays, token):
         xl, rbuf = arrays
         pairs = resolved_pairs
-        if pairs is None:
-            pairs = _resolve_pairs(source, dest, comm.Get_size(), "sendrecv")
-        pairs = comm.expand_pairs(pairs)  # comm-local -> global (color split)
+        if pairs is None:  # in-region: resolve at trace time, already GLOBAL
+            pairs = resolve_routing(comm, source, dest, what="sendrecv")
         xl = consume(token, xl)
         log_op("MPI_Sendrecv", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)}")

@@ -258,10 +258,12 @@ class GroupComm(Comm):
     group size.  All 12 ops work on UNIFORM group sizes;
     allreduce/reduce/bcast/barrier additionally work on unequal-sized
     partitions.  Ops whose routing or output shape needs a static group
-    size (point-to-point, the gather family, scan) raise ``Get_size``'s
-    clear error on unequal groups — one SPMD program cannot express a
-    per-group shape (the rank-dependent-shape restriction,
-    docs/sharp_bits.md).
+    size (the gather family: allgather/alltoall/gather/scatter) raise
+    ``Get_size``'s clear error on unequal groups — one SPMD program
+    cannot express a per-group shape (the rank-dependent-shape
+    restriction, docs/sharp_bits.md).  ``scan`` and point-to-point
+    (``shift``/callable routing) work on unequal groups too: their
+    routing comes from the static group tables, not a uniform size.
     """
 
     def __init__(self, parent: Comm, groups):
@@ -299,9 +301,10 @@ class GroupComm(Comm):
             raise RuntimeError(
                 f"Get_size on a color-split comm with unequal group sizes "
                 f"{sorted(len(g) for g in self._groups)} has no single "
-                "static value. allreduce/reduce/bcast/barrier work on "
-                "unequal groups; ops that need a static size (point-to-"
-                "point routing, shapes) require uniform groups."
+                "static value. Only the gather family (allgather/"
+                "alltoall/gather/scatter) needs uniform groups — its "
+                "output shapes depend on the group size; every other op "
+                "works on unequal groups."
             )
         return sizes.pop()
 

@@ -140,3 +140,43 @@ def normalize_source(spec: RankSpecLike, size: int, *,
 
 def invert_pairs(pairs: Sequence[Tuple[int, int]]) -> Tuple[Tuple[int, int], ...]:
     return tuple(sorted((d, s) for s, d in pairs))
+
+
+def resolve_routing(comm, source, dest, *, what: str
+                    ) -> Tuple[Tuple[int, int], ...]:
+    """Normalize ``source``/``dest`` specs to GLOBAL (src, dst) pairs over
+    ``comm``'s mesh axes — the single resolution point for every
+    point-to-point op.
+
+    Give either spec (the other is inferred) or both (validated for
+    consistency).  On a color-split comm each group normalizes the spec at
+    ITS OWN size and maps through the static member tables, so
+    ``shift``/callable specs route correctly on UNEQUAL group sizes too
+    (each group gets its own ring/edge pattern); a dict/pairs spec naming
+    a rank a group doesn't have raises that group's out-of-range error.
+    """
+
+    def norm(size):
+        pairs_d = (normalize_dest(dest, size, what=what)
+                   if dest is not None else None)
+        pairs_s = (normalize_source(source, size, what=what)
+                   if source is not None else None)
+        if pairs_d is not None and pairs_s is not None and pairs_d != pairs_s:
+            raise ValueError(
+                f"{what}: inconsistent routing — dest spec gives pairs "
+                f"{pairs_d} but source spec gives pairs {pairs_s}"
+            )
+        if pairs_d is None and pairs_s is None:
+            raise ValueError(
+                f"{what}: provide a routing spec via dest= and/or source= "
+                "(e.g. dest=shift(1) for a ring)"
+            )
+        return pairs_d if pairs_d is not None else pairs_s
+
+    groups = comm.groups
+    if groups is None:
+        return tuple(comm.expand_pairs(norm(comm.Get_size())))
+    out = []
+    for members in groups:
+        out.extend((members[s], members[d]) for s, d in norm(len(members)))
+    return tuple(sorted(out))

@@ -238,8 +238,59 @@ def test_split_gather_family_nonuniform_raises():
     split = comm.Split(COLORS_2)
     with pytest.raises(RuntimeError, match="unequal group sizes"):
         mpx.allgather(ranks_arange((1,)), comm=split)
-    with pytest.raises(RuntimeError, match="unequal group sizes"):
-        mpx.scan(ranks_arange((1,)), mpx.SUM, comm=split)
+
+
+def test_split_p2p_nonuniform_groups():
+    """Point-to-point on UNEQUAL groups: shift routing normalizes at each
+    group's own size (a per-group ring), via the static member tables."""
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+
+    @mpx.spmd
+    def ring(x):
+        y, t = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=split)
+        t2 = mpx.send(x, dest=mpx.shift(-1), tag=3, comm=split, token=t)
+        z, _ = mpx.recv(x, source=mpx.shift(1), tag=3, comm=split, token=t2)
+        return y, z
+
+    y, z = ring(ranks_arange((1,)))
+    exp_y = np.empty(size, np.float32)
+    exp_z = np.empty(size, np.float32)
+    for g in GROUPS_2:
+        n = len(g)
+        for i, r in enumerate(g):
+            exp_y[r] = g[(i - 1) % n]  # received from group-left neighbor
+            exp_z[r] = g[(i + 1) % n]  # send left <=> recv from group-right
+    np.testing.assert_allclose(np.asarray(y)[:, 0], exp_y)
+    np.testing.assert_allclose(np.asarray(z)[:, 0], exp_z)
+
+
+def test_split_p2p_nonuniform_dict_raises():
+    comm, _ = world()
+    split = comm.Split(COLORS_2)
+    with pytest.raises(ValueError, match="out of range"):
+        # rank 3 exists in the 5-group but not the 3-group
+        mpx.sendrecv(ranks_arange((1,)), ranks_arange((1,)),
+                     dest={0: 3}, comm=split)
+
+
+def test_split_scan_nonuniform_groups():
+    """Prefix reduction on UNEQUAL groups: scan's routing comes from the
+    static group tables (one masked permute round per doubling offset up
+    to the largest group), so the uniform-size restriction of the
+    shape-bound ops does not apply to it."""
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+
+    sc, _ = mpx.scan(ranks_arange((1,)), mpx.SUM, comm=split)
+    out = np.asarray(sc)[:, 0]
+    exp = np.empty(size, np.float32)
+    for g in GROUPS_2:
+        run = 0.0
+        for r in g:
+            run += r
+            exp[r] = run  # inclusive prefix in group order
+    np.testing.assert_allclose(out, exp)
 
 
 def test_split_validation_errors():
