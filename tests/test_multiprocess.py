@@ -105,6 +105,22 @@ WORKER = textwrap.dedent(
         assert np.isfinite(block).all(), (proc_id, s.index)
         assert 50 < block.mean() < 150  # height near resting depth
 
+    # --- 3b. unequal color split across real process boundaries -----------
+    if size >= 3:
+        uneq = mpx.get_default_comm().Split([0, 0] + [1] * (size - 2))
+        xs = jnp.arange(float(size))[:, None]
+        sc, _ = mpx.scan(xs, mpx.SUM, comm=uneq)
+        rg, _ = mpx.sendrecv(xs, xs, dest=mpx.shift(1), comm=uneq)
+        for arr, expect in ((sc, "scan"), (rg, "ring")):
+            for s in arr.addressable_shards:
+                r = s.index[0].start
+                got = float(np.asarray(s.data)[0, 0])
+                g = next(grp for grp in uneq.groups if r in grp)
+                i = g.index(r)
+                want = (float(sum(g[: i + 1])) if expect == "scan"
+                        else float(g[(i - 1) % len(g)]))
+                assert got == want, (proc_id, expect, r, got, want)
+
     # --- 4. wide-halo carried frame across real process boundaries --------
     # 16-cell local interiors: "auto" ships the communication-avoiding
     # wide path, whose margin-band sendrecvs here cross processes
