@@ -374,10 +374,42 @@ class GroupComm(Comm):
         )
 
     def Split(self, color, key=None) -> "Comm":
-        raise ValueError(
-            "nested Split of a color-split comm is not supported — split "
-            "the parent comm with combined colors instead"
-        )
+        """Nested ``MPI_Comm_split``: refine this partition.
+
+        ``colors``/``key`` are world-length tables indexed by GLOBAL rank
+        (the same SPMD convention as the parent's ``Split`` — every rank
+        of the mesh belongs to some group, so every rank needs an entry).
+        New groups form WITHIN each existing group — two ranks share a new
+        group only if they share both the old group and the new color —
+        ordered by ``(key, old group-local rank)``, MPI's rule with "rank
+        in the old comm" being the group-local rank."""
+        if isinstance(color, str):
+            raise ValueError(
+                "grid splits of a color-split comm are not supported — "
+                "take sub-comms from the parent comm before splitting"
+            )
+        n = len(self._lrank)
+        colors = list(color)
+        if len(colors) != n:
+            raise ValueError(
+                f"Split: colors must list every rank's color "
+                f"(got {len(colors)} entries for {n} mesh ranks; on a "
+                "color-split comm the table is indexed by GLOBAL rank)"
+            )
+        keys = list(key) if key is not None else [0] * n
+        if len(keys) != n:
+            raise ValueError(
+                f"Split: key must have one entry per rank "
+                f"(got {len(keys)} for {n})"
+            )
+        new_groups = []
+        for members in self._groups:
+            by_color = {}
+            for i, r in enumerate(members):
+                by_color.setdefault(colors[r], []).append((keys[r], i, r))
+            for _, lst in sorted(by_color.items(), key=lambda kv: str(kv[0])):
+                new_groups.append(tuple(r for _, _, r in sorted(lst)))
+        return GroupComm(self, tuple(new_groups))
 
     def __repr__(self):
         return (f"GroupComm(axes={self._axes}, groups={self._groups}, "

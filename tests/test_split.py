@@ -300,7 +300,9 @@ def test_split_validation_errors():
     with pytest.raises(ValueError, match="one entry per rank"):
         comm.Split([0] * size, key=[0])
     split = comm.Split(COLORS_EO)
-    with pytest.raises(ValueError, match="nested Split"):
+    # nested Split works (test_split_nested) but still wants the
+    # world-length table, not a group-length one
+    with pytest.raises(ValueError, match="GLOBAL rank"):
         split.Split([0] * (size // 2))
     with pytest.raises(ValueError, match="sub\\(\\) on a color-split"):
         split.sub("x")
@@ -370,3 +372,44 @@ def test_split_allreduce_noncommutative_op_group_consistent():
         for r in g[1:]:
             acc -= r
         np.testing.assert_allclose(out[list(g)], acc)
+
+
+def test_split_nested():
+    """Nested MPI_Comm_split: refining a split refines WITHIN each group
+    (world-length color table, group-local-rank tie-breaking)."""
+    comm, size = world()
+    parent = comm.Split(COLORS_2)  # (0,3,5) / (1,2,4,6,7)
+    nested = parent.Split([r % 2 for r in range(size)])
+    assert nested.groups == ((0,), (3, 5), (2, 4, 6), (1, 7))
+
+    s, _ = mpx.allreduce(ranks_arange((1,)), mpx.SUM, comm=nested)
+    np.testing.assert_allclose(
+        np.asarray(s)[:, 0],
+        _expected_groupwise(np.arange(8.0), nested.groups, sum),
+    )
+
+    with pytest.raises(ValueError, match="grid splits"):
+        parent.Split("py")
+    with pytest.raises(ValueError, match="GLOBAL rank"):
+        parent.Split([0, 1])
+
+
+def test_split_eager_unequal_p2p_and_scan():
+    """The standalone-eager path (cached one-op programs, resolve_routing
+    at build time) handles unequal splits too."""
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+    x = ranks_arange((1,))
+
+    ring, _ = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=split)
+    sc, _ = mpx.scan(x, mpx.SUM, comm=split)
+    exp_ring = np.empty(size, np.float32)
+    exp_sc = np.empty(size, np.float32)
+    for g in GROUPS_2:
+        run = 0.0
+        for i, r in enumerate(g):
+            exp_ring[r] = g[(i - 1) % len(g)]
+            run += r
+            exp_sc[r] = run
+    np.testing.assert_allclose(np.asarray(ring)[:, 0], exp_ring)
+    np.testing.assert_allclose(np.asarray(sc)[:, 0], exp_sc)
