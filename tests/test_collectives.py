@@ -194,6 +194,35 @@ def test_scan(op, npfn):
     assert np.allclose(out, npfn(vals, axis=0), rtol=1e-5), (out, npfn(vals, axis=0))
 
 
+def test_scan_grad():
+    """Reverse- and forward-mode through the prefix scan (beyond the
+    reference, which has autodiff only for allreduce/sendrecv): the
+    Hillis-Steele permute rounds transpose like any ppermute chain.
+    d(sum_s prefix_s^2)/dx_r = 2 * sum_{s >= r} prefix_s per group order."""
+    _, size = world()
+
+    @mpx.spmd
+    def parts(x):
+        res, _ = mpx.scan(x, op=mpx.SUM)
+        return (res ** 2).sum(axis=-1, keepdims=True)
+
+    def loss(x):
+        return parts(x).sum()
+
+    x = jnp.linspace(1.0, 2.0, size)[:, None]
+    g = np.asarray(jax.grad(loss)(x))[:, 0]
+    pref = np.cumsum(np.asarray(x)[:, 0])
+    exp = np.array([2 * pref[r:].sum() for r in range(size)])
+    np.testing.assert_allclose(g, exp, rtol=1e-5)
+
+    # forward mode: tangent of the prefix is the prefix of the tangent
+    tan = jnp.ones_like(x)
+    _, jv = jax.jvp(loss, (x,), (tan,))
+    # dL = sum_s 2 * prefix_s * (s+1-ish prefix of ones) in group order
+    exp_jv = (2 * pref * np.arange(1, size + 1)).sum()
+    np.testing.assert_allclose(float(jv), exp_jv, rtol=1e-5)
+
+
 def test_scan_int():
     _, size = world()
 
