@@ -143,7 +143,6 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
 _FLASH_MAX_UNTILED_TK = 4096
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal=False):
     """Single-device attention via the fused flash kernel: block partials +
     normalization, so the (T, T) score matrix never reaches HBM (the
@@ -151,9 +150,10 @@ def flash_attention(q, k, v, causal=False):
     key-tile-skipping kernel on TPU; very long NON-causal sequences fall
     back to the einsum (the untiled kernel would overflow VMEM).
 
-    Differentiable: the backward pass recomputes through the einsum
-    reference (a ``custom_vjp`` — the Pallas forward has no transpose
-    rule), so gradients match ``reference_attention``'s.
+    Differentiable on every backend: ``flash_block_partials`` carries a
+    blockwise custom VJP (Pallas backward kernels on TPU), so gradients
+    match ``reference_attention``'s without ever materializing the score
+    matrix — forward or backward.
     """
     if not causal and q.shape[1] > _FLASH_MAX_UNTILED_TK:
         return reference_attention(q, k, v, causal=causal)
@@ -161,22 +161,6 @@ def flash_attention(q, k, v, causal=False):
     o, _, l = flash_block_partials(q, k, v, None, scale=scale, causal=causal)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return (o / jnp.moveaxis(l_safe, 1, 2)[..., None]).astype(q.dtype)
-
-
-def _flash_attention_fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal), (q, k, v)
-
-
-def _flash_attention_bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
-        q, k, v,
-    )
-    return vjp(g)
-
-
-flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
 def ulysses_attention(q, k, v, *, comm=None, causal=False):

@@ -210,6 +210,136 @@ def test_causal_kernel_matches_tril_mask(b, t, h, d):
                                rtol=1e-4, atol=1e-4)
 
 
+def _normalized(o, l):
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return o / jnp.moveaxis(l_safe, 1, 2)[..., None]
+
+
+@pytest.mark.parametrize(
+    "b,tq,tk,h,d,causal,masked",
+    [
+        (1, 16, 16, 2, 32, False, False),
+        (2, 16, 24, 2, 32, False, False),   # rectangular ring block
+        (2, 16, 24, 2, 32, False, True),    # user mask (float0 cotangent)
+        (1, 64, 64, 2, 32, True, False),    # causal kernel
+        (1, 550, 550, 1, 32, True, False),  # ragged tiles (padding guards)
+    ],
+)
+def test_grad_kernel_matches_jnp_path(b, tq, tk, h, d, causal, masked):
+    """The blockwise backward (Pallas kernels, run under interpret) must
+    agree with the dense jnp backward — same custom-VJP formula, different
+    execution/tiling — through a normalized-attention loss."""
+    q, k, v = _qkv(11, b, tq, tk, h, d)
+    mask = (
+        jax.random.bernoulli(jax.random.PRNGKey(7), 0.8, (tq, tk))
+        if masked else None
+    )
+    scale = 1.0 / math.sqrt(d)
+
+    def loss(q, k, v, **kwargs):
+        o, _, l = flash_block_partials(
+            q, k, v, mask, scale=scale, causal=causal, **kwargs
+        )
+        return (_normalized(o, l) ** 2).sum()
+
+    g_k = jax.grad(lambda *a: loss(*a, interpret=True), (0, 1, 2))(q, k, v)
+    g_j = jax.grad(lambda *a: loss(*a, force_jnp=True), (0, 1, 2))(q, k, v)
+    for a, e, nm in zip(g_k, g_j, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-3, atol=1e-4,
+            err_msg=f"d{nm}",
+        )
+
+
+@pytest.mark.parametrize("impl", ["interpret", "force_jnp"])
+def test_grad_through_blockwise_merge(impl):
+    """Gradients through a merge_partials chain: this is the path where a
+    NONZERO stabilizer cotangent (g_m) reaches the custom VJP and is
+    dropped — exact because the merge rule is stabilizer-invariant.  The
+    composed gradient must match full-softmax attention's."""
+    b, t, h, d = 1, 32, 2, 32
+    q, k, v = _qkv(12, b, t, t, h, d)
+    scale = 1.0 / math.sqrt(d)
+    kwargs = {impl: True} if impl == "force_jnp" else {"interpret": True}
+    n_blocks, blk = 4, t // 4
+
+    def loss_blockwise(q, k, v):
+        m = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+        l = jnp.zeros((b, h, t), jnp.float32)
+        acc = jnp.zeros_like(q)
+        for i in range(n_blocks):
+            kb = k[:, i * blk: (i + 1) * blk]
+            vb = v[:, i * blk: (i + 1) * blk]
+            o_new, m_new, l_new = flash_block_partials(
+                q, kb, vb, None, scale=scale, **kwargs
+            )
+            acc, m, l = merge_partials(acc, m, l, o_new, m_new, l_new)
+        return ((acc / jnp.moveaxis(l, 1, 2)[..., None]) ** 2).sum()
+
+    def loss_full(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+        return (out ** 2).sum()
+
+    g_b = jax.grad(loss_blockwise, (0, 1, 2))(q, k, v)
+    g_f = jax.grad(loss_full, (0, 1, 2))(q, k, v)
+    for a, e, nm in zip(g_b, g_f, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=5e-3, atol=5e-4,
+            err_msg=f"d{nm}",
+        )
+
+
+@pytest.mark.parametrize("impl", ["interpret", "force_jnp"])
+def test_grad_fully_masked_rows_no_nan(impl):
+    """Rows with no attendable key (m = -inf, l = 0) must produce ZERO
+    gradients, not NaN, so the ring's skipped-block merges stay clean."""
+    b, t, h, d = 1, 16, 2, 32
+    q, k, v = _qkv(13, b, t, t, h, d)
+    mask = jnp.zeros((t, t), bool)
+    kwargs = {impl: True} if impl == "force_jnp" else {"interpret": True}
+
+    def loss(q, k, v):
+        o, _, l = flash_block_partials(q, k, v, mask, scale=0.2, **kwargs)
+        return (_normalized(o, l) ** 2).sum()
+
+    g = jax.grad(loss, (0, 1, 2))(q, k, v)
+    for a, nm in zip(g, "qkv"):
+        a = np.asarray(a)
+        assert not np.any(np.isnan(a)), f"d{nm} has NaN"
+        np.testing.assert_array_equal(a, np.zeros_like(a), err_msg=f"d{nm}")
+
+
+def test_grad_bf16_dtype_contract():
+    """Cotangents keep the primal dtypes on both backward paths."""
+    b, t, h, d = 1, 16, 2, 32
+    q, k, v = _qkv(14, b, t, t, h, d, dtype=jnp.bfloat16)
+    for kwargs in ({"interpret": True}, {"force_jnp": True}):
+        def loss(q, k, v):
+            o, _, l = flash_block_partials(
+                q, k, v, None, scale=0.2, causal=True, **kwargs
+            )
+            return (_normalized(o, l).astype(jnp.float32) ** 2).sum()
+
+        g = jax.grad(loss, (0, 1, 2))(q, k, v)
+        assert all(a.dtype == jnp.bfloat16 for a in g), kwargs
+
+
+def test_forward_mode_stays_supported_on_jnp_path():
+    """The custom VJP wraps only the kernel path: the jnp fallback must
+    keep JAX's native forward-mode (jax.jvp) — regression for wrapping
+    the whole dispatch in custom_vjp, which would raise TypeError here."""
+    q, k, v = _qkv(15, 1, 8, 8, 1, 32)
+
+    def f(q):
+        o, _, l = flash_block_partials(q, k, v, None, scale=0.2,
+                                       force_jnp=True)
+        return (_normalized(o, l) ** 2).sum()
+
+    _, tang = jax.jvp(f, (q,), (jnp.ones_like(q),))
+    assert np.isfinite(float(tang))
+
+
 def test_causal_kernel_validation():
     q, k, v = _qkv(4, 1, 16, 24, 1, 32)
     with pytest.raises(ValueError, match="Tq == Tk"):

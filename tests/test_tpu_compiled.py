@@ -159,3 +159,94 @@ def test_flash_attention_kernel_compiled():
         np.testing.assert_allclose(
             norm(o1, l1), norm(o2, l2), atol=2e-2
         )
+
+
+def test_flash_attention_backward_compiled():
+    """jax.grad through the Pallas flash kernels — forward AND the
+    blockwise backward kernels — Mosaic-compiled.  This was the round-4
+    gap: grad through ``flash_block_partials`` raised ``Linearization
+    failed`` on the chip, so the "differentiable" claim held only on the
+    CPU/jnp fallback.  Gradient equality is against the jnp path's grads
+    computed on the SAME chip (shared MXU bf16-multiply default)."""
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.kernels.flash_attention import flash_block_partials
+
+    b, t, h, d = 2, 1024, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (b, t, h, d), jnp.float32) for kk in ks)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss(q, k, v, causal, **kwargs):
+        o, _, l = flash_block_partials(
+            q, k, v, None, scale=scale, causal=causal, **kwargs
+        )
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = o / jnp.moveaxis(l_safe, 1, 2)[..., None]
+        return (out**2).sum()
+
+    for causal in (False, True):
+        g_k = jax.jit(jax.grad(
+            lambda *a: loss(*a, causal), (0, 1, 2)
+        ))(q, k, v)
+        g_j = jax.jit(jax.grad(
+            lambda *a: loss(*a, causal, force_jnp=True), (0, 1, 2)
+        ))(q, k, v)
+        for a, e, nm in zip(g_k, g_j, "qkv"):
+            a, e = np.asarray(a), np.asarray(e)
+            assert np.isfinite(a).all(), f"d{nm} (causal={causal}) not finite"
+            # grads of a squared loss amplify the matmul (bf16-epsilon)
+            # band; bound element error against the cotangent's scale
+            # (observed ~6e-3 of max-grad on the causal dq at T=1024 —
+            # interpret mode pins the same comparison at 1e-3 RELATIVE,
+            # so this band is chip matmul precision, not kernel logic)
+            bound = 1e-2 * np.abs(e).max() + 1e-3
+            assert np.abs(a - e).max() <= bound, (
+                f"d{nm} (causal={causal}) diverged on chip: "
+                f"{np.abs(a - e).max():.3e} > {bound:.3e}"
+            )
+
+
+def test_ring_and_ulysses_grad_compiled():
+    """ring/ulysses grads compile and run on a 1-device mesh on chip.
+
+    Scope (the attach hosts ONE chip): size=1 means the ring has no
+    sendrecv rotation and the Ulysses all-to-alls are no-ops — what this
+    exercises is the custom-VJP kernel path (Pallas fwd + causal bwd
+    kernels) *inside shard_map under grad* on real hardware, value-checked
+    against reference attention grads on the same chip.  The multi-rank
+    collective-transpose half of the grad path is pinned by the CPU-mesh
+    suite (tests/test_long_context.py) and the driver's dryrun."""
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+    from long_context_attention import (
+        reference_attention, ring_attention, ulysses_attention,
+    )
+
+    mesh = mpx.make_world_mesh(devices=jax.devices()[:1])
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    b, t, h, d = 1, 512, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (
+        jax.random.normal(kk, (1, b, t, h, d), jnp.float32) for kk in ks
+    )
+    g_ref = jax.jit(jax.grad(
+        lambda q: (reference_attention(q, k[0], v[0], causal=True) ** 2).sum()
+    ))(q[0])
+
+    for scheme in (ring_attention, ulysses_attention):
+
+        @mpx.spmd(comm=comm)
+        def f(q, k, v, scheme=scheme):
+            out = scheme(q, k, v, comm=comm, causal=True)
+            return mpx.varying(jnp.sum(out**2))
+
+        g = np.asarray(jax.grad(lambda q: jnp.sum(f(q, k, v)))(q))[0]
+        assert np.isfinite(g).all(), scheme.__name__
+        e = np.asarray(g_ref)
+        bound = 1e-2 * np.abs(e).max() + 1e-3
+        assert np.abs(g - e).max() <= bound, (
+            f"{scheme.__name__} dq diverged on chip: "
+            f"{np.abs(g - e).max():.3e} > {bound:.3e}"
+        )
