@@ -19,28 +19,36 @@ identical-math jnp path elsewhere (or under ``force_jnp=True``); interpret
 mode covers CPU testing (tests/test_kernels.py; the jnp/kernel equality,
 fully- and partially-masked rows, and the blockwise-merge invariant).
 
-Measured on one v5e chip (B=4, T=4096, H=8, D=128, causal, f32;
-dispatch-constant-amortized via a fori_loop run-length slope — single-call
-timings through the remote-attach tunnel carry a session-dependent fixed
-overhead that understated these by ~3x in earlier rounds):
-**3.5 ms/block = 78.5 TFLOP/s** vs 9.1 ms / 30.4 TFLOP/s for the XLA
-einsum+softmax path with all three outputs live — 2.6x, from keeping the
-4096x4096 score tile out of HBM.  The causal diagonal block additionally
-uses ``causal=True`` → ``_kernel_causal``, which SKIPS fully-masked key
-tiles instead of masking computed scores: **2.12 ms/block** (1.66x the
-masked kernel; useful causal throughput 39 → 65 TFLOP/s), outputs within
-f32 matmul-precision noise of the masked path (normalized attention
-~6e-4 abs on this chip, where f32 dots use the MXU's bf16-multiply
-default in both kernels).
+Both kernels stream (bq, bk) KEY TILES with online-softmax carries, so
+the live score tile is fixed-size for ANY Tk — the VMEM ceiling is the
+K/V residency, ~2·Tk·D·itemsize (≈ Tk 90k for f32 D=128 under the
+100 MB limit; roughly half that with a user mask, whose (bq, Tk_pad)
+block is also VMEM-resident), not the Tk² of a materialized score
+matrix.  Round 4's non-causal kernel computed one (bq, Tk) score tile
+per grid step, capping non-causal blocks at Tk ≈ 4k before VMEM
+overflow (long Ulysses sequences fell back to the einsum); the
+streaming rework removed that cap — verified fwd+bwd at Tk = 32768 on
+chip.
 
-A later session measured the same kernels at 2.1-2.2 ms/block (~125
-TFLOP/s) — the attach tunnel makes absolute figures session-dependent
-(docs/microbenchmarks.md), so read the numbers above as a conservative
-band and the 2.6x-vs-einsum ratio as the stable claim.  ``bfloat16``
-inputs measure within the same band as f32 (2.09-2.17 ms/block,
-interleaved same-session comparison): the MXU already multiplies in
-bf16 for f32 dots by default, and operand traffic is not the
-bottleneck, so bf16 here saves memory, not time.
+Measured on one v5e chip (B=4, T=4096, H=8, D=128, f32, amortized over
+a 25-iteration fori_loop with host-fetch sync; the attach tunnel makes
+ABSOLUTE figures drift ~±30% minute-to-minute — docs/microbenchmarks.md
+— so same-run interleaved RATIOS are the stable claims): non-causal
+streaming kernel **1.8-2.4x** the XLA einsum+softmax path (5.4-7.1
+ms/block = 39-51 TFLOP/s vs 12.8-13.1 ms for the einsum with all three
+outputs live; earlier one-shot-kernel sessions measured the same ratio
+at 2.6x).  ``causal=True`` → ``_kernel_causal`` SKIPS fully-masked key
+tiles instead of masking computed scores: 1.24x the masked streaming
+kernel at this config (6.2 vs 7.7 ms; ~2x less MXU work, bounded by
+the shared epilogue), outputs within f32 matmul-precision noise of the
+masked path (normalized attention ~6e-4 abs on this chip, where f32
+dots use the MXU's bf16-multiply default in both kernels).  Historical
+sessions measured these kernels as fast as 2.1-2.2 ms/block (~125
+TFLOP/s); treat every absolute number as a session band.  ``bfloat16``
+inputs measure within the f32 band (interleaved same-session
+comparison): the MXU already multiplies in bf16 for f32 dots by
+default, and operand traffic is not the bottleneck, so bf16 here saves
+memory, not time.
 
 End-to-end, the causal ring (examples/long_context_attention.py) skips
 fully-masked ring steps per rank (lax.cond) and drops masking on fully-
@@ -68,34 +76,89 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 _Q_TILE = 512  # query rows per grid step (keeps the score tile VMEM-sized)
+# keys per streaming tile in the non-causal kernel.  Swept interleaved on a
+# v5e chip at (B=4, T=4096, H=8, D=128) over {512, 1024, 2048, 4096}: 512
+# was fastest (5.35 ms/block best-of-6 vs 6.2-6.6 for the larger tiles) —
+# the (512, 512) score tile fits the fused VPU epilogue best, and larger
+# tiles buy nothing since the per-tile rescale is already <15% of the MXU
+# work.  Keeps the live score tile at 1 MB f32 for ANY Tk.
+_K_TILE = 512
 
 
-def _kernel(*refs):
-    # refs (one (batch*head, q-tile) grid step): q (1, Bq, D),
-    # k/v (1, Tk, D), [mask (Bq, Tk) — absent when unmasked],
-    # o (1, Bq, D), m/l (1, 1, Bq).
+def _merge_tile(carry, s, vv):
+    """Fold one (bq, bk) score tile into the online-softmax carry
+    ``(m, l, acc)`` — the shared rescale step of both streaming kernels.
+    Masked entries must already carry ``-inf`` in ``s``."""
+    m0, l0, acc0 = carry
+    mt = jnp.maximum(m0, s.max(axis=-1))
+    # fully-masked-so-far rows: exp against a 0 stand-in, p stays 0
+    mt_safe = jnp.where(jnp.isinf(mt), 0.0, mt)
+    p = jnp.exp(s - mt_safe[:, None])
+    p = jnp.where(jnp.isinf(s), 0.0, p)  # masked entries carry -inf
+    c = jnp.where(jnp.isinf(m0), 0.0, jnp.exp(m0 - mt_safe))
+    l1 = l0 * c + p.sum(axis=-1)
+    acc1 = acc0 * c[:, None] + jnp.dot(
+        p.astype(vv.dtype), vv, preferred_element_type=jnp.float32
+    )
+    return mt, l1, acc1
+
+
+def _carry_init(bq, d):
+    return (
+        jnp.full((bq,), -jnp.inf, jnp.float32),
+        jnp.zeros((bq,), jnp.float32),
+        jnp.zeros((bq, d), jnp.float32),
+    )
+
+
+def _pad_to(x, axis, target):
+    if x.shape[axis] == target:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+def _kernel(*refs, bq, bk, tk, n_kt, has_mask):
+    # Non-causal streaming kernel (one (batch*head, q-tile) grid step):
+    # q (1, Bq, D), k/v whole (1, Tk_pad, D), [mask (Bq, Tk_pad) — absent
+    # when unmasked], o (1, Bq, D), m/l (1, 1, Bq).
+    # A fori_loop walks (Bq, bk) KEY TILES with online-softmax carries, so
+    # the live score tile is a fixed (Bq, bk) regardless of Tk — long
+    # non-causal blocks no longer materialize a (Bq, Tk) score tile (the
+    # pre-round-5 kernel did, capping Tk at ~4k before VMEM overflow).
     # Mosaic tiling requires the last two block dims be (8, 128)-divisible
     # or span the whole array — hence the flattened (B*H, T, D) layout
     # (a (1, Tq, 1, D) block over (B, Tq, H, D) is not lowerable).
-    q_ref, k_ref, v_ref, *rest = refs
-    mask_ref, (o_ref, m_ref, l_ref) = (
-        (rest[0], rest[1:]) if len(rest) == 4 else (None, rest)
-    )
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
+        mask_ref = None
     q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-    if mask_ref is not None:
-        s = jnp.where(mask_ref[:, :], s, -jnp.inf)
-    m = jnp.max(s, axis=-1)
-    # fully-masked rows: exp(-inf - -inf) would be nan; zero them instead
-    m_safe = jnp.where(jnp.isinf(m), 0.0, m) if mask_ref is not None else m
-    p = jnp.exp(s - m_safe[:, None])
-    if mask_ref is not None:
-        p = jnp.where(mask_ref[:, :], p, 0.0)
-    l = jnp.sum(p, axis=-1)
-    o = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    o_ref[0] = o.astype(o_ref.dtype)
+    d = q.shape[-1]
+
+    ragged = tk != n_kt * bk
+
+    def body(kt, carry):
+        kk = k_ref[0, pl.dslice(kt * bk, bk), :]
+        vv = v_ref[0, pl.dslice(kt * bk, bk), :]
+        s = jnp.dot(q, kk.T, preferred_element_type=jnp.float32)
+        valid = None
+        if ragged:  # padded tail keys never attend
+            kpos = kt * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1
+            )
+            valid = kpos < tk
+        if mask_ref is not None:
+            mt_tile = mask_ref[:, pl.dslice(kt * bk, bk)]
+            valid = mt_tile if valid is None else valid & mt_tile
+        if valid is not None:
+            s = jnp.where(valid, s, -jnp.inf)
+        return _merge_tile(carry, s, vv)
+
+    m, l, acc = jax.lax.fori_loop(0, n_kt, body, _carry_init(bq, d))
+    o_ref[0] = acc.astype(o_ref.dtype)
     m_ref[0, 0] = m
     l_ref[0, 0] = l
 
@@ -115,32 +178,12 @@ def _kernel_causal(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, bq, bk, tk):
     def load_tile(ref, kt):
         return ref[0, pl.dslice(kt * bk, bk), :]
 
-    def merge_tile(carry, s, vv):
-        m0, l0, acc0 = carry
-        mt = jnp.maximum(m0, s.max(axis=-1))
-        # fully-masked rows (none on a causal diagonal, but keep the
-        # contract): exp against a 0 stand-in, zeroed by p's mask below
-        mt_safe = jnp.where(jnp.isinf(mt), 0.0, mt)
-        p = jnp.exp(s - mt_safe[:, None])
-        p = jnp.where(jnp.isinf(s), 0.0, p)  # masked entries carry -inf
-        c = jnp.where(jnp.isinf(m0), 0.0, jnp.exp(m0 - mt_safe))
-        l1 = l0 * c + p.sum(axis=-1)
-        acc1 = acc0 * c[:, None] + jnp.dot(
-            p.astype(v_ref.dtype), vv, preferred_element_type=jnp.float32
-        )
-        return mt, l1, acc1
-
     def body(kt, carry):
         s = jnp.dot(q, load_tile(k_ref, kt).T,
                     preferred_element_type=jnp.float32)
-        return merge_tile(carry, s, load_tile(v_ref, kt))
+        return _merge_tile(carry, s, load_tile(v_ref, kt))
 
-    init = (
-        jnp.full((bq,), -jnp.inf, jnp.float32),
-        jnp.zeros((bq,), jnp.float32),
-        jnp.zeros((bq, d), jnp.float32),
-    )
-    m, l, acc = jax.lax.fori_loop(0, qi, body, init)
+    m, l, acc = jax.lax.fori_loop(0, qi, body, _carry_init(bq, d))
 
     # boundary tile: triangular causal mask on global positions, plus the
     # ragged-tail guard (the final tile's rows beyond tk read clamped data)
@@ -148,7 +191,7 @@ def _kernel_causal(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, bq, bk, tk):
     qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = qi * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     s = jnp.where((qpos >= kpos) & (kpos < tk), s, -jnp.inf)
-    m, l, acc = merge_tile((m, l, acc), s, load_tile(v_ref, qi))
+    m, l, acc = _merge_tile((m, l, acc), s, load_tile(v_ref, qi))
 
     o_ref[0] = acc.astype(o_ref.dtype)
     m_ref[0, 0] = m
@@ -184,12 +227,16 @@ def _partials_impl(q, k, v, mask, scale, causal, interpret, force_jnp):
 
     qs = q * jnp.asarray(scale, q.dtype)
 
-    # flatten to the (B*H, T, D) flash layout (see _kernel) and tile long
-    # query blocks so the (Bq, Tk) score tile stays VMEM-sized
+    # flatten to the (B*H, T, D) flash layout (see _kernel); both kernels
+    # walk (bq, bk) key tiles, so the live score tile is fixed-size and the
+    # VMEM ceiling is set by the K/V residency (~2·Tk·D·itemsize), not Tk²
     def to_bht(x, t):
         return jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
 
     bq = _Q_TILE if tq > _Q_TILE else tq  # partial final tiles are fine
+    bk = bq if causal else (_K_TILE if tk > _K_TILE else tk)
+    n_kt = (tk + bk - 1) // bk
+    tk_pad = n_kt * bk
     grid = (b * h, (tq + bq - 1) // bq)
     # under shard_map with VMA checking (ring attention on a mesh) the
     # outputs must be typed varying over the same axes as the inputs
@@ -201,34 +248,31 @@ def _partials_impl(q, k, v, mask, scale, causal, interpret, force_jnp):
     )
     q_spec = pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
                           memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0),
+    kv_spec = pl.BlockSpec((1, tk_pad, d), lambda i, j: (i, 0, 0),
                            memory_space=pltpu.VMEM)
     ml_spec = pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j),
                            memory_space=pltpu.VMEM)
     in_specs = [q_spec, kv_spec, kv_spec]
-    operands = [to_bht(qs, tq), to_bht(k, tk), to_bht(v, tk)]
+    # pad K/V to a whole number of key tiles: pl.dslice would CLAMP the
+    # last tile's start otherwise, silently misaligning the positional
+    # masks; padded keys sit at kpos >= tk, which both kernels' ragged
+    # guards discard
+    kf = _pad_to(to_bht(k, tk), 1, tk_pad)
+    vf = _pad_to(to_bht(v, tk), 1, tk_pad)
+    operands = [to_bht(qs, tq), kf, vf]
     if causal:
-        # pad K/V to a whole number of key tiles: pl.dslice would CLAMP the
-        # last tile's start otherwise, silently misaligning the positional
-        # mask; padded keys sit at kpos >= tk, which the boundary-tile mask
-        # discards
-        tk_pad = grid[1] * bq
-        if tk_pad != tk:
-            pad = ((0, 0), (0, tk_pad - tk), (0, 0))
-            operands[1] = jnp.pad(operands[1], pad)
-            operands[2] = jnp.pad(operands[2], pad)
-            kvp_spec = pl.BlockSpec((1, tk_pad, d), lambda i, j: (i, 0, 0),
-                                    memory_space=pltpu.VMEM)
-            in_specs = [q_spec, kvp_spec, kvp_spec]
         kernel = functools.partial(_kernel_causal, bq=bq, bk=bq, tk=tk)
     else:
-        kernel = _kernel
+        kernel = functools.partial(
+            _kernel, bq=bq, bk=bk, tk=tk, n_kt=n_kt,
+            has_mask=mask is not None,
+        )
         if mask is not None:
             in_specs.append(
-                pl.BlockSpec((bq, tk), lambda i, j: (j, 0),
+                pl.BlockSpec((bq, tk_pad), lambda i, j: (j, 0),
                              memory_space=pltpu.VMEM)
             )
-            operands.append(mask)
+            operands.append(_pad_to(mask, 1, tk_pad))
     o_bht, m_f, l_f = pl.pallas_call(
         kernel,
         grid=grid,
@@ -357,14 +401,6 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, tq, tk, n_qt, has_mask):
     dk, dv = jax.lax.fori_loop(lo, n_qt, body, (zeros, zeros))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
-
-
-def _pad_to(x, axis, target):
-    if x.shape[axis] == target:
-        return x
-    pad = [(0, 0)] * x.ndim
-    pad[axis] = (0, target - x.shape[axis])
-    return jnp.pad(x, pad)
 
 
 def _partials_bwd_impl(q, k, v, mask, m, g_o, g_l, scale, causal, interpret):

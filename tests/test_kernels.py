@@ -36,6 +36,7 @@ def _qkv(seed, b, tq, tk, h, d, dtype=jnp.float32):
         (1, 16, 16, 1, 32),
         (2, 16, 24, 4, 32),  # rectangular block (ring step of unequal shards)
         (2, 8, 8, 3, 64),
+        (1, 257, 1100, 1, 32),  # ragged q AND k tiles (streaming loop)
     ],
 )
 def test_kernel_matches_jnp_path(b, tq, tk, h, d):
@@ -223,6 +224,8 @@ def _normalized(o, l):
         (2, 16, 24, 2, 32, False, True),    # user mask (float0 cotangent)
         (1, 64, 64, 2, 32, True, False),    # causal kernel
         (1, 550, 550, 1, 32, True, False),  # ragged tiles (padding guards)
+        (1, 257, 1100, 1, 32, False, False),  # streaming non-causal tiles
+        (1, 257, 1100, 1, 32, False, True),   # ... with a mask
     ],
 )
 def test_grad_kernel_matches_jnp_path(b, tq, tk, h, d, causal, masked):
