@@ -101,6 +101,49 @@ def bench_sendrecv_ring(comm, sizes_kb, iters=50):
     return rows
 
 
+def bench_prod_and_split(comm, sizes_mb, iters=20):
+    """The log-depth butterfly family: PROD allreduce (no native HLO
+    collective) on the whole comm and on an even/odd color split — the
+    lowerings tests/test_scale.py gates at 64 devices, timed here."""
+    n = comm.Get_size()
+    split = comm.Split([r % 2 for r in range(n)]) if n > 1 else None
+    rows = []
+    for mb in sizes_mb:
+        nelem = max(1, int(mb * 1e6 / 4))
+
+        @mpx.spmd(comm=comm)
+        def prog(x):
+            def body(_, v):
+                s, _tok = mpx.allreduce(v, op=mpx.PROD)
+                return mpx.varying(jnp.clip(s, 0.5, 2.0))  # keep bounded
+
+            return jax.lax.fori_loop(0, iters, body, x)
+
+        x = jnp.ones((n, nelem), jnp.float32)
+        t_whole = _time_program(prog, (x,)) / iters
+
+        t_split = None
+        if split is not None:
+
+            @mpx.spmd(comm=comm)
+            def prog_split(x):
+                def body(_, v):
+                    s, _tok = mpx.allreduce(v, op=mpx.PROD, comm=split)
+                    return mpx.varying(jnp.clip(s, 0.5, 2.0))
+
+                return jax.lax.fori_loop(0, iters, body, x)
+
+            t_split = _time_program(prog_split, (x,)) / iters
+        rows.append({
+            "size_mb": round(nelem * 4 / 1e6, 3),
+            "prod_us": round(t_whole * 1e6, 1),
+            "prod_split_us": (
+                round(t_split * 1e6, 1) if t_split is not None else None
+            ),
+        })
+    return rows
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--json", action="store_true", help="machine-readable output")
@@ -117,6 +160,7 @@ def main():
 
     ar = bench_allreduce(comm, args.sizes_mb)
     pp = bench_sendrecv_ring(comm, args.sizes_kb)
+    pr = bench_prod_and_split(comm, args.sizes_mb[:4])
 
     if args.json:
         print(json.dumps({
@@ -134,6 +178,7 @@ def main():
             ),
             "allreduce": ar,
             "sendrecv_ring": pp,
+            "prod_butterfly": pr,
         }))
         return
 
@@ -147,6 +192,11 @@ def main():
         bw = (f"{r['link_gb_s']} GB/s" if r["link_gb_s"] is not None
               else "n/a (1 device)")
         print(f"  {r['size_kb']:>10.2f} KB   {r['hop_us']:>10.2f} us   {bw}")
+    print("\nPROD butterfly (log-depth)    whole comm   even/odd split")
+    for r in pr:
+        sp = (f"{r['prod_split_us']:>10.1f} us"
+              if r["prod_split_us"] is not None else "n/a (1 device)")
+        print(f"  {r['size_mb']:>10.3f} MB   {r['prod_us']:>10.1f} us   {sp}")
 
 
 if __name__ == "__main__":

@@ -103,57 +103,115 @@ def combine_fn(op: OpLike) -> Callable:
     )
 
 
+def _comm_groups(comm: Comm):
+    """Static group member lists (global ranks, group order): a whole-axes
+    comm is one group of everyone."""
+    if comm.groups is not None:
+        return comm.groups
+    return (tuple(range(comm.Get_size())),)
+
+
+def _comm_pos_size(comm: Comm):
+    """(group position, group size) of the calling rank — a traced pair on
+    a color split (static table lookups), (traced, static int) otherwise."""
+    if comm.groups is None:
+        return comm.Get_rank(), comm.Get_size()
+    ksize = [0] * sum(len(g) for g in comm.groups)
+    for members in comm.groups:
+        for r in members:
+            ksize[r] = len(members)
+    return comm.Get_rank(), jnp.asarray(ksize)[comm.global_rank()]
+
+
+def _permute_axis(comm: Comm):
+    """ppermute axis argument: linearized row-major over multi-axis comms
+    (the same rank order ``Get_rank`` defines)."""
+    axes = comm.axes
+    return axes[0] if len(axes) == 1 else axes
+
+
+def apply_doubling_bcast(xl, comm: Comm, root: int):
+    """Log-depth broadcast from each group's ``root`` over ppermute rounds.
+
+    Round ``t`` doubles the covered span: positions (relative to root,
+    wrapped) ``[0, 2^t)`` hold the value and send to ``[2^t, 2^{t+1})``.
+    ``ceil(log2 k)`` rounds, one message per rank per round — O(log k)
+    per-rank bandwidth vs O(world) for an AllGather-based group broadcast.
+    where-select (not multiply-by-mask) so non-participant payloads — the
+    zeros ppermute delivers to pair-less ranks, or NaN/Inf garbage on
+    non-root ranks — never poison the result.
+    """
+    groups = _comm_groups(comm)
+    kmax = max(len(g) for g in groups)
+    if kmax == 1:
+        return xl
+    pos, k = _comm_pos_size(comm)
+    relpos = (pos - root) % k
+    acc = xl
+    axis = _permute_axis(comm)
+    w = 1
+    while w < kmax:
+        perm = [
+            (members[(root + p) % kk], members[(root + p + w) % kk])
+            for members in groups
+            if (kk := len(members)) > w
+            for p in range(min(w, kk - w))
+        ]
+        recvd = lax.ppermute(acc, axis, perm)
+        got = (relpos >= w) & (relpos < 2 * w)
+        acc = jnp.where(got, recvd, acc)
+        w *= 2
+    return acc
+
+
 def apply_allreduce(x, op: OpLike, comm: Comm):
     """All-reduce ``x`` over ``comm`` with reduction ``op``.
 
-    Whole-axes comm, SUM/MIN/MAX: one native AllReduce HLO.  Other ops:
-    AllGather + local reduce (bandwidth-optimal on ICI for small payloads;
-    XLA fuses the local reduction).  Color-split comm (``comm.groups``):
-    AllGather over the full axes + a per-group masked fold — correct for
-    any partition incl. unequal group sizes, at O(world) bandwidth
-    (``axis_index_groups`` is unavailable under shard_map; see
-    ``Comm.Split``).
+    Whole-axes comm, SUM/MIN/MAX: one native AllReduce HLO.  Every other
+    case — PROD/logical/bitwise/callable ops, and ALL ops on a color-split
+    comm (``axis_index_groups`` is unavailable under shard_map, see
+    ``Comm.Split``) — lowers to a log-depth doubling butterfly over
+    CollectivePermute: ``ceil(log2 k)`` suffix-fold rounds + a log-depth
+    broadcast, O(log k) depth and per-rank bandwidth (the round-3/4
+    lowering was AllGather + an O(world)-unrolled fold — O(world)
+    bandwidth AND an O(world) serial dependency chain per call, which
+    falls over at pod scale; see tests/test_scale.py's 64-device
+    budget).
+
+    The suffix fold combines in ascending group-rank order with plain
+    associativity — no commutativity or identity element required, so
+    arbitrary non-commutative callables keep MPI's deterministic
+    same-result-everywhere contract (every rank receives group-position
+    0's fold via the broadcast).
     """
     axes = comm.axes
     x = as_varying(x, axes)
-    if comm.groups is None:
-        if isinstance(op, Op) and op in _NATIVE_COLLECTIVE:
-            return _NATIVE_COLLECTIVE[op](x, axes)
-        fn = combine_fn(op)
-        axis = axes[0] if len(axes) == 1 else axes
-        gathered = lax.all_gather(x, axis, axis=0, tiled=False)
-        # reduce over the leading (ranks) axis with a static fold; XLA
-        # unrolls and fuses this into vector ops
-        out = gathered[0]
-        for i in range(1, gathered.shape[0]):
-            out = fn(out, gathered[i])
-        return out
+    if comm.groups is None and isinstance(op, Op) and op in _NATIVE_COLLECTIVE:
+        return _NATIVE_COLLECTIVE[op](x, axes)
 
     fn = combine_fn(op)
-    axis = axes[0] if len(axes) == 1 else axes
-    gathered = lax.all_gather(x, axis, axis=0, tiled=False)
-    size = gathered.shape[0]
-    gid = [0] * size
-    first = [0] * size  # lowest global rank of each rank's group
-    for g, members in enumerate(comm.groups):
-        for r in members:
-            gid[r] = g
-            first[r] = min(members)
-    gid_t = jnp.asarray(gid)
-    grank = comm.global_rank()
-    my_gid = gid_t[grank]
-    my_first = jnp.asarray(first)[grank]
-    # fold the group's members in ascending GLOBAL rank order, seeded from
-    # the group's lowest rank — the identical sequence on every member, so
-    # non-commutative callable ops give one group-wide result (like the
-    # whole-axes fold above; MPI requires this determinism).  jnp.where
-    # keeps other groups' values — NaN included — out of the result.
-    out = jnp.take(gathered, my_first, axis=0)
-    for r in range(size):
-        contrib = fn(out, gathered[r])
-        same = (gid_t[r] == my_gid) & (my_first != r)
-        out = jnp.where(same, contrib, out)
-    return out
+    groups = _comm_groups(comm)
+    kmax = max(len(g) for g in groups)
+    if kmax == 1:
+        return x
+    pos, k = _comm_pos_size(comm)
+    axis = _permute_axis(comm)
+    # suffix-window doubling: after round t, acc at group position p folds
+    # positions [p, min(p + 2^t, k)) in ascending order
+    acc = x
+    w = 1
+    while w < kmax:
+        perm = [
+            (members[p + w], members[p])
+            for members in groups
+            for p in range(len(members) - w)
+        ]
+        recvd = lax.ppermute(acc, axis, perm)
+        combine = pos + w < k
+        acc = jnp.where(combine, fn(acc, recvd), acc)
+        w *= 2
+    # group position 0 now holds the full fold; distribute it
+    return apply_doubling_bcast(acc, comm, 0)
 
 
 def linear_rank(comm: Comm):

@@ -19,7 +19,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch
+from ._base import apply_doubling_bcast, dispatch
 from .token import Token, consume, produce
 
 
@@ -41,18 +41,11 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
         rank = comm.Get_rank()
         log_op("MPI_Bcast", rank, f"{xl.size} items from root {root}")
         if comm.groups is not None:
-            # color split: AllGather over the full axes, then every rank
-            # picks its own group's root (static table, traced index) —
-            # one collective, any partition, no cross-group mixing
-            axes = comm.axes
-            axis = axes[0] if len(axes) == 1 else axes
-            gathered = lax.all_gather(xl, axis, axis=0, tiled=False)
-            root_glob = [0] * gathered.shape[0]
-            for members in comm.groups:
-                for r in members:
-                    root_glob[r] = members[root]
-            my_root = jnp.asarray(root_glob)[comm.global_rank()]
-            res = jnp.take(gathered, my_root, axis=0)
+            # color split: log-depth doubling broadcast from each group's
+            # root over ppermute rounds — O(log k) per-rank bandwidth, any
+            # partition, no cross-group mixing (the r4 lowering was a full
+            # AllGather + per-group take: O(world) bandwidth per call)
+            res = apply_doubling_bcast(xl, comm, root)
         elif jnp.issubdtype(xl.dtype, jnp.bool_):
             masked = jnp.where(rank == root, xl.astype(jnp.uint8), 0)
             res = lax.psum(masked, comm.axes).astype(jnp.bool_)

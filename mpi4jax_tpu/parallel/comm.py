@@ -205,11 +205,14 @@ class Comm:
           traced program must know the whole table).  Ranks sharing a color
           form a group, ordered by ``(key[r], r)`` when ``key`` (same
           length) is given, else by rank — exactly MPI's ordering rule.
-          Returns a :class:`GroupComm`, whose collectives are implemented
-          with masked/gathered collectives over the full axes (XLA's
-          ``axis_index_groups`` is unavailable under shard_map, verified on
-          jax 0.9): correct for any partition, at O(world) bandwidth — for
-          performance-critical regular splits prefer the grid form.
+          Returns a :class:`GroupComm`, whose collectives run over the
+          full axes with masked routing (XLA's ``axis_index_groups`` is
+          unavailable under shard_map, verified on jax 0.9): correct for
+          any partition.  allreduce/reduce/bcast/scan lower to log-depth
+          doubling rounds over CollectivePermute (O(log k) depth and
+          per-rank bandwidth); the gather family moves O(world) via a
+          full-axes AllGather.  Regular splits prefer the grid form
+          (single native HLO collectives).
         """
         if isinstance(color, str):
             remaining = tuple(a for a in self._axes if a != color)
@@ -252,9 +255,11 @@ class GroupComm(Comm):
 
     Produced by ``Comm.Split(colors, key)``.  The group structure is static
     (``groups``: tuple of tuples of *global* ranks); collectives run over
-    the parent's full mesh axes with masking/gathering, so any partition
-    works — including non-Cartesian and unequal-sized groups — at O(world)
-    bandwidth.  ``Get_rank``/``Get_size`` follow MPI: group-local rank and
+    the parent's full mesh axes with masked routing, so any partition
+    works — including non-Cartesian and unequal-sized groups — at
+    O(log k) per-rank bandwidth for the reduction family and O(world)
+    for the gather family.  ``Get_rank``/``Get_size`` follow MPI:
+    group-local rank and
     group size.  All 12 ops work on UNIFORM group sizes;
     allreduce/reduce/bcast/barrier additionally work on unequal-sized
     partitions.  Ops whose routing or output shape needs a static group

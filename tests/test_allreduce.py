@@ -77,20 +77,42 @@ def test_allreduce_logical():
 
 
 def test_allreduce_custom_op():
-    # user-defined reduction as a callable — beyond-reference capability
+    # User-defined reduction as a callable — beyond-reference capability.
+    # MPI's contract (which the reference inherits from libmpi): the op
+    # must be ASSOCIATIVE; commutativity is NOT required, and the result
+    # must be the fold in ascending rank order.  A 2x2 matrix product pins
+    # exactly that: associative, non-commutative, so any mis-ordered or
+    # mis-grouped combine changes the answer.
     _, size = world()
 
     @mpx.spmd
     def f(x):
-        res, _ = mpx.allreduce(x, op=lambda a, b: jnp.maximum(a, b) + 1)
+        res, _ = mpx.allreduce(x, op=jnp.matmul)
+        return res
+
+    rng = np.random.default_rng(0)
+    mats = rng.normal(size=(size, 2, 2)).astype(np.float32)
+    out = np.asarray(f(jnp.asarray(mats)))
+    expected = np.eye(2, dtype=np.float32)
+    for r in range(size):
+        expected = expected @ mats[r]
+    # every rank must hold the same rank-ordered product
+    for r in range(size):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_custom_op_commutative():
+    # an associative+commutative callable: sqrt-of-sum-of-squares
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=lambda a, b: jnp.sqrt(a * a + b * b))
         return res
 
     out = np.asarray(f(ranks_arange((1,))))
-    # fold: ((0 max 1)+1 max 2)+1 ... = size-1 + size-1 folds
-    expected = 0.0
-    for r in range(1, size):
-        expected = max(expected, r) + 1
-    assert np.allclose(out, expected)
+    expected = np.sqrt(sum(float(r) ** 2 for r in range(size)))
+    assert np.allclose(out, expected, rtol=1e-5)
 
 
 def test_allreduce_vmap():

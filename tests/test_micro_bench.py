@@ -41,3 +41,14 @@ def test_bench_sendrecv_schema():
     r = rows[0]
     assert r["hop_us"] > 0
     assert (r["link_gb_s"] is None) == (comm.Get_size() == 1)
+
+
+def test_bench_prod_and_split_schema():
+    comm = _world_comm()
+    rows = micro.bench_prod_and_split(comm, sizes_mb=[0.0001], iters=2)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["prod_us"] > 0
+    assert (r["prod_split_us"] is None) == (comm.Get_size() == 1)
+    if r["prod_split_us"] is not None:
+        assert r["prod_split_us"] > 0

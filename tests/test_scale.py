@@ -1,0 +1,108 @@
+"""Pod-scale gate: the lowerings must stay O(log n), not O(n).
+
+BASELINE.md's north star is a v4-32+ pod; the suite's 8-device mesh cannot
+catch a lowering that unrolls over the world size (the round-3/4 exotic-op
+allreduce did exactly that: AllGather + a python fold emitting an O(world)
+serial op chain).  This file spawns ONE subprocess with a 64-virtual-device
+CPU mesh and pins, for the doubling-butterfly family:
+
+- correctness at n = 64 (PROD, non-commutative matmul, unequal color
+  split allreduce/bcast/scan);
+- program size: the traced jaxpr's ppermute count is O(log n) —
+  2·ceil(log2 64) + broadcast rounds, not O(64);
+- a trace+compile+run wall budget, which an O(world) unroll blows.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import json, time
+import os
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=64"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import mpi4jax_tpu as mpx
+
+t0 = time.time()
+N = 64
+mesh = mpx.make_world_mesh()
+comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+assert comm.Get_size() == N
+
+# unequal color split: 3 groups of sizes 32 / 21 / 11
+colors = [0 if r < 32 else (1 if r < 53 else 2) for r in range(N)]
+split = comm.Split(colors)
+groups = split.groups
+
+@mpx.spmd(comm=comm)
+def prog(x, mats):
+    p, tok = mpx.allreduce(x, op=mpx.PROD, comm=comm)
+    mm, tok = mpx.allreduce(mats, op=jnp.matmul, comm=comm, token=tok)
+    gs, tok = mpx.allreduce(x, op=mpx.PROD, comm=split, token=tok)
+    gb, tok = mpx.bcast(x, 2, comm=split, token=tok)
+    gc, tok = mpx.scan(x, mpx.SUM, comm=split, token=tok)
+    return p, mm, gs, gb, gc
+
+x = (1.0 + jnp.arange(N)[:, None] / 64.0).astype(jnp.float32)
+rng = np.random.default_rng(0)
+mats = jnp.asarray(
+    (np.eye(2) + 0.01 * rng.normal(size=(N, 2, 2))).astype(np.float32)
+)
+
+# program-size gate: count ppermutes and total equations in the trace
+jaxpr_text = str(jax.make_jaxpr(prog)(x, mats))
+n_ppermute = jaxpr_text.count("ppermute")
+n_lines = len(jaxpr_text.splitlines())
+
+p, mm, gs, gb, gc = (np.asarray(v) for v in prog(x, mats))
+wall = time.time() - t0
+
+xs = np.asarray(x)[:, 0]
+ok = bool(np.allclose(p[:, 0], np.prod(xs), rtol=1e-4))
+expected_mm = np.eye(2, dtype=np.float32)
+for r in range(N):
+    expected_mm = expected_mm @ np.asarray(mats)[r]
+ok = ok and bool(np.allclose(mm[0], expected_mm, rtol=1e-3, atol=1e-4))
+for members in groups:
+    want = np.prod(xs[list(members)])
+    ok = ok and bool(np.allclose(gs[list(members), 0], want, rtol=1e-4))
+    ok = ok and bool(
+        np.allclose(gb[list(members), 0], xs[members[2]])
+    )
+    pref = np.cumsum(xs[list(members)])
+    ok = ok and bool(np.allclose(gc[list(members), 0], pref, rtol=1e-4))
+
+print(json.dumps({"ok": ok, "n_ppermute": n_ppermute,
+                  "n_lines": n_lines, "wall_s": wall}))
+"""
+
+
+def test_64_device_log_depth_budget():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["ok"], res
+    # 5 butterfly/prefix ops x <= 14 log2(64)-rounds each (measured 44);
+    # an O(n) permute ladder would need 5 x 63 = 315+
+    assert res["n_ppermute"] <= 70, res
+    # total program size catches O(world) unrolls that emit NO permutes
+    # (the old AllGather+fold chain): measured ~670 lines log-depth; a
+    # 5-op x 64-rank fold adds 320+ combine eqns on top
+    assert res["n_lines"] <= 800, res
+    # measured ~3 s; an O(world) trace/compile blows this long before a pod
+    assert res["wall_s"] < 120, res

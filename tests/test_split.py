@@ -355,23 +355,29 @@ def test_split_bind_preserves_groups():
 
 
 def test_split_allreduce_noncommutative_op_group_consistent():
-    # a callable op need not be commutative; every member of a group must
-    # still receive the SAME result (fold in a fixed group-wide order,
-    # seeded from the group's lowest rank — like the whole-axes path)
+    # a callable op need not be commutative (associativity is MPI's only
+    # requirement — association order is the library's choice, rank order
+    # is not); every member of a group must receive the SAME result: the
+    # fold of the group's members in ascending group-rank order.  The 2x2
+    # matrix product pins both properties.
     comm, size = world()
     split = comm.Split(COLORS_EO)
 
     @mpx.spmd
     def f(x):
-        s, _ = mpx.allreduce(x, op=lambda a, b: a - b, comm=split)
+        s, _ = mpx.allreduce(x, op=jnp.matmul, comm=split)
         return s
 
-    out = np.asarray(f(ranks_arange((1,))))[:, 0]
+    rng = np.random.default_rng(1)
+    mats = rng.normal(size=(size, 2, 2)).astype(np.float32)
+    out = np.asarray(f(jnp.asarray(mats)))
     for g in ((0, 2, 4, 6), (1, 3, 5, 7)):
-        acc = float(g[0])
-        for r in g[1:]:
-            acc -= r
-        np.testing.assert_allclose(out[list(g)], acc)
+        expected = np.eye(2, dtype=np.float32)
+        for r in g:
+            expected = expected @ mats[r]
+        for r in g:
+            np.testing.assert_allclose(out[r], expected, rtol=1e-5,
+                                       atol=1e-5)
 
 
 def test_split_nested():
