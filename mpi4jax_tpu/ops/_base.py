@@ -40,11 +40,13 @@ from ..utils.dtypes import check_dtype
 class Op(enum.Enum):
     """Reduction operations (replaces MPI.Op handles, ref _src/utils.py:141-145).
 
-    SUM/MIN/MAX lower to native ``psum``/``pmin``/``pmax`` HLO; the rest lower
-    to ``all_gather`` + a local reduction (one collective, then MXU/VPU-local
-    work).  A Python callable ``f(a, b)`` is also accepted anywhere an ``Op``
-    is — the analog of user-defined MPI ops, which the reference could only
-    pass through to libmpi.
+    SUM/MIN/MAX lower to native ``psum``/``pmin``/``pmax`` HLO; the rest
+    lower to a log-depth doubling butterfly over ``CollectivePermute``
+    (O(log n) depth and per-rank bandwidth — see ``apply_allreduce``).  A
+    Python callable ``f(a, b)`` is also accepted anywhere an ``Op`` is —
+    the analog of user-defined MPI ops, which the reference could only
+    pass through to libmpi.  Callables must be associative (MPI's
+    contract); commutativity is not required.
     """
 
     SUM = "sum"

@@ -15,7 +15,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch, group_select_gather
+from ._base import _permute_axis, dispatch, group_select_gather
 from .token import Token, consume, produce
 
 
@@ -45,7 +45,11 @@ def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
             sel = group_select_gather(comm, xl)
             res = jnp.take(sel, comm.Get_rank(), axis=1)
         else:
-            res = lax.all_to_all(xl, comm.axis, split_axis=0, concat_axis=0)
+            # multi-axis comms exchange over the linearized row-major rank
+            # order (XLA's AllToAll flattens the axis tuple the same way
+            # Get_rank does)
+            res = lax.all_to_all(xl, _permute_axis(comm), split_axis=0,
+                                 concat_axis=0)
         return res, produce(token, res)
 
     return dispatch("alltoall", comm, body, (x,), token, static_key=())

@@ -20,7 +20,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import SUM, Op, OpLike, combine_fn, dispatch
+from ._base import SUM, Op, OpLike, _permute_axis, combine_fn, dispatch
 from .token import Token, consume, produce
 
 
@@ -59,7 +59,7 @@ def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
                 for members in groups
                 for r in range(d, len(members))
             )
-            recvd = lax.ppermute(acc, comm.axis, list(perm))
+            recvd = lax.ppermute(acc, _permute_axis(comm), list(perm))
             acc = jnp.where(rank >= d, fn(acc, recvd), acc)
             d *= 2
         return acc, produce(token, acc)

@@ -16,7 +16,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch, group_select_gather
+from ._base import _permute_axis, dispatch, group_select_gather
 from .token import Token, consume, produce
 
 
@@ -53,7 +53,7 @@ def scatter(x, root: int, *, comm: Optional[Comm] = None,
         else:
             # all_to_all: out[i] = rank i's slice addressed to us; keep
             # root's
-            exchanged = lax.all_to_all(xl, comm.axis, split_axis=0,
+            exchanged = lax.all_to_all(xl, _permute_axis(comm), split_axis=0,
                                        concat_axis=0)
             res = exchanged[root]
         return res, produce(token, res)

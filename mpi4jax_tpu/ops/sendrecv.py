@@ -29,7 +29,7 @@ from ..parallel.comm import Comm
 from ..parallel.rankspec import resolve_routing
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import dispatch
+from ._base import _permute_axis, dispatch
 from .status import Status
 from .token import Token, consume, produce
 
@@ -50,7 +50,9 @@ def _apply_permute(xl, recvbuf, pairs, comm):
     if all(s == d for s, d in pairs):
         permuted = xl
     else:
-        permuted = lax.ppermute(xl, comm.axis, list(pairs))
+        # multi-axis comms permute over the linearized row-major rank
+        # order — the same order Get_rank defines (parallel/comm.py)
+        permuted = lax.ppermute(xl, _permute_axis(comm), list(pairs))
     # the output is typed by the recv buffer (ref sendrecv.py:369-377
     # abstract eval): a message with a matching element count but different
     # shape — e.g. exchange-row-for-column — lands in recvbuf's shape

@@ -272,3 +272,52 @@ def test_chained_mixed_ops():
     ranks = np.arange(size)
     expected = 0 + total + np.cumsum(ranks) + total
     assert np.allclose(np.asarray(out), expected)
+
+
+def test_full_op_matrix_on_two_axis_comm():
+    """Every op family on a MULTI-AXIS comm (ref parity: ops accept any
+    communicator handle, ref _src/utils.py:80-96).  Point-to-point, scan,
+    alltoall, and scatter linearize the (4, 2) mesh to the row-major rank
+    order Get_rank defines; before round 5 they raised on multi-axis
+    comms."""
+    mesh = mpx.make_world_mesh((4, 2), ("y", "x"))
+    comm = mpx.Comm(("y", "x"), mesh=mesh)
+    n = 8
+
+    @mpx.spmd(comm=comm)
+    def f(x, rows):
+        token = mpx.create_token()
+        a, token = mpx.allreduce(x, op=mpx.SUM, comm=comm, token=token)
+        p, token = mpx.allreduce(x, op=mpx.PROD, comm=comm, token=token)
+        b, token = mpx.bcast(x, 3, comm=comm, token=token)
+        g, token = mpx.allgather(x, comm=comm, token=token)
+        s, token = mpx.scan(x, mpx.SUM, comm=comm, token=token)
+        r, token = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=comm,
+                                token=token)
+        t, token = mpx.alltoall(rows, comm=comm, token=token)
+        sc, token = mpx.scatter(rows, 2, comm=comm, token=token)
+        gt, token = mpx.gather(x, 1, comm=comm, token=token)
+        rd, token = mpx.reduce(x, mpx.MAX, 0, comm=comm, token=token)
+        token = mpx.barrier(comm=comm, token=token)
+        return a, p, b, g.sum(0), s, r, t, sc, gt.sum(0), rd
+
+    x = (jnp.arange(float(n))[:, None] + 1.0)
+    rows = jnp.arange(float(n * n)).reshape(n, n, 1)
+    a, p, b, gs, s, r, t, sc, gt, rd = (np.asarray(v) for v in f(x, rows))
+    vals = np.arange(1.0, n + 1)
+    assert (a[:, 0] == vals.sum()).all()
+    np.testing.assert_allclose(p[:, 0], np.prod(vals), rtol=1e-5)
+    assert (b[:, 0] == vals[3]).all()
+    assert (gs[:, 0] == vals.sum()).all()
+    np.testing.assert_allclose(s[:, 0], np.cumsum(vals))
+    np.testing.assert_array_equal(r[:, 0], np.roll(vals, 1))
+    # alltoall: out[r][i] = rank i's row r (the linearized transpose)
+    rows_np = np.asarray(rows)[..., 0]
+    np.testing.assert_array_equal(t[..., 0], rows_np.T)
+    # scatter from rank 2: rank r gets rank 2's row r
+    np.testing.assert_array_equal(sc[:, 0], rows_np[2])
+    # gather to rank 1 (summed over the gathered axis): the root's sum
+    # covers every rank's value
+    assert gt[1, 0] == vals.sum()
+    np.testing.assert_array_equal(rd[0, 0], vals.max())
+    np.testing.assert_array_equal(rd[1:, 0], vals[1:])

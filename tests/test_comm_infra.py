@@ -82,16 +82,20 @@ def test_comm_rank_row_major():
     assert np.allclose(out, np.arange(8))
 
 
-def test_p2p_requires_single_axis():
+def test_p2p_on_multi_axis_comm():
+    """p2p over a multi-axis comm rides the linearized row-major rank
+    order: shift(1) on a (4, 2) comm is one ring over all 8 devices
+    (before round 5 this raised 'requires a single-axis communicator')."""
     mesh = mpx.make_world_mesh((4, 2), ("y", "x"))
     comm = mpx.Comm(("y", "x"), mesh=mesh)
-    with pytest.raises(ValueError, match="single-axis"):
-        @mpx.spmd(comm=comm)
-        def f(xl):
-            y, _ = mpx.sendrecv(xl, xl, dest=mpx.shift(1))
-            return y
 
-        f(jnp.zeros((8, 1)))
+    @mpx.spmd(comm=comm)
+    def f(xl):
+        y, _ = mpx.sendrecv(xl, xl, dest=mpx.shift(1))
+        return y
+
+    out = np.asarray(f(jnp.arange(8.0)[:, None])).ravel()
+    np.testing.assert_array_equal(out, np.roll(np.arange(8.0), 1))
 
 
 def test_unbound_comm_error():
