@@ -551,7 +551,8 @@ def _margin_rows(nsteps: int) -> int:
     ``_PBLK`` (the block-margin index maps need ``mrg | _PBLK``).  The
     single source of this invariant for both the whole-step chunk kernels
     and the wide-halo path."""
-    assert 1 <= nsteps <= 3, nsteps  # deeper fusion exceeds VMEM/compiler
+    if not 1 <= nsteps <= 3:  # deeper fusion exceeds VMEM/compiler
+        raise ValueError(f"fused step windows support 1..3 steps, got {nsteps}")
     m = 8 * nsteps
     while _PBLK % m:
         m += 8
@@ -907,14 +908,16 @@ def model_step_pallas(state: State, cfg: Config, comm: mpx.Comm,
     on the chip and the interpret path everywhere else (CPU CI, the
     driver's compile check).
     """
-    assert cfg.nproc == 1 and cfg.periodic_x, (
-        "model_step_pallas: single-rank periodic-x only; use model_step_fast"
-    )
+    if not (cfg.nproc == 1 and cfg.periodic_x):
+        raise ValueError(
+            "model_step_pallas: single-rank periodic-x only; use "
+            "model_step_fast"
+        )
     # one sublane tile of validity per fused step, rounded up to a divisor
     # of _PBLK — the prev/next margin index maps address mrg-row blocks as
     # i * (_PBLK // mrg), which only lands on block starts when mrg
     # divides _PBLK (nsteps=3: 24 -> 32); nsteps=4 exceeds the chip's
-    # VMEM/compiler limits at benchmark width (asserted in _margin_rows)
+    # VMEM/compiler limits at benchmark width (checked in _margin_rows)
     mrg = _margin_rows(nsteps)
     import jax.experimental.pallas as pl
 
@@ -1316,10 +1319,14 @@ def model_step_pallas_wide(state: State, cfg: Config, comm: mpx.Comm,
     split-phase path below that.
     """
     m = _margin_rows(nsteps)
-    assert cfg.ny_local - 2 >= m and cfg.nx_local - 2 >= m, (
-        "model_step_pallas_wide: local interior must be >= the exchange "
-        f"depth ({m}) in both dimensions; use model_step_pallas_halo"
-    )
+    if cfg.ny_local - 2 < m or cfg.nx_local - 2 < m:
+        # ValueError, not assert: user-facing eligibility that must
+        # survive `python -O` (an undersized interior would silently
+        # exchange out-of-range strips)
+        raise ValueError(
+            "model_step_pallas_wide: local interior must be >= the exchange "
+            f"depth ({m}) in both dimensions; use model_step_pallas_halo"
+        )
     if interpret is None:
         interpret = _resolve_interpret(comm)
     token = mpx.create_token()
@@ -1456,10 +1463,11 @@ def _wide_run(state: State, num_steps: int, cfg: Config, comm: mpx.Comm,
     first advanced step the forward-Euler one (a 1-step kernel call).
     This is the hot path behind every wide-mode driver (``make_stepper``
     and ``solve_fused``)."""
-    assert cfg.ny_local - 2 >= m and cfg.nx_local - 2 >= m, (
-        "wide-halo path: local interior must be >= the exchange depth "
-        f"({m}) in both dimensions; use model_step_pallas_halo"
-    )
+    if cfg.ny_local - 2 < m or cfg.nx_local - 2 < m:
+        raise ValueError(
+            "wide-halo path: local interior must be >= the exchange depth "
+            f"({m}) in both dimensions; use model_step_pallas_halo"
+        )
     if num_steps <= 0:
         return state
     token = mpx.create_token()
@@ -1523,7 +1531,7 @@ def select_step(fast, cfg: Config = None):
     - ``False`` — the reference-structured step (parity oracle);
     - ``True`` — ``model_step_fast`` (works on any mesh);
     - ``"pallas"`` / ``"pallas2"`` / ``"pallas3"`` — the fused whole-step
-      Pallas kernel (single-rank periodic-x only; asserts otherwise);
+      Pallas kernel (single-rank periodic-x only; raises otherwise);
       ``"pallas2"``/``"pallas3"`` additionally fuse 2/3 steps per kernel
       call (see ``select_steps``);
     - ``"pallas_halo"`` — the split-phase Pallas kernels with real halo

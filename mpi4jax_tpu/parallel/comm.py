@@ -33,6 +33,18 @@ from jax import lax
 _uid_counter = itertools.count()
 
 
+def _color_order_key(colors):
+    """Group-ordering key for Split color values: numeric when every color
+    is a number (so 10 sorts after 2, like MPI's integer colors), string
+    otherwise (mixed/naming colors get a stable lexicographic order)."""
+    import numbers
+
+    if all(isinstance(c, numbers.Real) and not isinstance(c, bool)
+           for c in colors):
+        return lambda kv: float(kv[0])
+    return lambda kv: str(kv[0])
+
+
 class Comm:
     """A communicator over one or more mesh axes.
 
@@ -241,7 +253,8 @@ class Comm:
             by_color.setdefault(colors[r], []).append(r)
         groups = tuple(
             tuple(sorted(members, key=lambda r: (keys[r], r)))
-            for _, members in sorted(by_color.items(), key=lambda kv: str(kv[0]))
+            for _, members in sorted(by_color.items(),
+                                     key=_color_order_key(colors))
         )
         return GroupComm(self, groups)
 
@@ -408,11 +421,12 @@ class GroupComm(Comm):
                 f"(got {len(keys)} for {n})"
             )
         new_groups = []
+        keyfn = _color_order_key(colors)  # once: the scan is O(world)
         for members in self._groups:
             by_color = {}
             for i, r in enumerate(members):
                 by_color.setdefault(colors[r], []).append((keys[r], i, r))
-            for _, lst in sorted(by_color.items(), key=lambda kv: str(kv[0])):
+            for _, lst in sorted(by_color.items(), key=keyfn):
                 new_groups.append(tuple(r for _, _, r in sorted(lst)))
         return GroupComm(self, tuple(new_groups))
 

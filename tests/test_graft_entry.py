@@ -39,7 +39,14 @@ def _run(code, timeout=600):
     )
 
 
-@pytest.mark.parametrize("n", [2, 3, 8])
+@pytest.mark.parametrize(
+    "n",
+    # the full dryrun costs ~25 s per subprocess; the driver runs n=8
+    # every round anyway, so only the odd-size config stays in the fast
+    # tier (it covers the non-power-of-2 group/ring edge cases)
+    [pytest.param(2, marks=pytest.mark.slow), 3,
+     pytest.param(8, marks=pytest.mark.slow)],
+)
 def test_dryrun_multichip_self_forces_platform(n):
     # The child process gets NO platform env vars — dryrun_multichip must
     # force the n-device virtual CPU platform entirely on its own.
@@ -50,6 +57,7 @@ def test_dryrun_multichip_self_forces_platform(n):
     assert "dryrun_multichip OK" in res.stdout
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_survives_preinitialized_jax():
     # Even if jax was already imported and backend-initialized before the
     # driver calls dryrun_multichip, the forcing must still yield n devices.

@@ -21,6 +21,9 @@ import textwrap
 
 import pytest
 
+# true multi-controller runs take ~15+ min: slow tier (pyproject addopts)
+pytestmark = pytest.mark.slow
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Everything the workers run.  Process-spanning assertions check only this

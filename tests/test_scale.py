@@ -7,7 +7,7 @@ serial op chain).  This file spawns ONE subprocess with a 64-virtual-device
 CPU mesh and pins, for the doubling-butterfly family:
 
 - correctness at n = 64 (PROD, non-commutative matmul, unequal color
-  split allreduce/bcast/scan);
+  split allreduce/bcast/scan, whole-world and per-group sendrecv rings);
 - program size: the traced jaxpr's ppermute count is O(log n) —
   2·ceil(log2 64) + broadcast rounds, not O(64);
 - a trace+compile+run wall budget, which an O(world) unroll blows.
@@ -49,7 +49,9 @@ def prog(x, mats):
     gs, tok = mpx.allreduce(x, op=mpx.PROD, comm=split, token=tok)
     gb, tok = mpx.bcast(x, 2, comm=split, token=tok)
     gc, tok = mpx.scan(x, mpx.SUM, comm=split, token=tok)
-    return p, mm, gs, gb, gc
+    rr, tok = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=comm, token=tok)
+    gr, tok = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=split, token=tok)
+    return p, mm, gs, gb, gc, rr, gr
 
 x = (1.0 + jnp.arange(N)[:, None] / 64.0).astype(jnp.float32)
 rng = np.random.default_rng(0)
@@ -62,7 +64,7 @@ jaxpr_text = str(jax.make_jaxpr(prog)(x, mats))
 n_ppermute = jaxpr_text.count("ppermute")
 n_lines = len(jaxpr_text.splitlines())
 
-p, mm, gs, gb, gc = (np.asarray(v) for v in prog(x, mats))
+p, mm, gs, gb, gc, rr, gr = (np.asarray(v) for v in prog(x, mats))
 wall = time.time() - t0
 
 xs = np.asarray(x)[:, 0]
@@ -79,6 +81,12 @@ for members in groups:
     )
     pref = np.cumsum(xs[list(members)])
     ok = ok and bool(np.allclose(gc[list(members), 0], pref, rtol=1e-4))
+    # per-group ring: local index i receives from i-1 (mod group size)
+    for i, r in enumerate(members):
+        ok = ok and bool(
+            gr[r, 0] == xs[members[(i - 1) % len(members)]]
+        )
+ok = ok and bool(np.allclose(rr[:, 0], np.roll(xs, 1)))
 
 print(json.dumps({"ok": ok, "n_ppermute": n_ppermute,
                   "n_lines": n_lines, "wall_s": wall}))
@@ -97,12 +105,12 @@ def test_64_device_log_depth_budget():
     assert out.returncode == 0, out.stderr[-3000:]
     res = json.loads(out.stdout.strip().splitlines()[-1])
     assert res["ok"], res
-    # 5 butterfly/prefix ops x <= 14 log2(64)-rounds each (measured 44);
-    # an O(n) permute ladder would need 5 x 63 = 315+
-    assert res["n_ppermute"] <= 70, res
+    # 5 butterfly/prefix ops x <= 14 log2(64)-rounds each + 2 single-
+    # permute sendrecvs (measured 46); an O(n) permute ladder needs 315+
+    assert res["n_ppermute"] <= 72, res
     # total program size catches O(world) unrolls that emit NO permutes
-    # (the old AllGather+fold chain): measured ~670 lines log-depth; a
+    # (the old AllGather+fold chain): measured ~700 lines log-depth; a
     # 5-op x 64-rank fold adds 320+ combine eqns on top
-    assert res["n_lines"] <= 800, res
+    assert res["n_lines"] <= 850, res
     # measured ~3 s; an O(world) trace/compile blows this long before a pod
     assert res["wall_s"] < 120, res

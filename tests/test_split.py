@@ -419,3 +419,19 @@ def test_split_eager_unequal_p2p_and_scan():
             exp_sc[r] = run
     np.testing.assert_allclose(np.asarray(ring)[:, 0], exp_ring)
     np.testing.assert_allclose(np.asarray(sc)[:, 0], exp_sc)
+
+
+def test_split_integer_colors_order_numerically():
+    """Integer colors order groups numerically (10 after 2), not
+    lexicographically; string colors keep lexicographic order (advisor
+    r4 finding: str() sorting surprised users with 10 < 2)."""
+    comm, size = world()
+    num = comm.Split([0, 10, 2, 10, 2, 0, 10, 2])
+    assert num.groups == ((0, 5), (2, 4, 7), (1, 3, 6))
+    nested = num.Split([10 if r % 2 else 2 for r in range(size)])
+    # within each numeric-ordered parent group, color 2 precedes color 10
+    assert nested.groups == (
+        (0,), (5,), (2, 4), (7,), (6,), (1, 3),
+    )
+    txt = comm.Split(["b", "a", "b", "a", "a", "b", "a", "b"])
+    assert txt.groups == ((1, 3, 4, 6), (0, 2, 5, 7))
