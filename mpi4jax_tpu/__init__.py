@@ -64,6 +64,7 @@ from .utils import (  # noqa: F401
     has_sycl_support,
     has_tpu_support,
 )
+from .utils.profiling import profile_ops  # noqa: F401
 
 # JAX version advisory at import (ref mpi4jax/_src/__init__.py:6-8).
 from .utils.jax_compat import check_jax_version as _check_jax_version
@@ -124,6 +125,7 @@ __all__ = [
     "run",
     "shift",
     "flush",
+    "profile_ops",
 ]
 
 __version__ = "0.1.0"

@@ -1,0 +1,56 @@
+"""Profiler integration: per-op device-time attribution on any backend.
+
+SURVEY.md §5 "Tracing / profiling": the reference's measured per-op latency
+lives in host-side ``perf_counter`` brackets inside libmpi calls (ref
+mpi_xla_bridge.pyx:47-60, 100-112) — a structure TPU collectives don't
+have (no host call per collective; XLA schedules them asynchronously on
+the device stream).  The native host-hooks path (``MPI4JAX_TPU_TRACE``,
+mpi4jax_tpu/native.py) reproduces the reference's measured brackets on the
+CPU backend; on TPU the honest measured source is the device profiler,
+and every op is already wrapped in ``jax.named_scope("mpi4jax_tpu.<op>")``
+(utils/debug.py) so collectives are attributable there.
+
+``profile_ops`` packages the correct capture protocol: the one pitfall is
+async dispatch — a jitted call returns before the device work runs, so a
+naive ``with jax.profiler.trace(...)`` can close the trace with nothing in
+it.  The context manager blocks on every live array before closing, which
+fences all outstanding device work into the captured window.
+"""
+
+import contextlib
+import os
+
+import jax
+
+__all__ = ["profile_ops"]
+
+
+@contextlib.contextmanager
+def profile_ops(logdir: str, *, create_perfetto_link: bool = False):
+    """Capture a profiler trace of the enclosed ops, async-dispatch-safe.
+
+    Usage::
+
+        with mpx.profile_ops("/tmp/jax-trace"):
+            out = step(state)          # any program using mpi4jax_tpu ops
+
+    On exit, outstanding device work is fenced into the trace
+    (``jax.block_until_ready`` over every live array on the default
+    backend), then the trace is closed.  The fence covers everything whose
+    output is still referenced — BIND the results you are profiling
+    (``out = step(state)``, as above); a call whose outputs you drop on
+    the floor has nothing live to fence and may land outside the window
+    (``jax.block_until_ready(step(state))`` inside the block is the
+    explicit form).  Open the directory in TensorBoard/xprof and filter
+    for ``mpi4jax_tpu.<op>`` to read each collective's device time, queue
+    time, and overlap with compute — measured on the real stream,
+    including any fusion/reordering XLA applied (docs/usage.md
+    "Observability").
+    """
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir, create_perfetto_link=create_perfetto_link):
+        yield
+        # fence: async dispatch means enclosed calls may not have executed
+        # yet; blocking on live arrays lands their device work inside the
+        # trace window
+        jax.block_until_ready(jax.live_arrays())

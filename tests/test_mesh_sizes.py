@@ -165,3 +165,30 @@ def test_hybrid_ensemble_spatial_mesh():
         np.testing.assert_allclose(mean[i + 4], want, rtol=1e-6)
     # members stay distinct dynamical trajectories
     assert np.abs(h_out[:4] - h_out[4:]).max() > 1e-3
+
+
+@pytest.mark.parametrize("n", [3, 5, 7])
+def test_butterfly_allreduce_odd_sizes(n):
+    """The doubling butterfly's window clamping at non-power-of-2 sizes:
+    PROD (no native collective) and a non-commutative matmul must both
+    give the ascending-rank fold on every rank."""
+    comm = _comm(n)
+
+    @mpx.spmd(comm=comm)
+    def f(x, m):
+        p, tok = mpx.allreduce(x, op=mpx.PROD, comm=comm)
+        mm, _ = mpx.allreduce(m, op=jnp.matmul, comm=comm, token=tok)
+        return p, mm
+
+    vals = 1.0 + jnp.arange(n)[:, None] / 8.0
+    rng = np.random.default_rng(n)
+    mats = jnp.asarray(rng.normal(size=(n, 2, 2)).astype(np.float32))
+    p, mm = f(vals, mats)
+    np.testing.assert_allclose(
+        np.asarray(p)[:, 0], np.prod(np.asarray(vals)), rtol=1e-6)
+    expected = np.eye(2, dtype=np.float32)
+    for r in range(n):
+        expected = expected @ np.asarray(mats)[r]
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(mm)[r], expected,
+                                   rtol=1e-5, atol=1e-5)

@@ -1,0 +1,51 @@
+"""profile_ops: the TPU-side per-op latency story (SURVEY.md §5 tracing).
+
+The measured host-bracket path (MPI4JAX_TPU_TRACE) is CPU-backend-only by
+design; on TPU the measured source is the device profiler.  ``profile_ops``
+packages the capture protocol (async-dispatch fence before the trace
+closes) — this file pins that a trace of a program full of collectives
+actually lands on disk with content, on the test backend; the chip lane's
+recipe is the same call (docs/usage.md).
+"""
+
+import glob
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as mpx
+
+
+def test_profile_ops_captures_trace(tmp_path):
+    comm = mpx.get_default_comm()
+
+    @mpx.spmd
+    def step(x):
+        y, tok = mpx.allreduce(x, op=mpx.SUM, comm=comm)
+        z, _ = mpx.sendrecv(y, y, dest=mpx.shift(1), comm=comm, token=tok)
+        return z
+
+    x = jnp.ones((8, 64))
+    step(x)  # compile outside the capture window
+    logdir = str(tmp_path / "trace")
+    with mpx.profile_ops(logdir):
+        out = step(x)
+    # the fence ran inside the context: out is ready without further sync
+    assert np.isfinite(np.asarray(out)).all()
+    files = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    assert files, f"no trace captured under {logdir}"
+
+
+def test_profile_ops_nested_exceptions_close_trace(tmp_path):
+    """An exception inside the window must not leave the profiler running
+    (a dangling session would poison every later capture)."""
+    logdir = str(tmp_path / "trace2")
+    try:
+        with mpx.profile_ops(logdir):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    # a second capture works — the first session was closed
+    with mpx.profile_ops(logdir):
+        jnp.ones(4).sum()
+    assert glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)

@@ -234,6 +234,33 @@ def test_all_twelve_ops_on_chip():
         )
 
 
+def test_profile_ops_on_chip(tmp_path):
+    """The per-op latency story on the REAL backend: profile_ops must
+    capture a device trace of a collective-bearing program on the chip
+    (the CPU suite pins the same protocol; this is the platform the
+    MPI4JAX_TPU_TRACE host brackets cannot cover)."""
+    import glob
+
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    mesh = mpx.make_world_mesh(devices=jax.devices()[:1])
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+
+    @mpx.spmd(comm=comm)
+    def step(x):
+        y, _ = mpx.allreduce(x, op=mpx.SUM, comm=comm)
+        return y
+
+    x = jnp.ones((1, 512, 512))
+    step(x)  # compile first
+    logdir = str(tmp_path / "trace")
+    with mpx.profile_ops(logdir):
+        step(x)
+    assert glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True), logdir
+
+
 def test_bench_smoke_on_chip():
     """bench.py (the driver's benchmark entry) must produce its one-line
     JSON on the chip with the on-chip amortized metric present and sane;
