@@ -56,7 +56,8 @@ def reference_attention(q, k, v, *, causal=False):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def ring_attention(q, k, v, *, comm=None, causal=False):
+def ring_attention(q, k, v, *, comm=None, causal=False,
+                   memory_efficient_grad=True):
     """Exact blockwise attention over a K/V ring.
 
     ``q``/``k``/``v``: rank-local sequence shards ``(B, T_local, H, D)``;
@@ -68,8 +69,30 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
     (the (Tq, Tk) score matrix never leaves VMEM), the identical-math jnp
     path elsewhere; ``merge_partials`` is the flash combine rule across
     ring steps.
+
+    ``memory_efficient_grad=True`` (default) gives the ring its own custom
+    VJP: the forward saves only rank-LOCAL tensors plus the final softmax
+    stats — O(T/n) per chip — and the backward RE-ROTATES K/V around the
+    ring, accumulating dK/dV gradients that travel with their blocks (one
+    extra full ring of communication; blockwise kernels throughout, so no
+    score matrix materializes).  Plain reverse-mode AD through the forward
+    would instead pin every rotated K/V block (plus each step's merge
+    accumulator) as residuals — O(T_global) per chip, silently forfeiting
+    ring attention's defining memory property exactly when sequences are
+    long.  Set ``False`` to use plain AD (keeps ``jax.jvp`` forward-mode
+    support, which a ``custom_vjp`` function cannot offer).
     """
     comm = comm if comm is not None else mpx.get_default_comm()
+    if memory_efficient_grad:
+        return _ring_attention_me(causal, comm, q, k, v)
+    out, _m, _l = _ring_forward(q, k, v, comm, causal)
+    return out
+
+
+def _ring_forward(q, k, v, comm, causal):
+    """The ring forward; returns the normalized output AND the final
+    streaming-softmax stats (m, l) so the memory-efficient backward can
+    reconstruct per-block probabilities without storing blocks."""
     size = comm.Get_size()
     rank = comm.Get_rank()
     b, t_loc, h, d = q.shape
@@ -81,7 +104,10 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
     acc = jnp.zeros_like(q)
     # promote fresh (replicated-typed) constants so they can join the
     # varying carry (docs/sharp_bits.md)
-    m, l, acc = mpx.varying((m, l, acc))
+    # pass comm explicitly: custom_vjp traces this function lazily (at
+    # grad/partial-eval time), after the enclosing region context popped,
+    # so the default-comm resolution would pick the wrong axes
+    m, l, acc = mpx.varying((m, l, acc), comm=comm)
 
     k_blk, v_blk = k, v
     # static unroll: `size` steps, each one CollectivePermute + one block of
@@ -133,7 +159,116 @@ def ring_attention(q, k, v, *, comm=None, causal=False):
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     # merge accumulates in f32; return in the input dtype
-    return (acc / jnp.moveaxis(l_safe, 1, 2)[..., None]).astype(q.dtype)
+    out = (acc / jnp.moveaxis(l_safe, 1, 2)[..., None]).astype(q.dtype)
+    return out, m, l
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ring_attention_me(causal, comm, q, k, v):
+    out, _m, _l = _ring_forward(q, k, v, comm, causal)
+    return out
+
+
+def _ring_me_fwd(causal, comm, q, k, v):
+    out, m, l = _ring_forward(q, k, v, comm, causal)
+    # residuals are rank-LOCAL only: O(T/n) per chip
+    return out, (q, k, v, out, m, l)
+
+
+def _ring_me_bwd(causal, comm, res, g):
+    """Ring-attention backward with re-communication instead of residuals.
+
+    Reconstruction: with the FINAL stabilizer ``m`` and normalizer ``l``,
+    the output decomposes over blocks as
+
+        out = (sum_b o_b * e^{m_b - m}) / l,     l = sum_b l_b * e^{m_b - m}
+
+    where ``(o_b, m_b, l_b)`` are block partials.  The cotangents of each
+    block's partials are therefore ``g_o_b = (g / l) * e^{m_b - m}`` and
+    ``g_l_b = -(sum_d g*out / l) * e^{m_b - m}`` (the softmax "delta"
+    term), with the stabilizer weights' own derivative dropped — exact,
+    because the decomposition is invariant to every stabilizer (the same
+    argument as ``flash_block_partials``'s custom VJP).  Each ring step
+    recomputes one block's ``m_b`` (a forward kernel call), feeds these
+    cotangents through the blockwise backward kernels (``jax.vjp`` of
+    ``flash_block_partials``), and accumulates (dK_b, dV_b) into buffers
+    that ROTATE WITH the block — after the full cycle of ``size`` hops
+    every dK/dV lands back on its owner with all ranks' contributions.
+    """
+    q, k, v, out, m, l = res
+    size = comm.Get_size()
+    rank = comm.Get_rank()
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+
+    g = g.astype(jnp.float32)
+    out32 = out.astype(jnp.float32)
+    # cotangents of the (acc, l) pair that produced out = acc / l
+    g_acc = g / jnp.moveaxis(l_safe, 1, 2)[..., None]          # (B,T,H,D)
+    delta = jnp.moveaxis((g * out32).sum(-1), 2, 1)            # (B,H,T)
+    g_l = -delta / l_safe
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    dq, dk, dv = mpx.varying((dq, dk, dv), comm=comm)
+    k_blk, v_blk = k, v
+
+    for step in range(size):
+        blk_causal = bool(causal and step == 0)
+
+        def _block(kb, vb, dk_c, dv_c, blk_causal=blk_causal):
+            (o_b, m_b, l_b), vjp = jax.vjp(
+                lambda q_, kb_, vb_: flash_block_partials(
+                    q_, kb_, vb_, None, scale=scale, causal=blk_causal
+                ),
+                q, kb, vb,
+            )
+            w = jnp.exp(m_b - m_safe)  # stabilizer reweight
+            g_ob = (g_acc * jnp.moveaxis(w, 1, 2)[..., None]).astype(o_b.dtype)
+            g_lb = g_l * w
+            # the TRUE m_b cotangent (L depends on m_b through w): with it
+            # the triple is the full chain rule, so the jnp fallback's
+            # native AD is exact; the kernel path's custom VJP drops it,
+            # which is equally exact by stabilizer invariance
+            g_mb = w * (
+                jnp.moveaxis((g_acc * o_b.astype(jnp.float32)).sum(-1), 2, 1)
+                + g_l * l_b
+            )
+            dq_b, dk_b, dv_b = vjp((g_ob, g_mb, g_lb))
+            return (dq_b.astype(jnp.float32),
+                    dk_c + dk_b.astype(jnp.float32),
+                    dv_c + dv_b.astype(jnp.float32))
+
+        if causal and step > 0:
+            dq_b, dk, dv = jax.lax.cond(
+                step <= rank,
+                _block,
+                lambda kb, vb, dk_c, dv_c: (jnp.zeros_like(dq), dk_c, dv_c),
+                k_blk, v_blk, dk, dv,
+            )
+        else:
+            dq_b, dk, dv = _block(k_blk, v_blk, dk, dv)
+        dq = dq + dq_b
+
+        # rotate: dK/dV accumulators travel with their block and need the
+        # FULL cycle of `size` hops to land back on the owner; K/V are
+        # never read after the last step, so their final hop is elided
+        # (same guard as the forward)
+        if step + 1 < size:
+            k_blk = notoken.sendrecv(k_blk, k_blk, dest=mpx.shift(1),
+                                     comm=comm)
+            v_blk = notoken.sendrecv(v_blk, v_blk, dest=mpx.shift(1),
+                                     comm=comm)
+        dk = notoken.sendrecv(dk, dk, dest=mpx.shift(1), comm=comm)
+        dv = notoken.sendrecv(dv, dv, dest=mpx.shift(1), comm=comm)
+
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention_me.defvjp(_ring_me_fwd, _ring_me_bwd)
 
 
 def flash_attention(q, k, v, causal=False):

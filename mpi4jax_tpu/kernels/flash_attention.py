@@ -307,6 +307,14 @@ def _partials_impl(q, k, v, mask, scale, causal, interpret, force_jnp):
 #     dq = ds @ k                   dk = ds^T @ q_scaled
 #     dv = p^T @ g_o
 #
+# Measured on the v5e chip at (B=4, T=4096, H=8, D=128, f32), interleaved
+# 15-iteration fori_loop amortization: full grad (fwd + both backward
+# kernels) costs ~2.4x the forward alone non-causal (16.5 vs 7.0 ms/iter
+# in one session) — consistent with the backward's ~2.5x matmul FLOPs (5
+# tile dots vs the forward's 2) — and ~1.6x causal (12.8 vs 8.1 ms),
+# where both backward kernels inherit the key-tile skipping via their
+# loop bounds.  Session-band caveats as in the module docstring.
+#
 # Stabilizer semantics: `m` is treated as `stop_gradient` — its incoming
 # cotangent is DROPPED.  This is exact for every numerically sane consumer:
 # the downstream combination (merge_partials chains + the final `acc / l`
