@@ -10,9 +10,12 @@ compose into sequence/context parallelism:
   Liu et al. 2023): each rank holds a sequence shard of K/V and rotates it
   around the ring with ``shift(1)`` — one CollectivePermute per step over
   ICI — accumulating attention with a streaming (flash-style) softmax.
-  Memory per chip stays O(T/n), enabling sequences n× longer than one chip
-  could hold; compute overlaps the permutes (XLA pipelines the unrolled
-  steps).  Causal runs compute only the visible blocks (fully-masked ring
+  Memory per chip stays O(T/n) — in the BACKWARD too: a custom VJP saves
+  only rank-local residuals and re-rotates K/V during the backward, with
+  dK/dV accumulators traveling the ring (see ``ring_attention``) —
+  enabling sequences n× longer than one chip could hold; compute overlaps
+  the permutes (XLA pipelines the unrolled steps).
+  Causal runs compute only the visible blocks (fully-masked ring
   steps are skipped per rank via ``lax.cond``; fully-visible blocks skip
   masking) — n(n+1)/2 blocks of MXU work instead of n², measured 2.10×
   end-to-end on the 8-rank test mesh — and the diagonal block uses the
