@@ -185,3 +185,41 @@ def test_ring_memory_efficient_grad_bf16():
     e = np.asarray(g_f32)
     assert np.isfinite(a).all()
     np.testing.assert_allclose(a, e, rtol=0.1, atol=0.05)
+
+
+def test_ring_memory_efficient_grad_uses_less_memory():
+    """The point of the custom VJP: XLA's own memory analysis must show the
+    memory-efficient backward allocating well under plain AD's residuals
+    (measured ~15 vs ~51 MiB temp at T_local=512 on the 8-rank mesh; the
+    plain path pins every rotated K/V block plus per-step merge
+    accumulators, O(n * T_local), while the custom VJP re-communicates)."""
+    comm = mpx.get_default_comm()
+    n, b, t_loc, h, d = SIZE, 1, 512, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (n, b, t_loc, h, d), jnp.float32) for kk in ks
+    )
+
+    def make_grad(me):
+        def loss(q, k, v):
+            @mpx.spmd
+            def f(q, k, v):
+                out = ring_attention(q, k, v, comm=comm, causal=True,
+                                     memory_efficient_grad=me)
+                l, _ = mpx.allreduce((out**2).sum(), op=mpx.SUM)
+                return mpx.varying(l)
+
+            return jnp.sum(f(q, k, v))
+
+        return jax.jit(jax.grad(loss, (0, 1, 2)))
+
+    temps = {}
+    for me in (False, True):
+        ma = make_grad(me).lower(q, k, v).compile().memory_analysis()
+        if ma is None:  # jax documents None for unsupported backends
+            pytest.skip("memory_analysis unavailable on this backend")
+        temps[me] = ma.temp_size_in_bytes
+    assert temps[True] < temps[False] / 2, (
+        f"memory-efficient backward lost its advantage: "
+        f"{temps[True]/2**20:.1f} vs {temps[False]/2**20:.1f} MiB temp"
+    )
