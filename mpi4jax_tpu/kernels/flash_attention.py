@@ -1,7 +1,7 @@
 """Flash-attention block partials — the ring-attention hot op, in Pallas.
 
 One ring-attention step computes attention of the local queries against one
-rotating K/V block (examples/long_context_attention.py).  The Pallas kernel
+rotating K/V block (mpi4jax_tpu/attention.py).  The Pallas kernel
 fuses score computation, masking, and the streaming-softmax partials for one
 (batch, head) pair entirely in VMEM — the (Tq, Tk) score matrix never
 touches HBM (XLA materializes it between the einsum and the softmax in the
@@ -50,7 +50,7 @@ comparison): the MXU already multiplies in bf16 for f32 dots by
 default, and operand traffic is not the bottleneck, so bf16 here saves
 memory, not time.
 
-End-to-end, the causal ring (examples/long_context_attention.py) skips
+End-to-end, the causal ring (mpi4jax_tpu/attention.py) skips
 fully-masked ring steps per rank (lax.cond) and drops masking on fully-
 visible blocks, so total causal FLOPs are n(n+1)/2 blocks instead of n^2.
 Measured 2.10x end-to-end speedup on the 8-rank test mesh (CPU — a ring

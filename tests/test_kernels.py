@@ -1,7 +1,7 @@
 """Pallas kernel tests: flash-attention block partials.
 
 The kernel (``mpi4jax_tpu/kernels/flash_attention.py``) is the ring-attention
-hot op — ``examples/long_context_attention.py::ring_attention`` calls it once
+hot op — ``mpi4jax_tpu.attention.ring_attention`` calls it once
 per ring step.  Interpret mode runs the actual kernel body on CPU; the
 acceptance criterion is equality with the identical-math jnp path
 (``force_jnp=True``), including rows with no attendable key, which must come

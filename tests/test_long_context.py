@@ -1,6 +1,6 @@
 """Long-context attention built on the primitives (SURVEY.md §5: the
 framework must make ring/Ulysses sequence parallelism expressible on the op
-set; examples/long_context_attention.py is the executable documentation).
+set; mpi4jax_tpu/attention.py is the first-class implementation).
 
 Both schemes are exact, so the acceptance test is equality with full
 single-device attention on the gathered sequence.
