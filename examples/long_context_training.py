@@ -1,0 +1,134 @@
+"""Training with sequence parallelism: ring attention × data parallel.
+
+The composition a long-context training run actually uses, end-to-end on
+one 2-D mesh ``("dp", "sp")``:
+
+- activations are sharded over BOTH axes: batch over ``dp``, sequence over
+  ``sp`` (each rank holds a (B_local, T_local, ...) tile);
+- attention runs over the ``sp`` sub-communicator via
+  ``mpi4jax_tpu.attention.ring_attention`` — exact causal attention over
+  the full sequence with O(T/n) memory per chip, forward and backward
+  (the memory-efficient custom VJP re-rotates K/V);
+- parameters are replicated; each rank's parameter gradient is partial
+  (it saw a batch/sequence tile), so one ``allreduce`` over the WORLD
+  communicator completes it — the reference's DP-SGD pattern
+  (ref tests/collective_ops/test_allreduce.py:254-324) extended with a
+  sequence axis;
+- the optimizer step is plain JAX on the replicated params.
+
+The model is a minimal pre-LN transformer block + readout trained to
+regress a target sequence.  ``tests/test_examples.py`` pins the
+distributed step's loss and every parameter gradient against a
+single-device reference on the gathered data; ``main()`` additionally
+asserts the loss decreases over five steps.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+from mpi4jax_tpu.attention import ring_attention  # noqa: E402
+
+
+def init_params(key, d_model, d_ff):
+    ks = jax.random.split(key, 5)
+    s = 1.0 / jnp.sqrt(d_model)
+    return {
+        "wqkv": jax.random.normal(ks[0], (d_model, 3 * d_model)) * s,
+        "wo": jax.random.normal(ks[1], (d_model, d_model)) * s,
+        "w1": jax.random.normal(ks[2], (d_model, d_ff)) * s,
+        "w2": jax.random.normal(ks[3], (d_ff, d_model)) * (1.0 / jnp.sqrt(d_ff)),
+        "wout": jax.random.normal(ks[4], (d_model, 1)) * s,
+    }
+
+
+def _ln(x):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-6)
+
+
+def block_forward(params, x, *, heads, attend):
+    """Pre-LN transformer block + scalar readout.
+
+    ``x``: (B, T, D_model) — T may be a rank-local sequence shard; the
+    attention implementation is injected via ``attend`` so the SAME
+    function serves the sharded model (ring attention over the sp comm)
+    and the single-device reference (full attention).
+    """
+    b, t, d = x.shape
+    h = heads
+    qkv = _ln(x) @ params["wqkv"]
+    q, k, v = (y.reshape(b, t, h, d // h) for y in jnp.split(qkv, 3, -1))
+    att = attend(q, k, v).reshape(b, t, d)
+    x = x + att @ params["wo"]
+    x = x + jax.nn.gelu(_ln(x) @ params["w1"]) @ params["w2"]
+    return (x @ params["wout"])[..., 0]  # (B, T)
+
+
+def make_train_step(world, sp, heads, lr=1e-2):
+    """One SGD step on ``world``'s mesh: activations sharded (dp, sp),
+    params replicated, gradient completed by a world allreduce."""
+
+    def local_loss(params, x, y):
+        pred = block_forward(
+            params, x, heads=heads,
+            attend=lambda q, k, v: ring_attention(
+                q, k, v, comm=sp, causal=True
+            ),
+        )
+        # rank-local partial of the GLOBAL mean squared error: divide by
+        # the global element count so the summed (allreduced) loss and
+        # gradients are means — without this, gradient magnitude scales
+        # with world size x tile size and SGD diverges
+        denom = world.Get_size() * y.size
+        return jnp.sum((pred - y) ** 2) / denom
+
+    @mpx.spmd(comm=world)
+    def step(params, x, y):
+        local, grads = jax.value_and_grad(local_loss)(params, x, y)
+        loss, tok = mpx.allreduce(local, op=mpx.SUM, comm=world)
+        out = {}
+        for name in sorted(grads):
+            g, tok = mpx.allreduce(grads[name], op=mpx.SUM, comm=world,
+                                   token=tok)
+            out[name] = params[name] - lr * g
+        return out, mpx.varying(loss, comm=world)
+
+    return step
+
+
+def main():
+    n = len(jax.devices())
+    n_dp = 2 if n % 2 == 0 and n > 1 else 1
+    n_sp = n // n_dp
+    mesh = mpx.make_world_mesh((n_dp, n_sp), ("dp", "sp"))
+    world = mpx.Comm(("dp", "sp"), mesh=mesh)
+    sp = world.sub("sp")
+
+    b_loc, t_loc, d_model, d_ff, heads = 2, 32, 32, 64, 4
+    params = init_params(jax.random.PRNGKey(0), d_model, d_ff)
+    # replicate params per rank (leading world axis)
+    params_g = {k: jnp.broadcast_to(v, (n, *v.shape))
+                for k, v in params.items()}
+    kx, ky = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(kx, (n, b_loc, t_loc, d_model), jnp.float32)
+    y = jax.random.normal(ky, (n, b_loc, t_loc), jnp.float32)
+
+    step = make_train_step(world, sp, heads, lr=0.1)
+    losses = []
+    for i in range(5):
+        params_g, loss = step(params_g, x, y)
+        losses.append(float(jnp.asarray(loss)[0]))
+    print(f"dp={n_dp} x sp={n_sp}: loss {losses[0]:.4f} -> {losses[-1]:.4f} "
+          f"over {len(losses)} steps")
+    assert losses[-1] < losses[0], "training did not reduce the loss"
+
+
+if __name__ == "__main__":
+    main()

@@ -365,7 +365,12 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, tk, n_kt, has_mask):
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, tq, tk, n_qt, has_mask):
     # grid step (i, kj): k/v tiles (1, bk, d), q/g_o whole (1, tq_pad, d),
-    # m/g_l whole (1, 1, tq_pad), [mask (tq_pad, bk)], out dk/dv (1, bk, d).
+    # m/g_l whole (1, tq_pad, 1), [mask (tq_pad, bk)], out dk/dv (1, bk, d).
+    # m/g_l arrive TRANSPOSED (query positions on the SUBLANE dim): the
+    # fori_loop below slices them at qj*bq, and Mosaic requires lane-dim
+    # dynamic offsets to be provable multiples of 128 — only true when bq
+    # is itself a multiple of 128, and bq = min(Tq, 512) — while sublane
+    # offsets only need multiples of 8 (every bq here is).
     if has_mask:
         (q_ref, k_ref, v_ref, m_ref, gl_ref, go_ref, mask_ref,
          dk_ref, dv_ref) = refs
@@ -382,8 +387,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, tq, tk, n_qt, has_mask):
         dk_acc, dv_acc = carry
         qt = q_ref[0, pl.dslice(qj * bq, bq), :].astype(jnp.float32)
         got = go_ref[0, pl.dslice(qj * bq, bq), :].astype(jnp.float32)
-        mt = m_ref[0, 0, pl.dslice(qj * bq, bq)]
-        glt = gl_ref[0, 0, pl.dslice(qj * bq, bq)]
+        mt = m_ref[0, pl.dslice(qj * bq, bq), 0]
+        glt = gl_ref[0, pl.dslice(qj * bq, bq), 0]
         m_safe = jnp.where(jnp.isinf(mt), 0.0, mt)
         s = jnp.dot(qt, kk.T, preferred_element_type=jnp.float32) * scale
         qpos = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -447,8 +452,6 @@ def _partials_bwd_impl(q, k, v, mask, m, g_o, g_l, scale, causal, interpret):
                                memory_space=pltpu.VMEM)
     mtile_spec = pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j),
                               memory_space=pltpu.VMEM)
-    mwhole_spec = pl.BlockSpec((1, 1, tq_pad), lambda i, j: (i, 0, 0),
-                               memory_space=pltpu.VMEM)
     params = (
         None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024
@@ -478,10 +481,18 @@ def _partials_bwd_impl(q, k, v, mask, m, g_o, g_l, scale, causal, interpret):
         compiler_params=params,
     )(*dq_operands)
 
-    # dk/dv: one grid step per (batch*head, key tile), loop over query tiles
-    dkv_in_specs = [qwhole_spec, ktile_spec, ktile_spec, mwhole_spec,
-                    mwhole_spec, qwhole_spec]
-    dkv_operands = [qf, kf, vf, mf, glf, gof]
+    # dk/dv: one grid step per (batch*head, key tile), loop over query
+    # tiles.  m/g_l go in TRANSPOSED — (bh, tq_pad, 1), query positions on
+    # the sublane dim — because the kernel's fori_loop slices them at
+    # qj*bq and lane-dim dynamic offsets must be provable multiples of
+    # 128, which only holds when bq is one (sublane offsets need 8s).
+    mT_spec = pl.BlockSpec((1, tq_pad, 1), lambda i, j: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    mf_t = jnp.swapaxes(mf, 1, 2)
+    glf_t = jnp.swapaxes(glf, 1, 2)
+    dkv_in_specs = [qwhole_spec, ktile_spec, ktile_spec, mT_spec,
+                    mT_spec, qwhole_spec]
+    dkv_operands = [qf, kf, vf, mf_t, glf_t, gof]
     if maskf is not None:
         dkv_in_specs.append(
             pl.BlockSpec((tq_pad, bk), lambda i, j: (0, j),

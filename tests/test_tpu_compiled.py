@@ -342,6 +342,36 @@ def test_flash_attention_backward_compiled():
             )
 
 
+def test_flash_backward_small_shapes_all_inputs_compiled():
+    """Grads wrt q AND k/v at small T and small head dims, Mosaic-compiled.
+
+    Regression: the dk/dv kernel used to dynamic-slice m/g_l on the LANE
+    dim at qj*bq offsets, which Mosaic can only prove 128-aligned when bq
+    (= min(Tq, 512)) is a multiple of 128 — so any transformer-block
+    training step with a T_local that wasn't failed to compile on TPU,
+    and nothing caught it because every earlier chip test took grads wrt
+    q only (the dk/dv kernel was dead code there).  m/g_l now enter that
+    kernel transposed (query positions on the sublane dim, 8-aligned)."""
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.kernels.flash_attention import flash_block_partials
+
+    for (t, d) in ((32, 8), (200, 32), (1024, 128)):
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, v = (
+            jax.random.normal(kk, (1, t, 2, d), jnp.float32) for kk in ks
+        )
+        for causal in (False, True):
+            g = jax.jit(jax.grad(
+                lambda q, k, v: flash_block_partials(
+                    q, k, v, None, scale=0.2, causal=causal
+                )[0].sum(),
+                (0, 1, 2),
+            ))(q, k, v)
+            for a, nm in zip(g, "qkv"):
+                assert np.isfinite(np.asarray(a)).all(), (t, d, causal, nm)
+
+
 def test_ring_and_ulysses_grad_compiled():
     """ring/ulysses grads compile and run on a 1-device mesh on chip.
 
