@@ -12,7 +12,12 @@
 //     measured across the collective on the host;
 //   - fail-fast abort: a data-dependent guard that kills the process when a
 //     runtime predicate fires (the MPI_Abort-on-error semantics of
-//     ref mpi_xla_bridge.pyx:67-91).
+//     ref mpi_xla_bridge.pyx:67-91);
+//   - collective watchdog (mpi4jax_tpu/resilience/watchdog.py): an arm/disarm
+//     registry of in-flight collectives plus a C++ monitor thread that dumps
+//     per-rank diagnostics and aborts when one exceeds its timeout.  The
+//     registry lives here (not Python) so the timeout fires even when every
+//     Python thread is wedged behind the GIL.
 //
 // Build: see csrc/CMakeLists.txt or `python -m mpi4jax_tpu.native build`.
 // Loaded and registered from mpi4jax_tpu/native.py via ctypes + jax.ffi.
@@ -26,6 +31,7 @@
 #include <mutex>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 
 #include "xla/ffi/api/ffi.h"
@@ -113,6 +119,96 @@ ffi::Error AbortIfImpl(ffi::BufferR0<ffi::U32> pred,
   return ffi::Error::Success();
 }
 
+// ---------------------------------------------------------------------------
+// collective watchdog (resilience/watchdog.py's native backend)
+// ---------------------------------------------------------------------------
+
+struct WatchdogEntry {
+  uint32_t rank;
+  std::string opname;
+  std::string call_id;
+  std::string axes;
+  double start;
+  double timeout;
+};
+
+// Same FIFO-per-(call_id, rank) aliasing story as begin_times above: a trace
+// site inside lax.fori_loop re-arms with the same call id before the prior
+// iteration's disarm is ordered, so a plain map entry could be clobbered.
+std::mutex wd_mu;
+std::unordered_map<std::string, std::deque<WatchdogEntry>> wd_inflight;
+bool wd_thread_running = false;
+
+void WatchdogDump(const WatchdogEntry& expired, double now) {
+  // called with wd_mu held; never returns
+  for (const auto& kv : wd_inflight) {
+    for (const auto& e : kv.second) {
+      std::fprintf(stderr,
+                   "r%" PRIu32 " | WATCHDOG | in-flight: %s (call %s, "
+                   "axes=%s, elapsed %.2fs)\n",
+                   e.rank, e.opname.c_str(), e.call_id.c_str(),
+                   e.axes.c_str(), now - e.start);
+    }
+  }
+  std::fprintf(stderr,
+               "r%" PRIu32 " | FATAL: collective watchdog: %s exceeded "
+               "%gs (call %s, axes=%s)\n",
+               expired.rank, expired.opname.c_str(), expired.timeout,
+               expired.call_id.c_str(), expired.axes.c_str());
+  std::fflush(stderr);
+  std::abort();
+}
+
+void WatchdogLoop() {
+  for (;;) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    double now = Now();
+    std::lock_guard<std::mutex> lock(wd_mu);
+    for (const auto& kv : wd_inflight) {
+      for (const auto& e : kv.second) {
+        if (now - e.start > e.timeout) WatchdogDump(e, now);
+      }
+    }
+  }
+}
+
+ffi::Error WatchdogArmImpl(ffi::BufferR0<ffi::U32> rank,
+                           ffi::Result<ffi::BufferR0<ffi::U32>> out,
+                           std::string_view opname, std::string_view call_id,
+                           std::string_view axes, double timeout) {
+  uint32_t r = rank.typed_data()[0];
+  std::string key = std::string(call_id) + ":" + std::to_string(r);
+  {
+    std::lock_guard<std::mutex> lock(wd_mu);
+    wd_inflight[key].push_back(WatchdogEntry{
+        r, std::string(opname), std::string(call_id), std::string(axes),
+        Now(), timeout});
+    if (!wd_thread_running) {
+      std::thread(WatchdogLoop).detach();
+      wd_thread_running = true;
+    }
+  }
+  out->typed_data()[0] = r;
+  return ffi::Error::Success();
+}
+
+ffi::Error WatchdogDisarmImpl(ffi::BufferR0<ffi::U32> rank,
+                              ffi::Result<ffi::BufferR0<ffi::U32>> out,
+                              std::string_view call_id) {
+  uint32_t r = rank.typed_data()[0];
+  std::string key = std::string(call_id) + ":" + std::to_string(r);
+  {
+    std::lock_guard<std::mutex> lock(wd_mu);
+    auto it = wd_inflight.find(key);
+    if (it != wd_inflight.end() && !it->second.empty()) {
+      it->second.pop_front();
+      if (it->second.empty()) wd_inflight.erase(it);
+    }
+  }
+  out->typed_data()[0] = r;
+  return ffi::Error::Success();
+}
+
 ffi::Error WallclockImpl(ffi::BufferR0<ffi::U32> token,
                          ffi::Result<ffi::BufferR0<ffi::F64>> out) {
   (void)token;
@@ -152,3 +248,18 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(MpxWallclock, WallclockImpl,
                               ffi::Ffi::Bind()
                                   .Arg<ffi::BufferR0<ffi::U32>>()
                                   .Ret<ffi::BufferR0<ffi::F64>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(MpxWatchdogArm, WatchdogArmImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::BufferR0<ffi::U32>>()
+                                  .Ret<ffi::BufferR0<ffi::U32>>()
+                                  .Attr<std::string_view>("opname")
+                                  .Attr<std::string_view>("call_id")
+                                  .Attr<std::string_view>("axes")
+                                  .Attr<double>("timeout"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(MpxWatchdogDisarm, WatchdogDisarmImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::BufferR0<ffi::U32>>()
+                                  .Ret<ffi::BufferR0<ffi::U32>>()
+                                  .Attr<std::string_view>("call_id"));

@@ -64,6 +64,11 @@ from .utils import (  # noqa: F401
     has_sycl_support,
     has_tpu_support,
 )
+from .resilience import (  # noqa: F401
+    set_check_numerics,
+    set_fault_spec,
+    set_watchdog_timeout,
+)
 from .utils.profiling import profile_ops  # noqa: F401
 
 # JAX version advisory at import (ref mpi4jax/_src/__init__.py:6-8).
@@ -126,6 +131,20 @@ __all__ = [
     "shift",
     "flush",
     "profile_ops",
+    # resilience (docs/resilience.md)
+    "set_watchdog_timeout",
+    "set_fault_spec",
+    "set_check_numerics",
 ]
 
-__version__ = "0.1.0"
+# Version comes from git tags via setuptools-scm at build time
+# (pyproject.toml [tool.setuptools_scm]); installed packages answer through
+# their metadata.  A source checkout on sys.path that was never installed
+# has no metadata — fall back to the scm-style local version.
+try:
+    from importlib.metadata import PackageNotFoundError, version as _version
+
+    __version__ = _version("mpi4jax_tpu")
+except PackageNotFoundError:  # uninstalled source tree
+    __version__ = "0.0.0+unknown"
+del PackageNotFoundError, _version

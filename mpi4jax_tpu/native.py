@@ -12,7 +12,10 @@ provides the host-side runtime services around it:
 - ``abort_if`` — data-dependent fail-fast (MPI_Abort-on-error semantics,
   ref mpi_xla_bridge.pyx:67-91): if the predicate is true at run time the
   whole process dies, not just the computation;
-- ``wallclock`` — host timestamp as an in-graph value.
+- ``wallclock`` — host timestamp as an in-graph value;
+- ``watchdog_arm``/``watchdog_disarm`` — the collective watchdog's in-graph
+  bracket (resilience/watchdog.py): registry and monitor thread live in C++
+  so the timeout fires even when every Python thread is wedged.
 
 All hooks are CPU-backend custom calls (the test/dev backend).  On TPU the
 compute path has no host hooks by design — ``runtime_tracing_supported()``
@@ -40,8 +43,14 @@ _LIB_PATH = os.path.join(_LIB_DIR, "libmpx_hooks.so")
 _lib: Optional[ctypes.CDLL] = None
 _registered = False
 
-_HANDLERS = ("MpxOpBegin", "MpxOpEnd", "MpxAbortIf", "MpxWallclock")
-_TARGETS = ("mpx_op_begin", "mpx_op_end", "mpx_abort_if", "mpx_wallclock")
+_HANDLERS = ("MpxOpBegin", "MpxOpEnd", "MpxAbortIf", "MpxWallclock",
+             "MpxWatchdogArm", "MpxWatchdogDisarm")
+_TARGETS = ("mpx_op_begin", "mpx_op_end", "mpx_abort_if", "mpx_wallclock",
+            "mpx_watchdog_arm", "mpx_watchdog_disarm")
+
+# handlers actually present in the loaded .so (an older build may predate
+# the watchdog hooks; feature probes below consult this set)
+_loaded_handlers: set = set()
 
 
 def build(verbose: bool = True) -> str:
@@ -55,7 +64,7 @@ def build(verbose: bool = True) -> str:
     )
     os.makedirs(_LIB_DIR, exist_ok=True)
     cmd = [
-        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+        "g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
         f"-I{jax.ffi.include_dir()}",
         os.path.abspath(src), "-o", _LIB_PATH,
     ]
@@ -74,11 +83,14 @@ def _load() -> Optional[ctypes.CDLL]:
     _lib = ctypes.CDLL(_LIB_PATH)
     if not _registered:
         for handler, target in zip(_HANDLERS, _TARGETS):
+            try:
+                sym = getattr(_lib, handler)
+            except AttributeError:
+                continue  # stale .so from before this hook existed
             jax.ffi.register_ffi_target(
-                target,
-                jax.ffi.pycapsule(getattr(_lib, handler)),
-                platform="cpu",
+                target, jax.ffi.pycapsule(sym), platform="cpu",
             )
+            _loaded_handlers.add(handler)
         _registered = True
     return _lib
 
@@ -150,11 +162,69 @@ def abort_if(pred, rank, message: str):
 
     def _cb(p, r):
         if p:
-            print(f"r{int(r)} | FATAL: {message}", file=sys.stderr, flush=True)
-            os.abort()
+            host_fatal(r, message)
 
     jax.debug.callback(_cb, pred, rank, ordered=False)
     return pred
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog hooks (resilience/watchdog.py)
+# ---------------------------------------------------------------------------
+
+
+def host_line(rank, text: str) -> None:
+    """Host-side diagnostic line in the runtime-log format (``r{rank} | ...``).
+
+    Plain Python (not in-graph): used by host-side monitors (the watchdog's
+    Python-fallback thread) that speak outside any traced program.
+    """
+    print(f"r{int(rank)} | {text}", file=sys.stderr, flush=True)
+
+
+def host_fatal(rank, text: str) -> None:
+    """Host-side fail-fast: print in ``abort_if``'s FATAL format and kill the
+    process (the watchdog's fallback death path — same loud exit as the
+    native ``MpxAbortIf`` hook)."""
+    print(f"r{int(rank)} | FATAL: {text}", file=sys.stderr, flush=True)
+    os.abort()
+
+
+def watchdog_supported() -> bool:
+    """True when the C++ watchdog registry/monitor can back the collective
+    watchdog (native library built with the watchdog hooks, CPU backend —
+    same availability rule as the runtime trace hooks)."""
+    return (
+        runtime_tracing_supported() and "MpxWatchdogArm" in _loaded_handlers
+    )
+
+
+def watchdog_arm(opname: str, call_id: str, rank, axes: str, timeout: float):
+    """Register one in-flight collective with the C++ watchdog; returns a u32
+    the op's inputs must be tied to (so arming precedes the collective)."""
+    call = jax.ffi.ffi_call(
+        "mpx_watchdog_arm",
+        jax.ShapeDtypeStruct((), jnp.uint32),
+        has_side_effect=True,
+    )
+    import numpy as np
+
+    return call(
+        jnp.asarray(rank, jnp.uint32),
+        opname=opname, call_id=call_id, axes=axes,
+        timeout=np.float64(timeout),
+    )
+
+
+def watchdog_disarm(call_id: str, rank, dep):
+    """Deregister after the collective: ``dep`` (the op's first output) ties
+    the call after completion."""
+    call = jax.ffi.ffi_call(
+        "mpx_watchdog_disarm",
+        jax.ShapeDtypeStruct((), jnp.uint32),
+        has_side_effect=True,
+    )
+    return call(_tie(jnp.asarray(rank, jnp.uint32), dep), call_id=call_id)
 
 
 # Base timestamp for the pure-Python fallback, captured at first use.  Raw

@@ -144,6 +144,17 @@ def apply_doubling_bcast(xl, comm: Comm, root: int):
     non-root ranks — never poison the result.
     """
     groups = _comm_groups(comm)
+    # ``members[(root + p) % kk]`` below would silently wrap an out-of-range
+    # root into a *different* group position and misroute every round; fail
+    # loudly here instead.  (bcast validates against ``comm.min_size()``
+    # before dispatch, but this helper is callable on its own.)
+    kmin = min(len(g) for g in groups)
+    if not 0 <= root < kmin:
+        raise ValueError(
+            f"apply_doubling_bcast: root {root} out of range for the "
+            f"smallest group (size {kmin}); root must be a valid group "
+            "position in every group"
+        )
     kmax = max(len(g) for g in groups)
     if kmax == 1:
         return xl
@@ -257,22 +268,38 @@ def _mpi_opname(opname: str) -> str:
 
 
 def _run_body(opname: str, comm: Comm, body, arrays, token):
-    """Run an op body, bracketed by native runtime begin/end hooks when
-    tracing is on (host-side log + measured per-op wall-clock latency; see
-    mpi4jax_tpu/native.py).  Data dependencies pin the hooks around the
-    collective: inputs are tied after ``op_begin``, ``op_end`` is tied to
-    the first output."""
-    from .. import native
+    """Run an op body, bracketed by the instrumentation every op shares:
 
-    if not (get_runtime_tracing() and native.runtime_tracing_supported()):
+    - native runtime begin/end hooks when tracing is on (host-side log +
+      measured per-op wall-clock latency; see mpi4jax_tpu/native.py);
+    - the resilience plan when any resilience feature is on (fault
+      injection, numeric guards, collective watchdog; see
+      mpi4jax_tpu/resilience/runtime.py) — this is the single dispatch
+      point that makes all 12 ops injectable/guardable without per-op code.
+
+    Data dependencies pin everything around the collective: inputs are tied
+    after ``op_begin``/fault probe/watchdog arm, and ``op_end``/watchdog
+    disarm/output guards are tied to the first output.  With tracing off and
+    every resilience feature off (the default) the body runs untouched — the
+    lowered HLO is byte-identical to an uninstrumented build (pinned by
+    tests/test_resilience.py)."""
+    from .. import native
+    from ..resilience import runtime as _resilience
+
+    plan = _resilience.plan_for(opname)
+    tracing = get_runtime_tracing() and native.runtime_tracing_supported()
+    if plan is None and not tracing:
         return body(comm, arrays, token)
     import secrets
 
     call_id = secrets.token_hex(4)
     rank = comm.Get_rank()
     name = _mpi_opname(opname)
-    begin = native.op_begin(name, call_id, rank, "")
-    arrays = tuple(native._tie(a, begin) for a in arrays)
+    if plan is not None:
+        arrays, token = plan.before(name, call_id, comm, arrays, token)
+    if tracing:
+        begin = native.op_begin(name, call_id, rank, "")
+        arrays = tuple(native._tie(a, begin) for a in arrays)
     out = body(comm, arrays, token)
     results = [r for r in out if r is not None]
     dep = results[0]
@@ -280,7 +307,10 @@ def _run_body(opname: str, comm: Comm, body, arrays, token):
 
     if isinstance(dep, Token):
         dep = dep.value
-    native.op_end(name, call_id, rank, dep)
+    if tracing:
+        native.op_end(name, call_id, rank, dep)
+    if plan is not None:
+        plan.after(name, call_id, comm, dep, results)
     return out
 
 
@@ -374,10 +404,13 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     if static_key is not None:
         from ..utils.config import prefer_notoken
 
+        from ..resilience.runtime import cache_token as resilience_token
+
         # every dynamically-read flag that shapes the trace must be in the
         # key, or toggling it would silently keep serving the old program
         cache_key = (opname, comm.mesh, comm.uid, static_key,
-                     get_runtime_tracing(), get_logging(), prefer_notoken())
+                     get_runtime_tracing(), get_logging(), prefer_notoken(),
+                     resilience_token())
         cached = _eager_cache.get(cache_key)
         if cached is not None:
             _eager_cache.move_to_end(cache_key)

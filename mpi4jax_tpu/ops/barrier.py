@@ -16,7 +16,7 @@ from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
 from ._base import as_varying, dispatch
-from .token import Token, consume
+from .token import Token, tie
 
 
 @enforce_types(comm=(Comm, None), token=(Token, None))
@@ -27,7 +27,13 @@ def barrier(*, comm: Optional[Comm] = None, token: Optional[Token] = None):
     def body(comm, arrays, token):
         z = jnp.zeros((), jnp.uint32)
         if token is not None:
-            z = consume(token, z)
+            # tie, not consume: ordering IS the barrier's semantics, so the
+            # incoming dependency must hold even under PREFER_NOTOKEN (which
+            # disables consume) — same reasoning as the pending-sync ties in
+            # ops/_base.py dispatch.  This is also what anchors the
+            # resilience probe/arm for a bare barrier() (the synthesized
+            # token in resilience/runtime.py Plan.before).
+            z = tie(token, z)
         log_op("MPI_Barrier", comm.Get_rank())
         s = lax.psum(as_varying(z, comm.axes), comm.axes)
         # the output token IS the collective result, so consuming the token

@@ -32,6 +32,10 @@ def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    connect_deadline: float = 300.0,
+    connect_base_delay: float = 1.0,
+    connect_max_delay: float = 30.0,
     **kwargs,
 ) -> None:
     """Initialize multi-host JAX (the ``mpirun`` replacement).
@@ -39,15 +43,44 @@ def init_distributed(
     On TPU pods the arguments are auto-detected from the TPU metadata
     environment, so a bare ``init_distributed()`` suffices; on CPU/GPU
     clusters pass coordinator/process info explicitly.  Idempotent.
+
+    The coordinator connection is retried with full-jitter exponential
+    backoff (resilience/retry.py): at job start workers race the coordinator
+    process, and on preempted pods transient refusals are the norm —
+    a worker that gives up on the first ``ConnectionError`` turns routine
+    scheduler jitter into a failed job.  ``connect_deadline`` bounds the
+    total wait (seconds); on expiry a ``RuntimeError`` names the attempt
+    count, elapsed time, and last underlying error.  ``connect_base_delay``
+    and ``connect_max_delay`` shape the backoff (docs/resilience.md).
     """
     global _distributed_initialized
     if _distributed_initialized:
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
+
+    from ..resilience.retry import retry_with_backoff
+
+    def _connect():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+
+    retry_with_backoff(
+        _connect,
+        what="jax.distributed coordinator connection "
+             f"({coordinator_address or 'auto-detected'})",
+        deadline=connect_deadline,
+        base_delay=connect_base_delay,
+        max_delay=connect_max_delay,
+        # a second initialize on an already-initialized backend is a
+        # programming error, not a transient refusal: retrying it would
+        # spin until the deadline on every attempt.  JAX's message is
+        # "distributed.initialize should only be called once." (stable
+        # wording across releases); match loosely in case it drifts.
+        giveup=lambda e: ("already initialized" in str(e)
+                          or "only be called once" in str(e)),
     )
     _distributed_initialized = True
 

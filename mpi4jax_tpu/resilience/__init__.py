@@ -1,0 +1,58 @@
+"""Resilience subsystem: fail loudly instead of hanging silently.
+
+In the SPMD/multi-host model a single dead or stalled process leaves every
+surviving process blocked *forever* inside its next collective — the classic
+silent failure mode of production TPU training stacks.  The reference design
+ships fail-fast semantics at the bridge level (``abort_on_error``, mirrored
+here as ``native.abort_if``) but nothing above it; this package is the layer
+above:
+
+- :mod:`.watchdog` — a host-side monitor armed/disarmed around each op's
+  begin/end bracket; a collective exceeding ``MPI4JAX_TPU_WATCHDOG_TIMEOUT``
+  seconds dumps per-rank in-flight diagnostics and kills the process;
+- :mod:`.faultinject` — deterministic delay/die/corrupt injection from a
+  parsed ``MPI4JAX_TPU_FAULT_SPEC``, intercepting at the single shared
+  dispatch point (``ops/_base.py``) so all 12 ops are injectable;
+- :mod:`.numerics` — opt-in ``MPI4JAX_TPU_CHECK_NUMERICS`` NaN/Inf guards on
+  each collective's inputs/outputs, tied into ``abort_if``;
+- :mod:`.retry` — exponential-backoff (full-jitter) retry with a total
+  deadline, used by ``init_distributed``'s coordinator connection;
+- :mod:`.runtime` — config resolution and the per-op :class:`~.runtime.Plan`
+  the dispatch layer consults.  All features default OFF, and when off the
+  lowered HLO is byte-identical to an uninstrumented build.
+
+Failure model, spec grammar, and knobs: docs/resilience.md.
+"""
+
+from .faultinject import (  # noqa: F401
+    FaultClause,
+    canonical_spec,
+    parse_fault_spec,
+    reset_fault_state,
+)
+from .retry import retry_with_backoff  # noqa: F401
+from .runtime import (  # noqa: F401
+    cache_token,
+    plan_for,
+    reset_overrides,
+    set_check_numerics,
+    set_fault_spec,
+    set_watchdog_timeout,
+)
+from .watchdog import inflight_snapshot, registry_empty  # noqa: F401
+
+__all__ = [
+    "FaultClause",
+    "parse_fault_spec",
+    "canonical_spec",
+    "reset_fault_state",
+    "retry_with_backoff",
+    "plan_for",
+    "cache_token",
+    "set_watchdog_timeout",
+    "set_fault_spec",
+    "set_check_numerics",
+    "reset_overrides",
+    "inflight_snapshot",
+    "registry_empty",
+]

@@ -1,0 +1,239 @@
+"""Deterministic fault injection at the shared op dispatch point.
+
+Production collectives fail in three characteristic ways: a rank goes slow
+(stragglers, preemption), a rank dies (hardware loss, OOM-kill), or a rank
+computes garbage (silent data corruption, bad reduction inputs).  This module
+injects all three *deterministically* from a parsed spec, at the single
+dispatch point every one of the 12 ops flows through (``ops/_base.py
+_run_body``) — so every op is injectable in tests without touching per-op
+code, and a production incident can be rehearsed with one environment
+variable.
+
+Spec grammar (``MPI4JAX_TPU_FAULT_SPEC``, full reference in
+docs/resilience.md)::
+
+    spec    := clause (';' clause)*
+    clause  := verb (':' arg)*
+    verb    := 'delay' | 'die' | 'corrupt'
+    arg     := 'nan' | 'inf' | key '=' value      # bare modes only for corrupt
+    key     := 'rank' | 'op' | 'after' | 'secs'
+
+Examples::
+
+    delay:rank=1:op=allreduce:after=3:secs=2   # rank 1 sleeps 2s in every
+                                               # allreduce after its 3rd
+    die:rank=0:op=barrier:after=1              # rank 0 exits in its 2nd barrier
+    corrupt:nan:rank=2:op=allreduce            # rank 2 feeds NaN inputs
+
+Semantics:
+
+- ``rank`` is the GLOBAL mesh rank (row-major over the comm's full axes);
+  omitted = every rank.
+- ``op`` is the lowercase op name as dispatched (``allreduce``, ``barrier``,
+  ...); omitted = every op.
+- ``after=N``: the first N matching calls (counted per rank, at run time —
+  compiled-program reuse is counted correctly) run clean; the fault fires on
+  every matching call after that.  Default 0 (fire immediately).
+- ``delay`` sleeps ``secs`` (default 1.0) on the host before the collective;
+  ``die`` kills the process (``os._exit(13)``), simulating a crashed rank;
+  ``corrupt`` overwrites the op's floating-point inputs with NaN (``nan``,
+  default) or +Inf (``inf``) on the firing rank only.
+
+Trigger decisions happen on the HOST at execution time (an ``io_callback``
+probe threaded into the program with data dependencies), not at trace time:
+``after=N`` keeps counting across reuses of one compiled program, which is
+where real stragglers live.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+_VERBS = ("delay", "die", "corrupt")
+_KEYS = ("rank", "op", "after", "secs")
+_MODES = ("nan", "inf")
+
+_GRAMMAR = (
+    "expected 'verb[:arg]*' clauses joined by ';', verb in "
+    f"{_VERBS}, args 'key=value' with key in {_KEYS} (plus a bare "
+    f"mode in {_MODES} for corrupt) — e.g. "
+    "'delay:rank=1:op=allreduce:after=3:secs=2'"
+)
+
+
+@dataclass(frozen=True)
+class FaultClause:
+    """One parsed fault clause (see module docstring for field semantics)."""
+
+    verb: str
+    mode: Optional[str] = None  # corrupt only: 'nan' | 'inf'
+    rank: Optional[int] = None  # global rank; None = all ranks
+    op: Optional[str] = None    # lowercase dispatch op name; None = all ops
+    after: int = 0
+    secs: float = 1.0           # delay only
+
+    def matches_op(self, opname: str) -> bool:
+        return self.op is None or self.op == opname
+
+    def canonical(self) -> str:
+        """Canonical spec string; ``parse_fault_spec`` round-trips it."""
+        parts = [self.verb]
+        if self.verb == "corrupt":
+            parts.append(self.mode or "nan")
+        if self.rank is not None:
+            parts.append(f"rank={self.rank}")
+        if self.op is not None:
+            parts.append(f"op={self.op}")
+        if self.after:
+            parts.append(f"after={self.after}")
+        if self.verb == "delay":
+            parts.append(f"secs={self.secs:g}")
+        return ":".join(parts)
+
+
+def _parse_clause(text: str) -> FaultClause:
+    fields = [f.strip() for f in text.split(":")]
+    verb = fields[0]
+    if verb not in _VERBS:
+        raise ValueError(
+            f"fault spec clause {text!r}: unknown verb {verb!r}; {_GRAMMAR}"
+        )
+    mode = None
+    kw = {}
+    for field in fields[1:]:
+        if not field:
+            raise ValueError(f"fault spec clause {text!r}: empty field; {_GRAMMAR}")
+        if "=" not in field:
+            if verb == "corrupt" and field in _MODES and mode is None:
+                mode = field
+                continue
+            raise ValueError(
+                f"fault spec clause {text!r}: bare field {field!r} is only "
+                f"valid as a corrupt mode in {_MODES}; {_GRAMMAR}"
+            )
+        key, _, value = field.partition("=")
+        key, value = key.strip(), value.strip()
+        if key not in _KEYS:
+            raise ValueError(
+                f"fault spec clause {text!r}: unknown key {key!r}; {_GRAMMAR}"
+            )
+        if key in kw:
+            raise ValueError(f"fault spec clause {text!r}: duplicate key {key!r}")
+        try:
+            if key == "rank":
+                kw["rank"] = int(value)
+            elif key == "after":
+                kw["after"] = int(value)
+            elif key == "secs":
+                kw["secs"] = float(value)
+            else:
+                kw["op"] = value.lower()
+        except ValueError as e:
+            raise ValueError(
+                f"fault spec clause {text!r}: bad value for {key}: {value!r}"
+            ) from e
+    if verb != "delay" and "secs" in kw:
+        raise ValueError(
+            f"fault spec clause {text!r}: 'secs' only applies to delay"
+        )
+    if verb == "corrupt" and mode is None:
+        mode = "nan"
+    if kw.get("after", 0) < 0:
+        raise ValueError(f"fault spec clause {text!r}: after must be >= 0")
+    if kw.get("secs", 1.0) < 0:
+        raise ValueError(f"fault spec clause {text!r}: secs must be >= 0")
+    return FaultClause(verb=verb, mode=mode, **kw)
+
+
+@functools.lru_cache(maxsize=32)
+def parse_fault_spec(spec: str) -> Tuple[FaultClause, ...]:
+    """Parse a ``MPI4JAX_TPU_FAULT_SPEC`` string into clauses.
+
+    Raises ``ValueError`` (with the grammar) on malformed specs; '' -> ().
+    """
+    spec = spec.strip()
+    if not spec:
+        return ()
+    return tuple(
+        _parse_clause(c.strip()) for c in spec.split(";") if c.strip()
+    )
+
+
+def canonical_spec(clauses: Tuple[FaultClause, ...]) -> str:
+    return ";".join(c.canonical() for c in clauses)
+
+
+# ---------------------------------------------------------------------------
+# host-side trigger state
+# ---------------------------------------------------------------------------
+
+
+class _FaultState:
+    """Per-process matching-call counters: (clause identity, rank) -> count.
+
+    The count only advances for calls the clause matches (op and rank), so
+    ``after=N`` means "the first N calls this fault WOULD hit run clean".
+    """
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counts = {}
+
+    def bump(self, clause: FaultClause, rank: int) -> int:
+        key = (clause, rank)
+        with self.lock:
+            n = self.counts.get(key, 0) + 1
+            self.counts[key] = n
+        return n
+
+    def reset(self) -> None:
+        with self.lock:
+            self.counts.clear()
+
+
+_state = _FaultState()
+
+
+def reset_fault_state() -> None:
+    """Forget all per-rank trigger counts (test isolation)."""
+    _state.reset()
+
+
+def _fault_line(rank: int, text: str) -> None:
+    print(f"r{rank} | FAULT | {text}", file=sys.stderr, flush=True)
+
+
+def probe_host(indexed_clauses, mpi_name: str, rank) -> int:
+    """Host-side trigger: count, act (delay/die), and return the corrupt mask.
+
+    ``indexed_clauses``: tuple of (bit, clause) for clauses whose ``op``
+    matches the dispatching op.  Returns a bitmask with bit ``b`` set iff
+    the corrupt clause at bit ``b`` fires for this rank on this call.
+    """
+    r = int(rank)
+    mask = 0
+    for bit, clause in indexed_clauses:
+        if clause.rank is not None and clause.rank != r:
+            continue
+        if _state.bump(clause, r) <= clause.after:
+            continue
+        if clause.verb == "delay":
+            _fault_line(r, f"delay {clause.secs:g}s injected in {mpi_name} "
+                           f"({clause.canonical()})")
+            time.sleep(clause.secs)
+        elif clause.verb == "die":
+            _fault_line(r, f"die injected in {mpi_name} "
+                           f"({clause.canonical()})")
+            sys.stderr.flush()
+            os._exit(13)
+        else:  # corrupt
+            _fault_line(r, f"corrupt:{clause.mode} injected in {mpi_name} "
+                           f"({clause.canonical()})")
+            mask |= 1 << bit
+    return mask

@@ -1,0 +1,79 @@
+"""Exponential-backoff retry with jitter and a total deadline.
+
+Built for the multi-host bootstrap (``init_distributed``'s
+``jax.distributed`` coordinator connection — workers race the coordinator
+process at job start, and transient refusals are the norm on preempted pods),
+but generic: any callable whose failures are transient.
+
+Full-jitter backoff (sleep ~ U(0, min(base * factor^n, max_delay))): the
+standard cure for reconnection stampedes when hundreds of workers retry the
+same coordinator.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["retry_with_backoff"]
+
+# transient-looking failure classes for a network rendezvous; TypeError /
+# ValueError and friends (programming errors) propagate immediately
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    ConnectionError,
+    OSError,
+    RuntimeError,
+    TimeoutError,
+)
+
+
+def retry_with_backoff(
+    fn: Callable,
+    *,
+    what: str = "operation",
+    deadline: float = 300.0,
+    base_delay: float = 1.0,
+    max_delay: float = 30.0,
+    factor: float = 2.0,
+    jitter: bool = True,
+    retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE,
+    giveup: Optional[Callable[[BaseException], bool]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+):
+    """Call ``fn`` until it succeeds, a non-retryable error escapes, or the
+    total ``deadline`` (seconds) elapses.
+
+    On the deadline, raises ``RuntimeError`` naming ``what``, the attempt
+    count, and the elapsed time, chained from the last underlying error —
+    the "clear error at the deadline" a stuck bootstrap owes its operator.
+    ``giveup(exc) -> True`` re-raises immediately even for a retryable class
+    (escape hatch for permanent failures that share an exception type with
+    transient ones).  ``sleep``/``clock`` are injectable for tests.
+    """
+    if deadline <= 0:
+        raise ValueError(f"deadline must be positive, got {deadline}")
+    start = clock()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retryable as e:
+            if giveup is not None and giveup(e):
+                raise
+            attempt += 1
+            elapsed = clock() - start
+            if elapsed >= deadline:
+                raise RuntimeError(
+                    f"{what} failed after {attempt} attempt(s) over "
+                    f"{elapsed:.1f}s (deadline {deadline:g}s); last error: "
+                    f"{type(e).__name__}: {e}"
+                ) from e
+            delay = min(base_delay * factor ** (attempt - 1), max_delay)
+            if jitter:
+                delay = random.uniform(0, delay)
+            # never sleep past the deadline: fail at the promised time
+            delay = min(delay, deadline - elapsed)
+            if delay > 0:
+                sleep(delay)

@@ -1,0 +1,206 @@
+"""Collective watchdog: turn silent hangs into loud, diagnosable deaths.
+
+In the SPMD multi-host model a single dead or stalled process leaves every
+surviving process blocked *forever* inside its next collective — the classic
+silent failure mode of production TPU training stacks (the job holds its
+slice, burns no steps, and pages nobody).  The watchdog arms a host-side
+monitor around each op's begin/end bracket (the same data-dependency
+threading as the ``op_begin``/``op_end`` trace hooks, ops/_base.py
+``_run_body``); when any collective stays in flight longer than
+``MPI4JAX_TPU_WATCHDOG_TIMEOUT`` seconds, it dumps every in-flight op on this
+process (op name, call id, comm axes, elapsed) and kills the process through
+the ``abort_if`` fail-fast path, so the scheduler can reschedule instead of
+the job hanging.
+
+Two implementations, chosen per availability:
+
+- **native** (csrc/host_hooks.cc ``MpxWatchdogArm``/``MpxWatchdogDisarm``):
+  registry and monitor thread live in C++ — they keep running even if every
+  Python thread is wedged (e.g. the GIL is held by a stuck extension call);
+  CPU backend with the hooks library built.
+- **fallback** (this module): an ``io_callback`` pair updating a Python
+  registry, watched by a daemon thread.  Collectives block with the GIL
+  released, so the thread fires reliably in practice; works on any backend.
+
+Arm is ordered *before* the collective by tying the op's inputs to the arm
+token; disarm is tied *after* the first output — exactly the bracket the
+runtime trace hooks use, so the elapsed time the diagnostics report is the
+collective's true in-flight time on this host.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+__all__ = [
+    "arm_in_graph",
+    "disarm_in_graph",
+    "inflight_snapshot",
+    "registry_empty",
+]
+
+_POLL_INTERVAL = 0.1
+
+
+def _default_on_timeout(entries, expired):
+    """Dump per-rank in-flight diagnostics, then die via the abort path."""
+    from .. import native
+
+    for e in entries:
+        native.host_line(
+            e["rank"],
+            f"WATCHDOG | in-flight: {e['opname']} (call {e['call_id']}, "
+            f"axes={e['axes']}, elapsed {e['elapsed']:.2f}s)",
+        )
+    native.host_fatal(
+        expired["rank"],
+        f"collective watchdog: {expired['opname']} exceeded "
+        f"{expired['timeout']:g}s (call {expired['call_id']}, "
+        f"axes={expired['axes']})",
+    )
+
+
+class _Registry:
+    """In-flight op registry + monitor thread (the Python fallback path).
+
+    Keys are ``(call_id, rank)`` with a FIFO of start times per key — a trace
+    site inside ``lax.fori_loop`` fires once per iteration with the same call
+    id, and the data dependencies order iteration N+1's arm after iteration
+    N's collective but not after N's disarm (the same aliasing the native
+    trace hooks handle, csrc/host_hooks.cc ``begin_times``).
+    """
+
+    def __init__(self, on_timeout: Optional[Callable] = None,
+                 clock=time.monotonic):
+        self.lock = threading.Lock()
+        self.entries = {}  # (call_id, rank) -> deque of (opname, axes, start, timeout)
+        self.clock = clock
+        self.on_timeout = on_timeout or _default_on_timeout
+        self._thread = None
+
+    def arm(self, opname: str, call_id: str, rank: int, axes: str,
+            timeout: float) -> None:
+        with self.lock:
+            self.entries.setdefault((call_id, int(rank)), deque()).append(
+                (opname, axes, self.clock(), float(timeout))
+            )
+            self._ensure_thread_locked()
+
+    def disarm(self, call_id: str, rank: int) -> None:
+        key = (call_id, int(rank))
+        with self.lock:
+            dq = self.entries.get(key)
+            if dq:
+                dq.popleft()
+                if not dq:
+                    del self.entries[key]
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._monitor, name="mpi4jax_tpu-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def snapshot(self):
+        """Diagnostic view of every in-flight op: list of dicts with opname,
+        call_id, rank, axes, elapsed, timeout."""
+        now = self.clock()
+        with self.lock:
+            return [
+                {
+                    "opname": opname, "call_id": call_id, "rank": rank,
+                    "axes": axes, "elapsed": now - start, "timeout": timeout,
+                }
+                for (call_id, rank), dq in self.entries.items()
+                for (opname, axes, start, timeout) in dq
+            ]
+
+    def check_expired(self):
+        """One monitor scan; returns the expired snapshot entry or None."""
+        for e in self.snapshot():
+            if e["elapsed"] > e["timeout"]:
+                return e
+        return None
+
+    def empty(self) -> bool:
+        with self.lock:
+            return not self.entries
+
+    def _monitor(self) -> None:
+        while True:
+            time.sleep(_POLL_INTERVAL)
+            expired = self.check_expired()
+            if expired is not None:
+                self.on_timeout(self.snapshot(), expired)
+                return  # only reachable with a non-fatal on_timeout override
+
+
+_registry = _Registry()
+
+
+def registry_empty() -> bool:
+    """True when no op is in flight in the Python-fallback registry."""
+    return _registry.empty()
+
+
+def inflight_snapshot():
+    """Current in-flight ops in the Python-fallback registry (diagnostics)."""
+    return _registry.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# in-graph arm/disarm
+# ---------------------------------------------------------------------------
+
+
+def _io_callback(fn, rank):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    return io_callback(
+        fn, jax.ShapeDtypeStruct((), jnp.uint32), rank, ordered=False
+    )
+
+
+def arm_in_graph(mpi_name: str, call_id: str, comm, rank, timeout: float):
+    """Arm the watchdog for one collective; returns a u32 the op's inputs
+    must be tied to (so arming precedes the collective's execution)."""
+    from .. import native
+
+    axes = repr(comm.axes)
+    if native.watchdog_supported():
+        return native.watchdog_arm(mpi_name, call_id, rank, axes, timeout)
+
+    import numpy as np
+
+    def _arm(r):
+        _registry.arm(mpi_name, call_id, int(r), axes, timeout)
+        return np.uint32(r)
+
+    import jax.numpy as jnp
+
+    return _io_callback(_arm, jnp.asarray(rank, jnp.uint32))
+
+
+def disarm_in_graph(mpi_name: str, call_id: str, comm, rank, dep):
+    """Disarm after the collective: ``dep`` (the op's first output) orders
+    the callback after completion."""
+    from .. import native
+
+    if native.watchdog_supported():
+        return native.watchdog_disarm(call_id, rank, dep)
+
+    import numpy as np
+
+    def _disarm(r):
+        _registry.disarm(call_id, int(r))
+        return np.uint32(r)
+
+    import jax.numpy as jnp
+
+    return _io_callback(_disarm, native._tie(jnp.asarray(rank, jnp.uint32), dep))

@@ -14,9 +14,22 @@ Recognized variables:
 - ``MPI4JAX_TPU_PREFER_NOTOKEN`` — make the token API delegate to the notoken
   (implicit-ordering) implementation.
 - ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the JAX version advisory.
+- ``MPI4JAX_TPU_WATCHDOG_TIMEOUT`` — collective watchdog (resilience/watchdog.py):
+  seconds a single collective may stay in flight before the process is killed
+  with per-rank in-flight-op diagnostics.  Unset/0 disables (default).
+- ``MPI4JAX_TPU_FAULT_SPEC`` — deterministic fault injection
+  (resilience/faultinject.py): semicolon-separated clauses, e.g.
+  ``delay:rank=1:op=allreduce:after=3:secs=2``, ``die:rank=0:op=barrier:after=1``,
+  ``corrupt:nan:rank=2:op=allreduce``.  Empty disables (default).
+- ``MPI4JAX_TPU_CHECK_NUMERICS`` — abort (via the ``abort_if`` fail-fast path)
+  when a collective's inputs or outputs contain NaN/Inf, naming the op.
+  Off by default; when off, the lowered HLO is byte-identical to a build
+  without the guards (resilience/numerics.py).
 """
 
+import math
 import os
+from typing import Optional
 
 TRUTHY = ("true", "1", "on", "yes")
 FALSY = ("false", "0", "off", "no", "")
@@ -48,6 +61,57 @@ def debug_enabled() -> bool:
 
 def trace_enabled() -> bool:
     return parse_env_bool("MPI4JAX_TPU_TRACE", False)
+
+
+def parse_env_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    """Parse a non-negative finite float environment variable (empty/unset ->
+    ``default``)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = float(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"Environment variable {name}={raw!r} could not be parsed as a "
+            "number of seconds"
+        ) from e
+    # NaN would pass a plain `val < 0` check and then silently defeat every
+    # comparison downstream (a NaN watchdog timeout never expires while
+    # still instrumenting each op); Inf is equally meaningless as seconds
+    if not math.isfinite(val) or val < 0:
+        raise ValueError(
+            f"Environment variable {name}={raw!r} must be a finite "
+            "number >= 0"
+        )
+    return val
+
+
+def watchdog_timeout() -> Optional[float]:
+    """Collective watchdog timeout in seconds; ``None`` = disabled.
+
+    ``MPI4JAX_TPU_WATCHDOG_TIMEOUT`` unset, empty, or ``0`` disables the
+    watchdog (see mpi4jax_tpu/resilience/watchdog.py).
+    """
+    val = parse_env_float("MPI4JAX_TPU_WATCHDOG_TIMEOUT", None)
+    if val is None or val == 0:
+        return None
+    return val
+
+
+def fault_spec() -> str:
+    """Raw ``MPI4JAX_TPU_FAULT_SPEC`` string ('' = no injection).
+
+    Parsed by ``mpi4jax_tpu.resilience.parse_fault_spec`` (grammar in
+    docs/resilience.md).
+    """
+    return os.environ.get("MPI4JAX_TPU_FAULT_SPEC", "").strip()
+
+
+def check_numerics() -> bool:
+    """Whether collectives guard their inputs/outputs against NaN/Inf
+    (``MPI4JAX_TPU_CHECK_NUMERICS``; see mpi4jax_tpu/resilience/numerics.py)."""
+    return parse_env_bool("MPI4JAX_TPU_CHECK_NUMERICS", False)
 
 
 def prefer_notoken() -> bool:

@@ -49,8 +49,13 @@ def profile_ops(logdir: str, *, create_perfetto_link: bool = False):
     """
     os.makedirs(logdir, exist_ok=True)
     with jax.profiler.trace(logdir, create_perfetto_link=create_perfetto_link):
-        yield
-        # fence: async dispatch means enclosed calls may not have executed
-        # yet; blocking on live arrays lands their device work inside the
-        # trace window
-        jax.block_until_ready(jax.live_arrays())
+        try:
+            yield
+        finally:
+            # fence: async dispatch means enclosed calls may not have
+            # executed yet; blocking on live arrays lands their device work
+            # inside the trace window.  In a finally so the fence also runs
+            # when the profiled block raises — work dispatched before the
+            # exception would otherwise land outside the window and the
+            # partial trace would silently under-report.
+            jax.block_until_ready(jax.live_arrays())

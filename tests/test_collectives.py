@@ -321,3 +321,68 @@ def test_full_op_matrix_on_two_axis_comm():
     assert gt[1, 0] == vals.sum()
     np.testing.assert_array_equal(rd[0, 0], vals.max())
     np.testing.assert_array_equal(rd[1:, 0], vals[1:])
+
+
+def test_butterfly_emits_ppermute_rounds_aot():
+    """AOT/HLO pin for the butterfly lowerings' CollectivePermute rounds.
+
+    The only place these lowerings compile on a real chip today is the
+    1-device ambient lane (tests/test_tpu_compiled.py), where ``kmax == 1``
+    makes every ppermute round dead code — so this asserts, at the lowered-
+    HLO level on the 8-device mesh, that the rounds actually exist for
+    ``kmax > 1``: ``ceil(log2 8) = 3`` doubling-broadcast rounds for a
+    group bcast, and fold + broadcast rounds for a butterfly (PROD)
+    allreduce.
+    """
+    import math
+
+    _, size = world()
+    rounds = math.ceil(math.log2(size))
+    comm = mpx.get_default_comm()
+    split = comm.Split([0] * size)  # one group of everyone: kmax = size
+
+    @mpx.spmd(comm=split)
+    def doubling_bcast(x):
+        res, _ = mpx.bcast(x, 0, comm=split)
+        return res
+
+    text = jax.jit(doubling_bcast).lower(jnp.ones((size, 2))).as_text()
+    got = text.count("collective_permute")
+    assert got >= rounds, (
+        f"doubling bcast lowered with {got} collective_permute ops; "
+        f"expected the {rounds} doubling rounds for kmax={size}"
+    )
+
+    @mpx.spmd
+    def butterfly_allreduce(x):
+        res, _ = mpx.allreduce(x, op=mpx.PROD)
+        return res
+
+    text = jax.jit(butterfly_allreduce).lower(jnp.ones((size, 2))).as_text()
+    got = text.count("collective_permute")
+    # suffix-fold rounds + doubling-broadcast rounds
+    assert got >= 2 * rounds, (
+        f"butterfly allreduce lowered with {got} collective_permute ops; "
+        f"expected {rounds} fold + {rounds} broadcast rounds for "
+        f"size={size}"
+    )
+
+
+def test_doubling_bcast_root_out_of_range_raises():
+    """``apply_doubling_bcast`` must reject a root that is not a valid group
+    position in EVERY group — ``members[(root + p) % kk]`` would silently
+    wrap it into a different position and misroute each round."""
+    from mpi4jax_tpu.ops._base import apply_doubling_bcast
+
+    _, size = world()
+    comm = mpx.get_default_comm()
+    # unequal split: group sizes (2, size - 2) — root 2 is valid in the big
+    # group but out of range for the small one
+    split = comm.Split([0, 0] + [1] * (size - 2))
+
+    @mpx.spmd(comm=split)
+    def f(x):
+        return apply_doubling_bcast(x, split, 2)
+
+    with pytest.raises(ValueError, match="root 2 out of range"):
+        f(jnp.ones((size, 2)))

@@ -234,6 +234,53 @@ def test_all_twelve_ops_on_chip():
         )
 
 
+# COVERAGE GAP (by construction): on the 1-device mesh above, every group
+# lowering's CollectivePermute machinery is dead code — kmax == 1 returns
+# the input before any butterfly/doubling round is traced, so the chip lane
+# compiles none of the ppermute rounds.  The rounds themselves are pinned
+# at the lowered-HLO level on the 8-device CPU mesh
+# (tests/test_collectives.py::test_butterfly_emits_ppermute_rounds_aot);
+# the test below closes the on-chip half whenever the attached TPU has
+# more than one device (e.g. a v4-8 slice).
+
+
+def test_butterfly_rounds_on_multi_device_chip():
+    """The butterfly/doubling ppermute rounds compiled and EXECUTED on a
+    real multi-device TPU mesh — the coverage the 1-device lane cannot
+    provide.  PROD allreduce takes the fold+broadcast butterfly; the split
+    bcast takes the doubling broadcast."""
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    n = jax.device_count()
+    if n < 2:
+        pytest.skip("needs a multi-device TPU slice (ppermute rounds are "
+                    "dead code on 1 device)")
+
+    mesh = mpx.make_world_mesh()
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    split = comm.Split([0] * n)  # one group of everyone: kmax = n
+
+    @mpx.spmd(comm=comm)
+    def butterfly(x):
+        res, _ = mpx.allreduce(x, op=mpx.PROD, comm=comm)
+        return res
+
+    @mpx.spmd(comm=split)
+    def doubling(x):
+        res, _ = mpx.bcast(x, 1, comm=split)
+        return res
+
+    vals = jnp.arange(1.0, n + 1)[:, None] * jnp.ones((n, 4))
+    p = np.asarray(butterfly(vals))
+    np.testing.assert_allclose(
+        p, np.prod(np.arange(1.0, n + 1)) * np.ones((n, 4)), rtol=1e-5
+    )
+    b = np.asarray(doubling(vals))
+    np.testing.assert_allclose(b, 2.0 * np.ones((n, 4)))
+
+
 def test_profile_ops_on_chip(tmp_path):
     """The per-op latency story on the REAL backend: profile_ops must
     capture a device trace of a collective-bearing program on the chip
