@@ -9,7 +9,11 @@ these fill that gap with the two north-star metrics from BASELINE.json:
   convention, so numbers are comparable across device counts);
 - **sendrecv ring latency** (µs per hop) — the halo-exchange primitive.
 
-Usage:  python benchmarks/micro.py [--json]
+plus the butterfly-vs-ring allreduce sweep that measures the payload-aware
+algorithm layer's crossover (``MPI4JAX_TPU_COLLECTIVE_ALGO``,
+ops/_algos.py; the measured table lives in docs/microbenchmarks.md).
+
+Usage:  python benchmarks/micro.py [--json] [--save]
 
 Timing protocol: each measurement chains ``iters`` collectives inside one
 jitted program (so dispatch overhead amortizes), syncs via a host fetch
@@ -144,9 +148,74 @@ def bench_prod_and_split(comm, sizes_mb, iters=20):
     return rows
 
 
+def bench_allreduce_algos(comm, sizes_mb, iters=20):
+    """Forced butterfly vs forced ring for the SAME PROD allreduce over a
+    size sweep — the measured crossover table of docs/microbenchmarks.md.
+    PROD has no native HLO collective, so the two forced settings time the
+    CollectivePermute algorithm layer itself (``MPI4JAX_TPU_COLLECTIVE_ALGO``
+    is folded into the program cache keys, so each setting retraces)."""
+    n = comm.Get_size()
+    rows = []
+    saved = os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO")
+    try:
+        for mb in sizes_mb:
+            nelem = max(1, int(mb * 1e6 / 4))
+            row = {"size_mb": round(nelem * 4 / 1e6, 3)}
+            for algo in ("butterfly", "ring"):
+                os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = algo
+
+                @mpx.spmd(comm=comm)
+                def prog(x):
+                    def body(_, v):
+                        s, _tok = mpx.allreduce(v, op=mpx.PROD)
+                        return mpx.varying(jnp.clip(s, 0.5, 2.0))
+
+                    return jax.lax.fori_loop(0, iters, body, x)
+
+                x = jnp.ones((n, nelem), jnp.float32)
+                t = _time_program(prog, (x,)) / iters
+                row[f"{algo}_us"] = round(t * 1e6, 1)
+            # on 1 device both settings lower to the identity — no crossover
+            row["ring_speedup"] = (
+                round(row["butterfly_us"] / row["ring_us"], 2) if n > 1
+                else None
+            )
+            rows.append(row)
+    finally:
+        # restore (not just drop) the user's global algorithm setting
+        if saved is None:
+            os.environ.pop("MPI4JAX_TPU_COLLECTIVE_ALGO", None)
+        else:
+            os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = saved
+    return rows
+
+
+def save_results(payload, outdir=None):
+    """Write one sweep payload to ``benchmarks/results/`` (the ``--save``
+    flag): ``micro_{platform}_{n}dev_{YYYYMMDD}.json``, returning the path
+    (dated so committed captures are never silently clobbered)."""
+    import datetime
+
+    if outdir is None:
+        outdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "results")
+    os.makedirs(outdir, exist_ok=True)
+    stamp = datetime.date.today().strftime("%Y%m%d")
+    path = os.path.join(
+        outdir,
+        f"micro_{payload['platform']}_{payload['n_devices']}dev_{stamp}.json",
+    )
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument("--save", action="store_true",
+                   help="write the sweep to benchmarks/results/")
     p.add_argument("--sizes-mb", type=float, nargs="+",
                    default=[0.004, 0.25, 1, 4, 16, 64])
     p.add_argument("--sizes-kb", type=float, nargs="+",
@@ -161,25 +230,31 @@ def main():
     ar = bench_allreduce(comm, args.sizes_mb)
     pp = bench_sendrecv_ring(comm, args.sizes_kb)
     pr = bench_prod_and_split(comm, args.sizes_mb[:4])
+    al = bench_allreduce_algos(comm, args.sizes_mb)
 
+    payload = {
+        "platform": devices[0].platform,
+        "n_devices": n,
+        # honesty marker (docs/microbenchmarks.md): with a single
+        # device there is no interconnect to measure, and dispatch/
+        # attach overhead can dominate the timings — never read 1-device
+        # numbers as link bandwidth or latency
+        "environment": (
+            f"{n}-device {devices[0].platform}"
+            + ("; no interconnect to measure — timings may be "
+               "dispatch/attach-dominated (docs/microbenchmarks.md)"
+               if n == 1 else "")
+        ),
+        "allreduce": ar,
+        "sendrecv_ring": pp,
+        "prod_butterfly": pr,
+        "allreduce_algos": al,
+    }
+    if args.save:
+        path = save_results(payload)
+        print(f"saved: {path}", file=sys.stderr)
     if args.json:
-        print(json.dumps({
-            "platform": devices[0].platform,
-            "n_devices": n,
-            # honesty marker (docs/microbenchmarks.md): with a single
-            # device there is no interconnect to measure, and dispatch/
-            # attach overhead can dominate the timings — never read 1-device
-            # numbers as link bandwidth or latency
-            "environment": (
-                f"{n}-device {devices[0].platform}"
-                + ("; no interconnect to measure — timings may be "
-                   "dispatch/attach-dominated (docs/microbenchmarks.md)"
-                   if n == 1 else "")
-            ),
-            "allreduce": ar,
-            "sendrecv_ring": pp,
-            "prod_butterfly": pr,
-        }))
+        print(json.dumps(payload))
         return
 
     print(f"platform={devices[0].platform} n_devices={n}")
@@ -197,6 +272,12 @@ def main():
         sp = (f"{r['prod_split_us']:>10.1f} us"
               if r["prod_split_us"] is not None else "n/a (1 device)")
         print(f"  {r['size_mb']:>10.3f} MB   {r['prod_us']:>10.1f} us   {sp}")
+    print("\nPROD algo crossover           butterfly    ring         ring speedup")
+    for r in al:
+        sp = (f"{r['ring_speedup']:>6.2f}x"
+              if r["ring_speedup"] is not None else "n/a (1 device)")
+        print(f"  {r['size_mb']:>10.3f} MB   {r['butterfly_us']:>10.1f} us"
+              f"   {r['ring_us']:>10.1f} us   {sp}")
 
 
 if __name__ == "__main__":

@@ -1,9 +1,10 @@
 """mpi4jax_tpu — MPI-style communication primitives, TPU-native.
 
 A brand-new framework with the capabilities of mpi4jax (reference:
-Silv3S/mpi4jax): the 12 MPI communication primitives usable inside
-``jax.jit``, with explicit token-chaining *and* implicit ordering, and
-autodiff (JVP + transpose) through the communication — re-designed for TPU:
+Silv3S/mpi4jax): the reference's 12 MPI communication primitives (plus
+``reduce_scatter``, which it lacks) usable inside ``jax.jit``, with
+explicit token-chaining *and* implicit ordering, and autodiff (JVP +
+transpose) through the communication — re-designed for TPU:
 
 - every primitive lowers to **native XLA collective HLO** (AllReduce,
   AllGather, AllToAll, CollectivePermute) scheduled over ICI/DCN — no libmpi,
@@ -37,10 +38,12 @@ from .ops import (  # noqa: F401
     alltoall,
     barrier,
     bcast,
+    clear_caches,
     create_token,
     gather,
     recv,
     reduce,
+    reduce_scatter,
     scan,
     scatter,
     send,
@@ -95,6 +98,7 @@ __all__ = [
     "gather",
     "recv",
     "reduce",
+    "reduce_scatter",
     "scan",
     "scatter",
     "send",
@@ -130,6 +134,7 @@ __all__ = [
     "run",
     "shift",
     "flush",
+    "clear_caches",
     "profile_ops",
     # resilience (docs/resilience.md)
     "set_watchdog_timeout",

@@ -3,6 +3,8 @@
 Ref parity: ``mpi4jax.experimental.notoken`` re-implements all 12 ops on
 JAX's *ordered effects* so XLA threads an implicit token and users never
 touch one (ref experimental/notoken/collective_ops/*.py; SURVEY.md §2.3).
+All 13 ops here (the reference's 12 plus ``reduce_scatter``, which it
+lacks) get the tokenless variant.
 
 In this framework the tokenless style is the *primary* design: the SPMD
 model compiles ONE program for all ranks, so cross-rank schedule divergence
@@ -77,6 +79,11 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
 
 def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None):
     res, _ = _ops.reduce(x, op, root, comm=comm)
+    return res
+
+
+def reduce_scatter(x, op: OpLike = SUM, *, comm: Optional[Comm] = None):
+    res, _ = _ops.reduce_scatter(x, op, comm=comm)
     return res
 
 

@@ -1,9 +1,10 @@
-"""The 12 communication primitives.
+"""The 13 communication primitives.
 
-TPU-native re-design of ref mpi4jax/_src/collective_ops/ — same op set, same
-shape/autodiff contracts (divergences documented per-module), but every op
-lowers to native XLA collective HLO over ICI/DCN instead of custom-calling
-into libmpi.
+TPU-native re-design of ref mpi4jax/_src/collective_ops/ — the reference's
+12 ops with the same shape/autodiff contracts (divergences documented
+per-module) plus ``reduce_scatter`` (MPI_Reduce_scatter_block, which the
+reference lacks), and every op lowers to native XLA collective HLO over
+ICI/DCN instead of custom-calling into libmpi.
 """
 
 from ._base import (  # noqa: F401
@@ -19,6 +20,7 @@ from ._base import (  # noqa: F401
     SUM,
     Op,
     OpLike,
+    clear_caches,
     varying,
 )
 from .allgather import allgather  # noqa: F401
@@ -29,6 +31,7 @@ from .bcast import bcast  # noqa: F401
 from .gather import gather  # noqa: F401
 from .recv import recv  # noqa: F401
 from .reduce import reduce  # noqa: F401
+from .reduce_scatter import reduce_scatter  # noqa: F401
 from .scan import scan  # noqa: F401
 from .scatter import scatter  # noqa: F401
 from .send import send  # noqa: F401

@@ -1,0 +1,408 @@
+"""Payload-aware collective algorithms: ring lowerings + the auto-selector.
+
+The butterfly lowerings in ``_base.py`` ship the FULL payload every round —
+O(size·log k) bytes per rank.  That is latency-optimal for small payloads
+(``ceil(log2 k)`` neighbor hops) but a bandwidth disaster for large ones:
+the well-known ring algorithms move only O(size) bytes per rank, the
+bandwidth-optimal bound ``benchmarks/micro.py`` normalizes against
+(``2·(n-1)/n·size`` for an allreduce).  This module provides:
+
+- ``apply_ring_allreduce`` — ring reduce-scatter + ring allgather, for all
+  10 ``Op``s and associative callables (ascending group-rank fold order is
+  preserved for non-commutative callables via a lo/hi accumulator pair —
+  see ``rs_update_pair``);
+- ``apply_ring_reduce_scatter`` — the reduce-scatter building block, also
+  the lowering of the public ``reduce_scatter`` op (ops/reduce_scatter.py);
+- ``apply_ring_allgather`` — the allgather building block;
+- ``apply_vdg_bcast`` — binomial-halving scatter + ring allgather broadcast
+  (van de Geijn), ~2·size bytes per rank vs the doubling broadcast's
+  size·log2(k);
+- ``resolve_algo`` / ``algo_cache_token`` — per-call butterfly-vs-ring
+  selection from STATIC payload bytes and group size, forced via
+  ``MPI4JAX_TPU_COLLECTIVE_ALGO={auto,butterfly,ring}`` and folded into the
+  compiled-program cache keys exactly like the resilience flags.
+
+Ring lowerings need a static uniform group size (the chunk count); unequal
+color-split groups keep the butterfly.  Chunks are padded to ``k·chunk``
+elements so payloads not divisible by ``k`` lower cleanly; padding lanes
+are discarded after the final reshape, so garbage combines never leak.
+
+**Callable caveat**: ``apply_ring_allreduce`` splits the flattened payload
+into chunks and applies the reduction per chunk, so a callable op must be
+ELEMENTWISE (the ``MPI_User_function`` contract).  Whole-array callables
+(e.g. ``jnp.matmul``) are only valid with the butterfly — ``auto`` never
+routes callables to the ring; only an explicit ``ring`` override does.
+``reduce_scatter`` has no such caveat: its chunks are the user's own
+blocks, so block-wise callables (including ``jnp.matmul``) work there.
+
+The index formulas and update rules below are polymorphic over Python ints
+and traced values, so ``tests/test_algos.py`` drives the SAME functions
+through a pure-Python lockstep simulator (symbolic string folds pin the
+exact combine order; numpy folds pin all 10 ops) without needing a
+multi-device mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import config
+
+# ``auto`` never picks the ring below this group size: with k < 4 the ring's
+# 2·(k-1) rounds don't beat the butterfly's 2·ceil(log2 k) and the byte
+# volumes are comparable.
+RING_MIN_GROUP = 4
+
+
+# ---------------------------------------------------------------------------
+# selection
+# ---------------------------------------------------------------------------
+
+
+def algo_cache_token() -> tuple:
+    """Hashable fingerprint of the algorithm-selection configuration —
+    folded into every compiled-program cache key that caches op lowerings
+    (mirrors ``resilience.runtime.cache_token``), so toggling
+    ``MPI4JAX_TPU_COLLECTIVE_ALGO`` retraces instead of silently serving
+    the old program."""
+    return (config.collective_algo(), config.ring_crossover_bytes())
+
+
+def static_group_size(comm):
+    """The comm's uniform static group size, or ``None`` when group sizes
+    differ (unequal color splits cannot ring: the chunk count is the group
+    size and one SPMD program cannot express per-rank chunk counts)."""
+    try:
+        return comm.Get_size()
+    except RuntimeError:
+        return None
+
+
+def resolve_algo(algo: str, payload_bytes: int, k: int, ring_ok: bool) -> str:
+    """Pick ``"butterfly"`` or ``"ring"`` for one call.
+
+    ``algo`` is the configured value (``config.collective_algo()``); forced
+    values win, except that a forced ring falls back to the butterfly where
+    the ring is not expressible (``ring_ok=False``: unequal groups, k <= 1,
+    or a callable op on the chunked-allreduce path).  ``auto`` picks the
+    ring for payloads at/above ``ring_crossover_bytes()`` on groups of at
+    least ``RING_MIN_GROUP``.
+    """
+    if not ring_ok or algo == "butterfly":
+        return "butterfly"
+    if algo == "ring":
+        return "ring"
+    if k >= RING_MIN_GROUP and payload_bytes >= config.ring_crossover_bytes():
+        return "ring"
+    return "butterfly"
+
+
+def algorithm_bytes_per_rank(algo: str, nbytes: int, k: int,
+                             preserve_order: bool = False) -> int:
+    """Algorithmic bytes one rank ships for an allreduce of ``nbytes``
+    (the docs/microbenchmarks.md byte-volume table; also pinned by
+    tests/test_algos.py against the simulated lowerings)."""
+    if k <= 1:
+        return 0
+    if algo == "butterfly":
+        rounds = (k - 1).bit_length()  # ceil(log2 k)
+        return 2 * rounds * nbytes  # fold + doubling broadcast, full payload
+    chunk = -(-nbytes // k)
+    pair = 2 if preserve_order else 1
+    # reduce-scatter ships the accumulator (pair or single chunk) k-1
+    # times; the allgather ships one chunk k-1 times
+    return (k - 1) * chunk * (pair + 1)
+
+
+# ---------------------------------------------------------------------------
+# static structure: chunk layout, ring routing, index formulas
+# (polymorphic over Python ints and traced values — shared with the
+# lockstep simulator in tests/test_algos.py)
+# ---------------------------------------------------------------------------
+
+
+def chunk_layout(n: int, k: int):
+    """(elements per chunk, padded element count ``k·chunk``) for an
+    ``n``-element payload split into ``k`` ring chunks."""
+    chunk = -(-n // k)
+    return chunk, chunk * k
+
+
+def ring_pairs(groups):
+    """Static ppermute pairs of the ring: every rank sends to its group
+    ring-successor, every round (only the circulating chunk indices
+    rotate).  Singleton groups need no edges."""
+    return [
+        (members[p], members[(p + 1) % len(members)])
+        for members in groups
+        if len(members) > 1
+        for p in range(len(members))
+    ]
+
+
+def rs_send_chunk(pos, r, k):
+    """Chunk index group-position ``pos`` sends in reduce-scatter round
+    ``r`` (chunk ``c``'s journey starts at position ``(c+1) % k`` and walks
+    the ring ascending, ending at position ``c`` after ``k-1`` hops)."""
+    return (pos - r - 1) % k
+
+
+def rs_recv_chunk(pos, r, k):
+    """Chunk index group-position ``pos`` receives in reduce-scatter round
+    ``r`` (= the predecessor's ``rs_send_chunk``)."""
+    return (pos - r - 2) % k
+
+
+def ag_recv_chunk(pos, r, k):
+    """Chunk index received in allgather round ``r`` at position ``pos``
+    (entering round ``r`` each position holds chunk ``(pos - r) % k``)."""
+    return (pos - r - 1) % k
+
+
+def rs_update_pair(where, fn, pos, c, k, lo_in, hi_in, mine):
+    """Order-preserving reduce-scatter accumulator update at the receiving
+    position ``pos`` for chunk ``c``.
+
+    Chunk ``c``'s ring journey visits positions ``c+1 … k-1`` then (after
+    wrapping past the ring seam) ``0 … c``.  Associativity alone cannot
+    repair a cyclically rotated fold, so the accumulator is a pair:
+    ``hi`` folds the pre-wrap segment ``x_{c+1} … x_{k-1}`` and ``lo`` the
+    post-wrap segment ``x_0 … x_c``, each in ascending group order; the
+    final value is ``lo ∘ hi`` (``rs_finish_pair``) — the exact ascending
+    fold the butterfly produces, commutativity never required.
+
+    ``where(cond, a, b)`` is supplied by the caller: ``jnp.where`` when
+    traced, a plain Python select in the simulator tests.  Both branches
+    are evaluated; discarded garbage (the ``lo`` placeholder before the
+    wrap) never reaches a kept lane.
+    """
+    pre = (pos > c) | (c == k - 1)  # chunk k-1's journey never wraps
+    lo = where(pre, lo_in, where(pos == 0, mine, fn(lo_in, mine)))
+    hi = where(pre, fn(hi_in, mine), hi_in)
+    return lo, hi
+
+
+def rs_finish_pair(where, fn, pos, k, lo, hi):
+    """Final order-preserving reduce-scatter value at position ``pos``
+    (which owns chunk ``pos``): ``lo ∘ hi``, except chunk ``k-1`` whose
+    journey never wrapped (``lo`` still holds its placeholder)."""
+    return where(pos == k - 1, hi, fn(lo, hi))
+
+
+def next_pow2(k: int) -> int:
+    return 1 << max(0, (k - 1).bit_length())
+
+
+def vdg_widths(K: int):
+    """Binomial-scatter half-widths for ``K = next_pow2(k)`` virtual
+    chunks: K/2, K/4, …, 1."""
+    w = K >> 1
+    out = []
+    while w >= 1:
+        out.append(w)
+        w >>= 1
+    return out
+
+
+def vdg_scatter_pairs(groups, root, w, K):
+    """Static ppermute pairs of one binomial-scatter round: every holder at
+    relative position ``r0`` (``r0 % 2w == 0``) sends virtual chunks
+    ``[r0+w, r0+2w)`` to relative position ``r0+w``; pairs whose receiver
+    falls outside the (uniform) group carry only padding chunks and are
+    dropped.  Relative positions are group positions rotated by ``root``
+    (the same convention as ``apply_doubling_bcast``)."""
+    pairs = []
+    for members in groups:
+        kk = len(members)
+        for r0 in range(0, K, 2 * w):
+            if r0 + w < kk:
+                pairs.append((members[(root + r0) % kk],
+                              members[(root + r0 + w) % kk]))
+    return pairs
+
+
+# ---------------------------------------------------------------------------
+# traced appliers
+# ---------------------------------------------------------------------------
+
+
+def apply_ring_reduce_scatter(blocks, op, comm, k: int):
+    """Ring reduce-scatter of ``blocks`` (shape ``(k, *s)``) over ``comm``:
+    group position ``p`` receives ``fold_j blocks_j[p]`` in ascending group
+    order (MPI_Reduce_scatter_block semantics), shape ``(*s,)``.
+
+    ``k-1`` ppermute rounds, each carrying one block (two for
+    order-preserving callables) — O(size·(k-1)/k) bytes per rank.  Enum
+    ``Op``s are commutative, so they circulate a single accumulator in the
+    ring's natural (cyclically rotated) fold order; callables get the
+    lo/hi pair that preserves the ascending fold (``rs_update_pair``).
+    """
+    from ._base import Op, _comm_groups, _permute_axis, combine_fn
+
+    if k == 1:
+        return blocks[0]
+    fn = combine_fn(op)
+    pos = comm.Get_rank()
+    axis = _permute_axis(comm)
+    pairs = ring_pairs(_comm_groups(comm))
+    preserve = not isinstance(op, Op)
+    start = jnp.take(blocks, (pos - 1) % k, axis=0)
+    if preserve:
+        lo, hi = start, start  # lo is a placeholder until the wrap entry
+        for r in range(k - 1):
+            c = rs_recv_chunk(pos, r, k)
+            mine = jnp.take(blocks, c, axis=0)
+            recvd = lax.ppermute(jnp.stack([lo, hi]), axis, pairs)
+            lo, hi = rs_update_pair(
+                jnp.where, fn, pos, c, k, recvd[0], recvd[1], mine
+            )
+        return rs_finish_pair(jnp.where, fn, pos, k, lo, hi)
+    acc = start
+    for r in range(k - 1):
+        c = rs_recv_chunk(pos, r, k)
+        mine = jnp.take(blocks, c, axis=0)
+        acc = fn(lax.ppermute(acc, axis, pairs), mine)
+    return acc
+
+
+def apply_ring_allgather(v, comm, k: int, pos):
+    """Ring allgather: position ``pos`` contributes ``v`` (shape ``(*s,)``)
+    as chunk ``pos``; every position receives ``(k, *s)`` in group order.
+    ``k-1`` ppermute rounds of one chunk each."""
+    from ._base import _comm_groups, _permute_axis
+
+    out = jnp.zeros((k,) + v.shape, v.dtype).at[pos].set(v)
+    if k == 1:
+        return out
+    axis = _permute_axis(comm)
+    pairs = ring_pairs(_comm_groups(comm))
+    cur = v
+    for r in range(k - 1):
+        cur = lax.ppermute(cur, axis, pairs)
+        out = out.at[ag_recv_chunk(pos, r, k)].set(cur)
+    return out
+
+
+def _pad_to(flat, total):
+    n = flat.shape[0]
+    if total == n:
+        return flat
+    return jnp.concatenate([flat, jnp.zeros((total - n,), flat.dtype)])
+
+
+def apply_ring_allreduce(x, op, comm, k=None):
+    """Bandwidth-optimal allreduce: ring reduce-scatter + ring allgather.
+
+    Moves ``~2·(k-1)/k·size`` bytes per rank (``3·(k-1)/k`` for
+    order-preserving callables) over ``2·(k-1)`` chunk-sized ppermute
+    rounds, vs the butterfly's ``2·ceil(log2 k)`` full-payload rounds —
+    the asymptotic win for gradient buckets and halo frames.  Same
+    contract as ``apply_butterfly_allreduce``: all 10 ``Op``s plus
+    associative callables folded in ascending group-rank order (callables
+    must be ELEMENTWISE here — the payload is chunked; see module
+    docstring).  Requires a uniform static group size.
+    """
+    from ._base import as_varying
+
+    if k is None:
+        k = comm.Get_size()
+    x = as_varying(x, comm.axes)
+    if k == 1:
+        return x
+    shape, n = x.shape, x.size
+    chunk, padded = chunk_layout(n, k)
+    blocks = _pad_to(x.reshape(-1), padded).reshape(k, chunk)
+    mine = apply_ring_reduce_scatter(blocks, op, comm, k)
+    full = apply_ring_allgather(mine, comm, k, comm.Get_rank())
+    return full.reshape(-1)[:n].reshape(shape)
+
+
+def apply_vdg_bcast(x, comm, root: int, k=None):
+    """Large-payload broadcast: binomial-halving scatter from ``root`` +
+    ring allgather (van de Geijn).
+
+    The scatter tree halves the in-flight payload every round (root ships
+    ``~size`` bytes total; ``ceil(log2 k)`` rounds), then the ring
+    allgather circulates one chunk per round (``k-1`` rounds,
+    ``(k-1)/k·size`` bytes per rank) — ~2·size bytes per rank end to end,
+    vs ``size·ceil(log2 k)`` for ``apply_doubling_bcast``.  The chunk
+    count is padded to the next power of two so any uniform group size
+    lowers cleanly; padding chunks ride the scatter slabs but are dropped
+    by the final reshape.  Requires a uniform static group size.
+    """
+    from ._base import _comm_groups, _permute_axis, as_varying
+
+    if k is None:
+        k = comm.Get_size()
+    groups = _comm_groups(comm)
+    kmin = min(len(g) for g in groups)
+    if not 0 <= root < kmin:
+        raise ValueError(
+            f"apply_vdg_bcast: root {root} out of range for the smallest "
+            f"group (size {kmin}); root must be a valid group position in "
+            "every group"
+        )
+    x = as_varying(x, comm.axes)
+    if k == 1:
+        return x
+    pos = comm.Get_rank()
+    relpos = (pos - root) % k
+    axis = _permute_axis(comm)
+    shape, n = x.shape, x.size
+    chunk, _ = chunk_layout(n, k)
+    K = next_pow2(k)
+    buf = _pad_to(x.reshape(-1), K * chunk).reshape(K, chunk)
+    for w in vdg_widths(K):
+        pairs = vdg_scatter_pairs(groups, root, w, K)
+        if not pairs:
+            continue
+        # senders (relpos % 2w == 0) hold virtual chunks [relpos, relpos+2w)
+        # and ship the far half; the receiver at relpos+w writes it at its
+        # OWN relpos.  Non-participants' slices are clamped garbage that no
+        # pair routes and the where() discards.
+        slab = lax.dynamic_slice_in_dim(buf, relpos + w, w, axis=0)
+        recvd = lax.ppermute(slab, axis, pairs)
+        is_recv = (relpos % (2 * w)) == w
+        buf = jnp.where(
+            is_recv, lax.dynamic_update_slice_in_dim(buf, recvd, relpos, axis=0),
+            buf,
+        )
+    mine = jnp.take(buf, relpos, axis=0)  # this rank's real chunk (relpos < k)
+    full = apply_ring_allgather(mine, comm, k, relpos)
+    return full.reshape(-1)[:n].reshape(shape)
+
+
+def apply_reduce_scatter(xl, op, comm):
+    """Lowering of the public ``reduce_scatter`` op: ``(k, *s)`` blocks in,
+    ``(*s,)`` out — group position ``p`` receives the ascending-group-order
+    fold of every member's block ``p``.
+
+    Native path: one ``psum_scatter`` HLO for SUM on a whole single-axis
+    comm under ``auto``.  Otherwise butterfly (allreduce the block stack,
+    keep own block — O(size·log k) bytes) vs ring (O(size·(k-1)/k) bytes)
+    by the selector.  Blocks are the user's own, so block-wise callables
+    (including whole-block ops like ``jnp.matmul``, which batch over the
+    leading axis on the butterfly path) are valid on BOTH algorithms —
+    the chunked-allreduce elementwise caveat does not apply here.
+    """
+    from ._base import Op, apply_butterfly_allreduce, as_varying
+
+    k = comm.Get_size()  # static; raises the clear error on unequal splits
+    xl = as_varying(xl, comm.axes)
+    if k == 1:
+        return xl[0]
+    algo = config.collective_algo()
+    if (algo == "auto" and op is Op.SUM and comm.groups is None
+            and len(comm.axes) == 1):
+        try:
+            return lax.psum_scatter(
+                xl, comm.axes[0], scatter_dimension=0, tiled=False
+            )
+        except NotImplementedError:  # shard_map/backend gap: fall through
+            pass
+    algo = resolve_algo(algo, xl.size * xl.dtype.itemsize, k, ring_ok=True)
+    if algo == "ring":
+        return apply_ring_reduce_scatter(xl, op, comm, k)
+    full = apply_butterfly_allreduce(xl, op, comm)
+    return jnp.take(full, comm.Get_rank(), axis=0)

@@ -115,14 +115,13 @@ def _comm_groups(comm: Comm):
 
 def _comm_pos_size(comm: Comm):
     """(group position, group size) of the calling rank — a traced pair on
-    a color split (static table lookups), (traced, static int) otherwise."""
+    a color split (static table lookups, cached on the ``GroupComm`` at
+    construction instead of rebuilt per collective trace), (traced, static
+    int) otherwise."""
     if comm.groups is None:
         return comm.Get_rank(), comm.Get_size()
-    ksize = [0] * sum(len(g) for g in comm.groups)
-    for members in comm.groups:
-        for r in members:
-            ksize[r] = len(members)
-    return comm.Get_rank(), jnp.asarray(ksize)[comm.global_rank()]
+    table = comm.group_size_table()
+    return comm.Get_rank(), jnp.asarray(table)[comm.global_rank()]
 
 
 def _permute_axis(comm: Comm):
@@ -183,13 +182,52 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
     Whole-axes comm, SUM/MIN/MAX: one native AllReduce HLO.  Every other
     case — PROD/logical/bitwise/callable ops, and ALL ops on a color-split
     comm (``axis_index_groups`` is unavailable under shard_map, see
-    ``Comm.Split``) — lowers to a log-depth doubling butterfly over
-    CollectivePermute: ``ceil(log2 k)`` suffix-fold rounds + a log-depth
-    broadcast, O(log k) depth and per-rank bandwidth (the round-3/4
-    lowering was AllGather + an O(world)-unrolled fold — O(world)
-    bandwidth AND an O(world) serial dependency chain per call, which
-    falls over at pod scale; see tests/test_scale.py's 64-device
-    budget).
+    ``Comm.Split``) — picks per call between two CollectivePermute
+    lowerings (``_algos.resolve_algo``, forced via
+    ``MPI4JAX_TPU_COLLECTIVE_ALGO``):
+
+    - the log-depth doubling **butterfly** (``apply_butterfly_allreduce``):
+      ``2·ceil(log2 k)`` rounds shipping the FULL payload —
+      latency-optimal, O(size·log k) bytes per rank;
+    - the **ring** (``_algos.apply_ring_allreduce``): ``2·(k-1)`` rounds
+      shipping one CHUNK (``size/k``) — bandwidth-optimal,
+      ~``2·(k-1)/k·size`` bytes per rank, the win for large payloads
+      (gradient buckets, halo frames).
+
+    Both preserve the deterministic ascending group-rank fold for
+    associative non-commutative callables; the ring additionally requires
+    an elementwise callable and a uniform static group size (see
+    ``_algos`` module docstring), so ``auto`` only routes enum ``Op``s on
+    uniform groups to it.
+    """
+    from . import _algos
+    from ..utils.config import collective_algo
+
+    axes = comm.axes
+    x = as_varying(x, axes)
+    algo = collective_algo()
+    if (algo == "auto" and comm.groups is None and isinstance(op, Op)
+            and op in _NATIVE_COLLECTIVE):
+        return _NATIVE_COLLECTIVE[op](x, axes)
+    k = _algos.static_group_size(comm)
+    ring_ok = k is not None and k > 1 and (
+        isinstance(op, Op) or algo == "ring"  # auto never chunks callables
+    )
+    algo = _algos.resolve_algo(algo, x.size * x.dtype.itemsize,
+                               k or 1, ring_ok)
+    if algo == "ring":
+        return _algos.apply_ring_allreduce(x, op, comm, k)
+    return apply_butterfly_allreduce(x, op, comm)
+
+
+def apply_butterfly_allreduce(x, op: OpLike, comm: Comm):
+    """Log-depth doubling-butterfly allreduce: ``ceil(log2 k)`` suffix-fold
+    rounds + a log-depth broadcast over CollectivePermute, O(log k) depth
+    and O(size·log k) per-rank bytes (the round-3/4 lowering was AllGather
+    + an O(world)-unrolled fold — O(world) bandwidth AND an O(world)
+    serial dependency chain per call, which falls over at pod scale; see
+    tests/test_scale.py's 64-device budget).  Works on ANY partition,
+    unequal color-split groups included.
 
     The suffix fold combines in ascending group-rank order with plain
     associativity — no commutativity or identity element required, so
@@ -197,11 +235,7 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
     same-result-everywhere contract (every rank receives group-position
     0's fold via the broadcast).
     """
-    axes = comm.axes
-    x = as_varying(x, axes)
-    if comm.groups is None and isinstance(op, Op) and op in _NATIVE_COLLECTIVE:
-        return _NATIVE_COLLECTIVE[op](x, axes)
-
+    x = as_varying(x, comm.axes)
     fn = combine_fn(op)
     groups = _comm_groups(comm)
     kmax = max(len(g) for g in groups)
@@ -267,6 +301,20 @@ def _mpi_opname(opname: str) -> str:
     return "MPI_" + opname.capitalize()
 
 
+# Call ids pair begin/end hooks and watchdog arm/disarm across one dispatch.
+# A module-level monotonic counter (hoisted out of ``_run_body``, which runs
+# on EVERY traced collective when tracing or resilience is on) — unique per
+# process, which is all the FIFO-aliasing registries require; 8 hex chars to
+# match the historical ``secrets.token_hex(4)`` format in log lines.
+import itertools
+
+_call_id_counter = itertools.count()
+
+
+def _next_call_id() -> str:
+    return f"{next(_call_id_counter) & 0xFFFFFFFF:08x}"
+
+
 def _run_body(opname: str, comm: Comm, body, arrays, token):
     """Run an op body, bracketed by the instrumentation every op shares:
 
@@ -290,9 +338,8 @@ def _run_body(opname: str, comm: Comm, body, arrays, token):
     tracing = get_runtime_tracing() and native.runtime_tracing_supported()
     if plan is None and not tracing:
         return body(comm, arrays, token)
-    import secrets
 
-    call_id = secrets.token_hex(4)
+    call_id = _next_call_id()
     rank = comm.Get_rank()
     name = _mpi_opname(opname)
     if plan is not None:
@@ -325,6 +372,21 @@ from collections import OrderedDict
 
 _eager_cache: "OrderedDict" = OrderedDict()
 _EAGER_CACHE_MAX = 128
+
+
+def clear_caches() -> None:
+    """Drain the eager one-op compiled-program cache.
+
+    Each entry pins a compiled executable plus its mesh; call this after
+    retiring a mesh, or when flipping a trace-shaping environment variable
+    mid-process by hand (the knobs this library reads —
+    ``MPI4JAX_TPU_COLLECTIVE_ALGO``, the resilience flags, tracing/logging
+    — are already folded into the cache key, so toggling them retraces
+    without an explicit clear).  ``spmd``-decorated functions hold their
+    own per-function program caches keyed the same way; they are dropped
+    with the function object.
+    """
+    _eager_cache.clear()
 
 
 def group_select_gather(comm: Comm, xl):
@@ -405,12 +467,13 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
         from ..utils.config import prefer_notoken
 
         from ..resilience.runtime import cache_token as resilience_token
+        from ._algos import algo_cache_token
 
         # every dynamically-read flag that shapes the trace must be in the
         # key, or toggling it would silently keep serving the old program
         cache_key = (opname, comm.mesh, comm.uid, static_key,
                      get_runtime_tracing(), get_logging(), prefer_notoken(),
-                     resilience_token())
+                     resilience_token(), algo_cache_token())
         cached = _eager_cache.get(cache_key)
         if cached is not None:
             _eager_cache.move_to_end(cache_key)

@@ -4,11 +4,24 @@ TPU-native re-design of ref mpi4jax/_src/collective_ops/bcast.py.  Contract
 preserved: every rank receives root's value with the input's shape; the root
 gets its own input back (ref bcast.py:76-81).
 
-Lowering: masked AllReduce — ``psum(where(rank == root, x, 0))`` — one
-O(n)-bandwidth collective on ICI (vs an AllGather-based broadcast which would
-move ``size × n``).  ``where`` (not multiply-by-mask) so non-root NaN/Inf
-payloads cannot poison the result.  Differentiable: the transpose of the
-masked psum correctly routes cotangents back to the root.
+Lowering, picked per call by the payload-aware selector
+(``MPI4JAX_TPU_COLLECTIVE_ALGO``, ops/_algos.py):
+
+- whole-axes comm under ``auto``: masked AllReduce —
+  ``psum(where(rank == root, x, 0))`` — one O(n)-bandwidth native
+  collective on ICI.  ``where`` (not multiply-by-mask) so non-root
+  NaN/Inf payloads cannot poison the result.  Differentiable: the
+  transpose of the masked psum correctly routes cotangents back to root.
+- color splits and forced algorithms, small payloads (**butterfly**):
+  log-depth doubling broadcast over CollectivePermute
+  (``apply_doubling_bcast``) — ``ceil(log2 k)`` full-payload rounds,
+  latency-optimal, works on ANY partition (unequal groups included).
+- color splits and forced algorithms, large payloads (**ring**):
+  binomial-halving scatter + ring allgather
+  (``_algos.apply_vdg_bcast``, van de Geijn) — ~2·size bytes per rank vs
+  the doubling broadcast's size·ceil(log2 k), the bandwidth-optimal form
+  for large frames.  Needs a uniform static group size; unequal splits
+  keep the butterfly.
 """
 
 from typing import Optional
@@ -33,6 +46,9 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
     """
 
     def body(comm, arrays, token):
+        from . import _algos
+        from ..utils.config import collective_algo
+
         (xl,) = arrays
         size = comm.min_size()  # on a color split, root must fit EVERY group
         if not 0 <= root < size:
@@ -40,18 +56,32 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
         xl = consume(token, xl)
         rank = comm.Get_rank()
         log_op("MPI_Bcast", rank, f"{xl.size} items from root {root}")
-        if comm.groups is not None:
-            # color split: log-depth doubling broadcast from each group's
-            # root over ppermute rounds — O(log k) per-rank bandwidth, any
-            # partition, no cross-group mixing (the r4 lowering was a full
-            # AllGather + per-group take: O(world) bandwidth per call)
-            res = apply_doubling_bcast(xl, comm, root)
-        elif jnp.issubdtype(xl.dtype, jnp.bool_):
-            masked = jnp.where(rank == root, xl.astype(jnp.uint8), 0)
-            res = lax.psum(masked, comm.axes).astype(jnp.bool_)
+        algo = collective_algo()
+        if comm.groups is None and algo == "auto":
+            # whole-axes fast path: one native AllReduce HLO
+            if jnp.issubdtype(xl.dtype, jnp.bool_):
+                masked = jnp.where(rank == root, xl.astype(jnp.uint8), 0)
+                res = lax.psum(masked, comm.axes).astype(jnp.bool_)
+            else:
+                masked = jnp.where(rank == root, xl, jnp.zeros_like(xl))
+                res = lax.psum(masked, comm.axes)
         else:
-            masked = jnp.where(rank == root, xl, jnp.zeros_like(xl))
-            res = lax.psum(masked, comm.axes)
+            # color splits (XLA's axis_index_groups is unavailable under
+            # shard_map — see Comm.Split) and forced algorithms: doubling
+            # (butterfly) vs van de Geijn (ring) by static payload bytes.
+            # The vdg scatter needs a uniform static group size; unequal
+            # partitions keep the doubling broadcast, which works on any
+            # partition (the r4 lowering was a full AllGather + per-group
+            # take: O(world) bandwidth per call).
+            k = _algos.static_group_size(comm)
+            picked = _algos.resolve_algo(
+                algo, xl.size * xl.dtype.itemsize, k or 1,
+                ring_ok=k is not None and k > 1,
+            )
+            if picked == "ring":
+                res = _algos.apply_vdg_bcast(xl, comm, root, k)
+            else:
+                res = apply_doubling_bcast(xl, comm, root)
         return res, produce(token, res)
 
     return dispatch("bcast", comm, body, (x,), token, static_key=(root,))

@@ -5,8 +5,13 @@ preserved exactly (possible here, unlike gather, because shapes match): root
 receives the reduction, every other rank gets its own input back
 (ref reduce.py:77-80, abstract :240-252).
 
-Lowering: AllReduce + per-rank select on the (traced) rank index.  The select
+Lowering: allreduce + per-rank select on the (traced) rank index.  The select
 is free (fused); XLA's AllReduce is no slower than a rooted Reduce on ICI.
+The allreduce itself goes through the payload-aware algorithm layer
+(``apply_allreduce`` -> ops/_algos.py): native HLO where available, else
+butterfly vs bandwidth-optimal ring by static payload bytes, forced via
+``MPI4JAX_TPU_COLLECTIVE_ALGO`` — so large-payload rooted reductions get the
+ring's O(size) byte volume automatically.
 """
 
 from typing import Optional

@@ -300,14 +300,20 @@ class GroupComm(Comm):
                 "executes the SPMD program, so every rank needs a group)"
             )
         self._groups = tuple(tuple(int(r) for r in g) for g in groups)
-        # global rank -> (group id, local rank), as static tables
+        # global rank -> (group id, local rank, group size), as static
+        # tables built ONCE here (collective lowerings look them up on
+        # every trace — rebuilding the dense size table per collective was
+        # an O(world) python loop per trace of a split comm)
         n = len(seen)
         self._gid = [0] * n
         self._lrank = [0] * n
+        self._ksize = [0] * n
         for g, members in enumerate(self._groups):
             for i, r in enumerate(members):
                 self._gid[r] = g
                 self._lrank[r] = i
+                self._ksize[r] = len(members)
+        self._ksize = tuple(self._ksize)
 
     @property
     def groups(self):
@@ -320,9 +326,9 @@ class GroupComm(Comm):
                 f"Get_size on a color-split comm with unequal group sizes "
                 f"{sorted(len(g) for g in self._groups)} has no single "
                 "static value. Only the gather family (allgather/"
-                "alltoall/gather/scatter) needs uniform groups — its "
-                "output shapes depend on the group size; every other op "
-                "works on unequal groups."
+                "alltoall/gather/scatter) and reduce_scatter need uniform "
+                "groups — their shapes/blocking depend on the group size; "
+                "every other op works on unequal groups."
             )
         return sizes.pop()
 
@@ -337,6 +343,13 @@ class GroupComm(Comm):
 
     def min_size(self) -> int:
         return min(len(g) for g in self._groups)
+
+    def group_size_table(self):
+        """Static per-GLOBAL-rank group-size tuple (``table[r]`` = size of
+        the group containing rank ``r``), cached at construction — the
+        table the butterfly lowerings index with the traced global rank
+        (``ops/_base._comm_pos_size``)."""
+        return self._ksize
 
     def local_rank_of(self, r: int) -> int:
         return self._lrank[r]
@@ -367,6 +380,7 @@ class GroupComm(Comm):
         clone._groups = self._groups
         clone._gid = self._gid
         clone._lrank = self._lrank
+        clone._ksize = self._ksize
         return clone
 
     Dup = Clone

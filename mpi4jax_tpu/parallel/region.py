@@ -186,13 +186,14 @@ def spmd(
             # the key (mirrors _eager_cache in ops/_base.py), or toggling
             # tracing/logging/prefer_notoken after the first call would
             # silently keep serving the stale compiled program
+            from ..ops._algos import algo_cache_token
             from ..resilience.runtime import cache_token as resilience_token
             from ..utils.config import prefer_notoken
             from ..utils.debug import get_logging, get_runtime_tracing
 
             key = (c.mesh, c.uid, statics, static_vals, kw_names, n_dyn,
                    get_runtime_tracing(), get_logging(), prefer_notoken(),
-                   resilience_token())
+                   resilience_token(), algo_cache_token())
             sm = program_cache.get(key)
             if sm is None:
                 axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])

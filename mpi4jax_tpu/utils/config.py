@@ -25,6 +25,13 @@ Recognized variables:
   when a collective's inputs or outputs contain NaN/Inf, naming the op.
   Off by default; when off, the lowered HLO is byte-identical to a build
   without the guards (resilience/numerics.py).
+- ``MPI4JAX_TPU_COLLECTIVE_ALGO`` — ``auto`` (default) / ``butterfly`` /
+  ``ring``: the reduction-family algorithm (ops/_algos.py).  ``auto`` picks
+  per call from static payload bytes and group size; the explicit values
+  force one lowering (benchmarks, equivalence tests, escape hatch).
+- ``MPI4JAX_TPU_RING_CROSSOVER_BYTES`` — payload size (bytes) at which
+  ``auto`` switches from the log-depth butterfly to the bandwidth-optimal
+  ring lowerings.  Default 1 MiB.
 """
 
 import math
@@ -112,6 +119,55 @@ def check_numerics() -> bool:
     """Whether collectives guard their inputs/outputs against NaN/Inf
     (``MPI4JAX_TPU_CHECK_NUMERICS``; see mpi4jax_tpu/resilience/numerics.py)."""
     return parse_env_bool("MPI4JAX_TPU_CHECK_NUMERICS", False)
+
+
+COLLECTIVE_ALGOS = ("auto", "butterfly", "ring")
+
+# default ring/butterfly crossover: 1 MiB — below it the butterfly's
+# ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
+# the ring's O(size) vs O(size·log k) byte volume dominates.  Measured per
+# platform by ``benchmarks/micro.py --save`` (docs/microbenchmarks.md).
+DEFAULT_RING_CROSSOVER_BYTES = 1 << 20
+
+
+def collective_algo() -> str:
+    """Reduction-family algorithm selection (``MPI4JAX_TPU_COLLECTIVE_ALGO``).
+
+    ``auto`` (default): pick butterfly vs ring per call from static payload
+    bytes and group size (ops/_algos.py).  ``butterfly`` / ``ring`` force
+    one lowering everywhere it is expressible.
+    """
+    raw = os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO")
+    if raw is None or not raw.strip():
+        return "auto"
+    val = raw.lower().strip()
+    if val not in COLLECTIVE_ALGOS:
+        raise ValueError(
+            f"Environment variable MPI4JAX_TPU_COLLECTIVE_ALGO={raw!r} must "
+            f"be one of {COLLECTIVE_ALGOS}"
+        )
+    return val
+
+
+def ring_crossover_bytes() -> int:
+    """Payload bytes at which ``auto`` prefers the ring lowerings
+    (``MPI4JAX_TPU_RING_CROSSOVER_BYTES``; default 1 MiB)."""
+    raw = os.environ.get("MPI4JAX_TPU_RING_CROSSOVER_BYTES")
+    if raw is None or not raw.strip():
+        return DEFAULT_RING_CROSSOVER_BYTES
+    try:
+        val = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"Environment variable MPI4JAX_TPU_RING_CROSSOVER_BYTES={raw!r} "
+            "could not be parsed as an integer number of bytes"
+        ) from e
+    if val < 0:
+        raise ValueError(
+            f"Environment variable MPI4JAX_TPU_RING_CROSSOVER_BYTES={raw!r} "
+            "must be >= 0"
+        )
+    return val
 
 
 def prefer_notoken() -> bool:

@@ -1,0 +1,376 @@
+"""Payload-aware collective algorithms (ops/_algos.py): simulator + selector.
+
+The ring and van-de-Geijn lowerings keep ALL of their static structure —
+chunk layout, ppermute pair construction, per-round chunk index formulas,
+and the order-preserving accumulator update rules — in plain functions
+that are polymorphic over Python ints and traced values.  This file drives
+those SAME functions through a pure-Python lockstep simulator:
+
+- symbolic string folds pin the EXACT combine order (ascending group
+  rank, the deterministic non-commutative contract ``apply_allreduce``
+  documents) — any mis-routed round or mis-ordered combine changes the
+  string;
+- numpy folds pin the semantics of all 10 ``Op``s through the ring
+  reduce-scatter;
+- a chunk-level vdg simulation pins the binomial-scatter pair
+  construction (clamped slices, dropped padding subtrees) for every
+  (group size, root), power of two or not.
+
+The module is loaded under a private package name (``_load_isolated``,
+mirroring tests/test_resilience.py) so these tests run even where the
+installed JAX is below the package's hard floor and ``import
+mpi4jax_tpu`` refuses; the traced integration half lives in
+tests/test_allreduce.py / test_reduce_scatter.py / test_split.py.
+"""
+
+import importlib
+import os
+import pathlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_algos_iso"
+
+
+def _load_isolated():
+    """Load ops/_algos.py + utils/config.py under a private package name,
+    bypassing ``mpi4jax_tpu/__init__.py`` (whose JAX-floor check refuses
+    to import on old JAX) while preserving package context for the
+    relative imports."""
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "ops"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "ops._algos"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+al = ISO.ops._algos
+config = ISO.utils.config
+
+
+@pytest.fixture(autouse=True)
+def _clean_algo_env():
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in ("MPI4JAX_TPU_COLLECTIVE_ALGO",
+                  "MPI4JAX_TPU_RING_CROSSOVER_BYTES")
+    }
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _where(cond, a, b):
+    """The simulator's ``where``: a plain Python select (the traced
+    appliers pass ``jnp.where`` into the same update rules)."""
+    return a if cond else b
+
+
+def _recv_map(k):
+    """position -> predecessor, derived from the REAL ring pair table."""
+    pairs = al.ring_pairs([tuple(range(k))])
+    recv_from = {dst: src for src, dst in pairs}
+    assert len(recv_from) == k  # every position receives exactly once
+    return recv_from
+
+
+def sim_ring_reduce_scatter(blocks, fn, k, preserve):
+    """Pure-Python lockstep of ``apply_ring_reduce_scatter``: ``blocks[p][c]``
+    is position ``p``'s block addressed to position ``c``; returns
+    ``final[p]`` — the reduction position ``p`` ends up owning."""
+    recv_from = _recv_map(k)
+    if preserve:
+        lo = [blocks[p][(p - 1) % k] for p in range(k)]
+        hi = list(lo)
+        for r in range(k - 1):
+            rlo = [lo[recv_from[p]] for p in range(k)]
+            rhi = [hi[recv_from[p]] for p in range(k)]
+            nxt = [
+                al.rs_update_pair(_where, fn, p, al.rs_recv_chunk(p, r, k),
+                                  k, rlo[p], rhi[p],
+                                  blocks[p][al.rs_recv_chunk(p, r, k)])
+                for p in range(k)
+            ]
+            lo = [t[0] for t in nxt]
+            hi = [t[1] for t in nxt]
+        return [al.rs_finish_pair(_where, fn, p, k, lo[p], hi[p])
+                for p in range(k)]
+    acc = [blocks[p][(p - 1) % k] for p in range(k)]
+    for r in range(k - 1):
+        recvd = [acc[recv_from[p]] for p in range(k)]
+        acc = [fn(recvd[p], blocks[p][al.rs_recv_chunk(p, r, k)])
+               for p in range(k)]
+    return acc
+
+
+def sim_ring_allgather(vals, rel, k):
+    """Lockstep of ``apply_ring_allgather``: position ``p`` contributes
+    ``vals[p]`` as chunk ``rel[p]``; returns ``out[p][c]``."""
+    recv_from = _recv_map(k)
+    out = [[None] * k for _ in range(k)]
+    cur = list(vals)
+    for p in range(k):
+        out[p][rel[p]] = vals[p]
+    for r in range(k - 1):
+        cur = [cur[recv_from[p]] for p in range(k)]
+        for p in range(k):
+            out[p][al.ag_recv_chunk(rel[p], r, k)] = cur[p]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static structure
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,k", [(8, 4), (7, 4), (1, 8), (9, 8), (16, 1)])
+def test_chunk_layout(n, k):
+    chunk, padded = al.chunk_layout(n, k)
+    assert padded == chunk * k
+    assert padded >= n                      # payload always fits
+    assert (chunk - 1) * k < n              # and the chunk is minimal
+
+
+@pytest.mark.parametrize("k", [2, 3, 4, 7, 8])
+def test_ring_pair_and_chunk_index_consistency(k):
+    # the chunk a position receives is exactly what its ring predecessor
+    # sends, every round; and after k-1 rounds each position's final
+    # accumulator is its OWN chunk (reduce-scatter termination)
+    for r in range(k - 1):
+        for p in range(k):
+            assert al.rs_recv_chunk(p, r, k) == al.rs_send_chunk((p - 1) % k, r, k)
+    for p in range(k):
+        assert al.rs_recv_chunk(p, k - 2, k) == p
+
+
+def test_ring_pairs_skip_singletons():
+    pairs = al.ring_pairs([(3,), (1, 5, 6)])
+    assert pairs == [(1, 5), (5, 6), (6, 1)]
+
+
+def test_next_pow2_and_vdg_widths():
+    assert [al.next_pow2(k) for k in (1, 2, 3, 4, 5, 8, 9)] == \
+        [1, 2, 4, 4, 8, 8, 16]
+    assert al.vdg_widths(8) == [4, 2, 1]
+    assert al.vdg_widths(1) == []
+
+
+# ---------------------------------------------------------------------------
+# ring reduce-scatter: exact combine order + all 10 op semantics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [2, 3, 4, 5, 8])
+def test_ring_rs_preserves_ascending_fold_order(k):
+    # string concatenation is associative and non-commutative with a fully
+    # observable result: chunk c's fold must read (0:c)(1:c)...(k-1:c) —
+    # the ascending group-rank order, exactly what apply_allreduce's
+    # contract for associative non-commutative callables promises
+    blocks = [[f"({p}:{c})" for c in range(k)] for p in range(k)]
+    out = sim_ring_reduce_scatter(blocks, lambda a, b: a + b, k,
+                                  preserve=True)
+    for p in range(k):
+        assert out[p] == "".join(f"({j}:{p})" for j in range(k))
+
+
+@pytest.mark.parametrize("opname,npfn", [
+    ("SUM", np.add), ("PROD", np.multiply), ("MIN", np.minimum),
+    ("MAX", np.maximum), ("LAND", np.logical_and), ("LOR", np.logical_or),
+    ("LXOR", np.logical_xor), ("BAND", np.bitwise_and),
+    ("BOR", np.bitwise_or), ("BXOR", np.bitwise_xor),
+])
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_ring_rs_all_ops(opname, npfn, k):
+    rng = np.random.default_rng(hash((opname, k)) % 2**32)
+    if opname in ("LAND", "LOR", "LXOR"):
+        blocks = rng.integers(0, 2, size=(k, k, 3)).astype(bool)
+    elif opname in ("BAND", "BOR", "BXOR"):
+        blocks = rng.integers(0, 255, size=(k, k, 3)).astype(np.int32)
+    else:
+        blocks = rng.normal(size=(k, k, 3)).astype(np.float64)
+    out = sim_ring_reduce_scatter(
+        [[blocks[p, c] for c in range(k)] for p in range(k)],
+        npfn, k, preserve=False)
+    for p in range(k):
+        expected = blocks[0, p]
+        for j in range(1, k):
+            expected = npfn(expected, blocks[j, p])
+        np.testing.assert_allclose(np.asarray(out[p], dtype=np.float64),
+                                   np.asarray(expected, dtype=np.float64),
+                                   rtol=1e-12)
+
+
+@pytest.mark.parametrize("k", [2, 3, 4, 8])
+def test_ring_allgather_completeness(k):
+    out = sim_ring_allgather([f"v{p}" for p in range(k)], list(range(k)), k)
+    for p in range(k):
+        assert out[p] == [f"v{c}" for c in range(k)]
+
+
+# ---------------------------------------------------------------------------
+# van de Geijn bcast: binomial scatter pair construction
+# ---------------------------------------------------------------------------
+
+
+def sim_vdg_bcast(k, root):
+    """Chunk-level lockstep of ``apply_vdg_bcast`` over one uniform group:
+    returns ``full[p]`` — the k real chunks position ``p`` reassembles."""
+    K = al.next_pow2(k)
+    groups = [tuple(range(k))]
+    rel = [(p - root) % k for p in range(k)]
+    # root holds the real payload ("R", c); everyone else garbage
+    buf = [[("R", c) if p == root else ("G", p, c) for c in range(K)]
+           for p in range(k)]
+    for w in al.vdg_widths(K):
+        pairs = al.vdg_scatter_pairs(groups, root, w, K)
+        assert len(set(d for _, d in pairs)) == len(pairs)  # one sender each
+
+        def slab(p):
+            start = min(max(rel[p] + w, 0), K - w)  # dynamic_slice clamping
+            return buf[p][start:start + w]
+
+        recvd = {d: slab(s) for s, d in pairs}
+        for p in range(k):
+            if rel[p] % (2 * w) == w:
+                # every real receiver position must have a sender pair —
+                # a dropped pair here would leave it holding garbage
+                assert p in recvd, (k, root, w, p)
+                start = min(max(rel[p], 0), K - w)
+                for i, v in enumerate(recvd[p]):
+                    buf[p][start + i] = v
+    mine = [buf[p][rel[p]] for p in range(k)]
+    return sim_ring_allgather(mine, rel, k)
+
+
+@pytest.mark.parametrize("k", [2, 3, 4, 5, 6, 7, 8, 9])
+def test_vdg_bcast_delivers_root_payload(k):
+    for root in range(k):
+        full = sim_vdg_bcast(k, root)
+        for p in range(k):
+            assert full[p] == [("R", c) for c in range(k)], (k, root, p)
+
+
+def test_vdg_scatter_pairs_drop_padding_subtrees():
+    # k=5 -> K=8: receivers at relative positions >= 5 don't exist; their
+    # whole subtrees carry only padding chunks and must be dropped
+    groups = [tuple(range(5))]
+    for w in al.vdg_widths(8):
+        for _, dst in al.vdg_scatter_pairs(groups, 0, w, 8):
+            assert dst < 5
+
+
+# ---------------------------------------------------------------------------
+# selector + byte-volume model
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_algo_forced_and_fallback():
+    big = config.DEFAULT_RING_CROSSOVER_BYTES * 4
+    assert al.resolve_algo("butterfly", big, 8, True) == "butterfly"
+    assert al.resolve_algo("ring", 1, 8, True) == "ring"
+    # a forced ring falls back where the ring is not expressible
+    assert al.resolve_algo("ring", big, 8, False) == "butterfly"
+
+
+def test_resolve_algo_auto_crossover():
+    cross = config.ring_crossover_bytes()
+    assert al.resolve_algo("auto", cross - 1, 8, True) == "butterfly"
+    assert al.resolve_algo("auto", cross, 8, True) == "ring"
+    # tiny groups never ring under auto: 2·(k-1) rounds don't beat
+    # 2·ceil(log2 k) and the byte volumes are comparable
+    for k in range(2, al.RING_MIN_GROUP):
+        assert al.resolve_algo("auto", cross * 64, k, True) == "butterfly"
+
+
+def test_resolve_algo_env_crossover_override():
+    os.environ["MPI4JAX_TPU_RING_CROSSOVER_BYTES"] = "256"
+    assert al.resolve_algo("auto", 256, 8, True) == "ring"
+    assert al.resolve_algo("auto", 255, 8, True) == "butterfly"
+
+
+def test_algorithm_bytes_per_rank():
+    # butterfly ships the full payload 2·ceil(log2 k) times; the ring ships
+    # chunk-sized messages: (k-1)·chunk·2 (accumulator + allgather), one
+    # more chunk stream for the order-preserving lo/hi pair
+    assert al.algorithm_bytes_per_rank("butterfly", 1024, 8) == 2 * 3 * 1024
+    assert al.algorithm_bytes_per_rank("ring", 1024, 8) == 7 * 128 * 2
+    assert al.algorithm_bytes_per_rank("ring", 1024, 8, True) == 7 * 128 * 3
+    assert al.algorithm_bytes_per_rank("ring", 1024, 1) == 0
+    # the asymptotic claim of the whole layer: above k=4 the ring moves
+    # strictly fewer bytes, and the gap grows with log k
+    for k in (4, 8, 64, 1024):
+        ring = al.algorithm_bytes_per_rank("ring", 1 << 20, k)
+        fly = al.algorithm_bytes_per_rank("butterfly", 1 << 20, k)
+        assert ring < fly
+        assert ring <= 2 * (1 << 20)  # bandwidth-optimal bound 2·(k-1)/k·size
+
+
+def test_ring_byte_count_matches_simulated_rounds():
+    # count the messages the lockstep simulator actually ships: k-1
+    # reduce-scatter rounds (pair-sized when order-preserving) + k-1
+    # allgather rounds, one chunk each — the formula is not free-floating
+    k, chunk_bytes = 8, 128
+    for preserve, pair in ((False, 1), (True, 2)):
+        shipped = (k - 1) * chunk_bytes * pair + (k - 1) * chunk_bytes
+        assert shipped == al.algorithm_bytes_per_rank(
+            "ring", chunk_bytes * k, k, preserve)
+
+
+# ---------------------------------------------------------------------------
+# config knobs + cache token
+# ---------------------------------------------------------------------------
+
+
+def test_collective_algo_default_and_validation():
+    assert config.collective_algo() == "auto"
+    os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = "RING"  # case-insensitive
+    assert config.collective_algo() == "ring"
+    os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = "doubling"
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_COLLECTIVE_ALGO"):
+        config.collective_algo()
+
+
+def test_ring_crossover_bytes_parsing():
+    assert config.ring_crossover_bytes() == config.DEFAULT_RING_CROSSOVER_BYTES
+    os.environ["MPI4JAX_TPU_RING_CROSSOVER_BYTES"] = "4096"
+    assert config.ring_crossover_bytes() == 4096
+    os.environ["MPI4JAX_TPU_RING_CROSSOVER_BYTES"] = "-1"
+    with pytest.raises(ValueError, match="must be >= 0"):
+        config.ring_crossover_bytes()
+    os.environ["MPI4JAX_TPU_RING_CROSSOVER_BYTES"] = "1MB"
+    with pytest.raises(ValueError, match="could not be parsed"):
+        config.ring_crossover_bytes()
+
+
+def test_algo_cache_token_reflects_every_knob():
+    # mirrors tests/test_resilience.py::test_cache_token_reflects_every_knob:
+    # each knob must change the compiled-program cache key, or toggling it
+    # would silently keep serving the stale program
+    base = al.algo_cache_token()
+    tokens = {base}
+    os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = "ring"
+    tokens.add(al.algo_cache_token())
+    os.environ["MPI4JAX_TPU_RING_CROSSOVER_BYTES"] = "123"
+    tokens.add(al.algo_cache_token())
+    assert len(tokens) == 3
+    del os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"]
+    del os.environ["MPI4JAX_TPU_RING_CROSSOVER_BYTES"]
+    assert al.algo_cache_token() == base

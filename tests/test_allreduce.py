@@ -262,3 +262,127 @@ def test_allreduce_bf16():
     out = f(x)
     assert out.dtype == jnp.bfloat16
     assert np.allclose(np.asarray(out, dtype=np.float32), _expected_sum((2,)))
+
+
+# ---------------------------------------------------------------------------
+# payload-aware algorithm layer (ops/_algos.py): butterfly vs ring
+# ---------------------------------------------------------------------------
+
+_ALGO_OP_CASES = [
+    (mpx.SUM, np.add.reduce, "float"),
+    (mpx.PROD, np.multiply.reduce, "float"),
+    (mpx.MIN, np.minimum.reduce, "float"),
+    (mpx.MAX, np.maximum.reduce, "float"),
+    (mpx.LAND, np.logical_and.reduce, "bool"),
+    (mpx.LOR, np.logical_or.reduce, "bool"),
+    (mpx.LXOR, np.logical_xor.reduce, "bool"),
+    (mpx.BAND, np.bitwise_and.reduce, "int"),
+    (mpx.BOR, np.bitwise_or.reduce, "int"),
+    (mpx.BXOR, np.bitwise_xor.reduce, "int"),
+]
+
+
+@pytest.mark.parametrize("algo", ["auto", "butterfly", "ring"])
+@pytest.mark.parametrize("op,npred,kind", _ALGO_OP_CASES,
+                         ids=[o.name for o, _, _ in _ALGO_OP_CASES])
+def test_allreduce_algo_equivalence(monkeypatch, algo, op, npred, kind):
+    """Every Op must produce the same result under the forced butterfly,
+    the forced ring, and auto — on a payload NOT divisible by the group
+    size, so the ring's chunk padding is exercised too.  The env override
+    is folded into the compiled-program cache keys, so each setting
+    retraces (no clear_caches needed)."""
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", algo)
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=op)
+        return res
+
+    rng = np.random.default_rng(7)
+    if kind == "bool":
+        vals = rng.integers(0, 2, size=(size, 5)).astype(bool)
+    elif kind == "int":
+        vals = rng.integers(0, 128, size=(size, 5)).astype(np.int32)
+    else:
+        vals = rng.uniform(0.5, 1.5, size=(size, 5)).astype(np.float32)
+    out = np.asarray(f(jnp.asarray(vals)))
+    expected = npred(vals, axis=0)
+    for r in range(size):
+        np.testing.assert_allclose(
+            out[r].astype(np.float64), expected.astype(np.float64),
+            rtol=1e-5, err_msg=f"algo={algo} op={op} rank={r}")
+
+
+def test_allreduce_ring_elementwise_callable_order(monkeypatch):
+    """A forced ring accepts ELEMENTWISE callables (the MPI_User_function
+    contract; whole-array callables keep the butterfly — see _algos).
+    Right-projection is associative, non-commutative, and elementwise:
+    the ascending group-rank fold must yield the LAST rank's value, which
+    any mis-ordered ring combine would change."""
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=lambda a, b: b)
+        return res
+
+    out = np.asarray(f(ranks_arange((5,))))
+    assert np.allclose(out, size - 1), out
+
+
+def test_allreduce_ring_vs_butterfly_hlo_byte_volume(monkeypatch):
+    """The acceptance-criteria HLO pin: a forced-ring allreduce must move
+    chunk-sized payloads per CollectivePermute round (O(size) bytes per
+    rank over 2·(k-1) rounds), while the butterfly ships the FULL payload
+    every round (O(size·log k))."""
+    _, size = world()
+    nelem = 64 * size  # local payload; ring chunk = 64 elements
+    x = jnp.ones((size, nelem), jnp.float32)
+
+    def lowered(algo):
+        monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", algo)
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.allreduce(xl, op=mpx.SUM)
+            return res
+
+        return jax.jit(f).lower(x).as_text()
+
+    ring_lines = [ln for ln in lowered("ring").splitlines()
+                  if "collective_permute" in ln]
+    # 2·(k-1) chunk-sized rounds: k-1 reduce-scatter + k-1 allgather
+    assert len(ring_lines) >= 2 * (size - 1), len(ring_lines)
+    assert any(f"tensor<{nelem // size}xf32>" in ln for ln in ring_lines)
+    for ln in ring_lines:  # never the full payload
+        assert f"tensor<{nelem}xf32>" not in ln, ln
+
+    fly_lines = [ln for ln in lowered("butterfly").splitlines()
+                 if "collective_permute" in ln]
+    assert len(fly_lines) >= 1
+    # every butterfly round ships the FULL payload
+    assert all(f"tensor<{nelem}xf32>" in ln for ln in fly_lines)
+
+
+def test_eager_cache_algo_key_and_clear_caches(monkeypatch):
+    """Toggling MPI4JAX_TPU_COLLECTIVE_ALGO must retrace the eager one-op
+    program (the knob is folded into the cache key, mirroring the
+    resilience flags), and mpx.clear_caches() must drain the cache."""
+    from mpi4jax_tpu.ops import _base
+
+    mpx.clear_caches()
+    x = ranks_arange((4,))
+    res1, _ = mpx.allreduce(x, op=mpx.PROD)
+    n1 = len(_base._eager_cache)
+    assert n1 >= 1
+    mpx.allreduce(x, op=mpx.PROD)  # same key: cache hit, no growth
+    assert len(_base._eager_cache) == n1
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    res2, _ = mpx.allreduce(x, op=mpx.PROD)  # new key: retraced
+    assert len(_base._eager_cache) == n1 + 1
+    np.testing.assert_allclose(np.asarray(res2), np.asarray(res1),
+                               rtol=1e-5)
+    mpx.clear_caches()
+    assert len(_base._eager_cache) == 0

@@ -93,6 +93,36 @@ def test_bcast_bool():
     assert out[:, 0].all() and not out[:, 1].any()
 
 
+@pytest.mark.parametrize("algo", ["butterfly", "ring"])
+def test_bcast_forced_algos_whole_comm(monkeypatch, algo):
+    """Forced algorithms take the ppermute lowerings even on a whole-axes
+    comm (the escape hatch the benchmarks use): doubling broadcast
+    (butterfly) vs van de Geijn scatter + ring allgather (ring).  A
+    non-zero root, a payload not divisible by the comm size, and bool
+    dtype must all round-trip."""
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", algo)
+    _, size = world()
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.bcast(x, 3)
+        return res
+
+    x = per_rank(lambda r: 10.0 * r + np.arange(5, dtype=np.float32))
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.asarray(x)[3])
+
+    @mpx.spmd
+    def g(x):
+        res, _ = mpx.bcast(x, 1)
+        return res
+
+    xb = per_rank(lambda r: np.array([r == 1, r == 2]), dtype=jnp.bool_)
+    outb = np.asarray(g(xb))
+    assert outb.dtype == bool
+    assert outb[:, 0].all() and not outb[:, 1].any()
+
+
 def test_bcast_grad():
     # differentiable broadcast: cotangents route back to root
     _, size = world()

@@ -52,3 +52,26 @@ def test_bench_prod_and_split_schema():
     assert (r["prod_split_us"] is None) == (comm.Get_size() == 1)
     if r["prod_split_us"] is not None:
         assert r["prod_split_us"] > 0
+
+
+def test_bench_allreduce_algos_schema():
+    # force-compiles BOTH CollectivePermute algorithms (butterfly + ring)
+    # at a tiny size: a lowering regression in either fails here, fast
+    comm = _world_comm()
+    saved = os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO")
+    rows = micro.bench_allreduce_algos(comm, sizes_mb=[0.0001], iters=2)
+    assert os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO") == saved  # restored
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["butterfly_us"] > 0 and r["ring_us"] > 0
+    assert (r["ring_speedup"] is None) == (comm.Get_size() == 1)
+
+
+def test_save_results_roundtrip(tmp_path):
+    import json
+
+    payload = {"platform": "cpu", "n_devices": 8, "allreduce": []}
+    path = micro.save_results(payload, outdir=str(tmp_path))
+    assert os.path.basename(path).startswith("micro_cpu_8dev_")
+    with open(path) as f:
+        assert json.load(f) == payload

@@ -421,6 +421,92 @@ def test_split_eager_unequal_p2p_and_scan():
     np.testing.assert_allclose(np.asarray(sc)[:, 0], exp_sc)
 
 
+def test_split_algo_equivalence_ring_vs_butterfly(monkeypatch):
+    """The payload-aware layer on a color split: PROD (never native) must
+    agree across auto, forced butterfly, and forced ring on uniform
+    groups — with a payload not divisible by the group size, so the
+    ring's chunk padding is exercised."""
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+    groups = ((0, 2, 4, 6), (1, 3, 5, 7))
+    rng = np.random.default_rng(11)
+    vals = rng.uniform(0.5, 1.5, size=(size, 5)).astype(np.float32)
+    for algo in ("auto", "butterfly", "ring"):
+        monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", algo)
+
+        @mpx.spmd
+        def f(x):
+            s, _ = mpx.allreduce(x, op=mpx.PROD, comm=split)
+            return s
+
+        out = np.asarray(f(jnp.asarray(vals)))
+        for grp in groups:
+            expected = np.prod([vals[r] for r in grp], axis=0)
+            for r in grp:
+                np.testing.assert_allclose(out[r], expected, rtol=1e-5,
+                                           err_msg=f"algo={algo}")
+
+
+def test_split_forced_ring_unequal_groups_falls_back(monkeypatch):
+    """The ring lowerings need a uniform static group size (the chunk
+    count); a forced ring on an UNEQUAL partition must fall back to the
+    butterfly — still correct, never an error."""
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    comm, size = world()
+    split = comm.Split(COLORS_2)
+    s, _ = mpx.allreduce(ranks_arange((3,)), op=mpx.SUM, comm=split)
+    vals = np.arange(size, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(s)[:, 0], _expected_groupwise(vals, GROUPS_2, sum))
+    b, _ = mpx.bcast(ranks_arange((1,)), 1, comm=split)
+    exp_b = np.empty(size, np.float32)
+    for g in GROUPS_2:
+        exp_b[list(g)] = g[1]
+    np.testing.assert_allclose(np.asarray(b)[:, 0], exp_b)
+
+
+def test_split_bcast_vdg_ring(monkeypatch):
+    """Forced-ring bcast on a uniform split takes the van de Geijn
+    scatter + ring-allgather lowering; a payload not divisible by the
+    group size exercises the virtual-chunk padding."""
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+    groups = ((0, 2, 4, 6), (1, 3, 5, 7))
+    x = per_rank(lambda r: 10.0 * r + np.arange(5, dtype=np.float32))
+
+    @mpx.spmd
+    def f(xl):
+        b, _ = mpx.bcast(xl, 2, comm=split)
+        return b
+
+    out = np.asarray(f(x))
+    for g in groups:
+        for r in g:
+            np.testing.assert_allclose(out[r], np.asarray(x)[g[2]])
+
+
+def test_split_bcast_auto_crossover_picks_vdg(monkeypatch):
+    """``auto`` routes large split-comm broadcasts to the vdg lowering
+    once the payload crosses MPI4JAX_TPU_RING_CROSSOVER_BYTES — pinned by
+    shrinking the crossover to 1 byte instead of shipping megabytes."""
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "1")
+    comm, size = world()
+    split = comm.Split(COLORS_EO)
+    groups = ((0, 2, 4, 6), (1, 3, 5, 7))
+    x = per_rank(lambda r: float(r) + np.arange(8, dtype=np.float32))
+
+    @mpx.spmd
+    def f(xl):
+        b, _ = mpx.bcast(xl, 0, comm=split)
+        return b
+
+    out = np.asarray(f(x))
+    for g in groups:
+        for r in g:
+            np.testing.assert_allclose(out[r], np.asarray(x)[g[0]])
+
+
 def test_split_integer_colors_order_numerically():
     """Integer colors order groups numerically (10 after 2), not
     lexicographically; string colors keep lexicographic order (advisor
