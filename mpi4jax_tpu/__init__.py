@@ -72,6 +72,13 @@ from .resilience import (  # noqa: F401
     set_fault_spec,
     set_watchdog_timeout,
 )
+from .analysis import (  # noqa: F401
+    AnalysisError,
+    Finding,
+    Report,
+    analyze,
+    set_analyze_mode,
+)
 from .utils.profiling import profile_ops  # noqa: F401
 
 # JAX version advisory at import (ref mpi4jax/_src/__init__.py:6-8).
@@ -140,6 +147,12 @@ __all__ = [
     "set_watchdog_timeout",
     "set_fault_spec",
     "set_check_numerics",
+    # trace-time collective verifier (docs/analysis.md)
+    "analyze",
+    "Report",
+    "Finding",
+    "AnalysisError",
+    "set_analyze_mode",
 ]
 
 # Version comes from git tags via setuptools-scm at build time

@@ -1,0 +1,160 @@
+"""Trace-time SPMD collective verifier (``mpx.analyze``).
+
+The hazards docs/sharp_bits.md used to state only in prose — unmatched
+point-to-point, rank-dependent structure, token misuse, algorithm-
+crossover surprises — are enforced mechanically here, each with a stable
+``MPX1xx`` code, a one-line finding, and a suggested rewrite.  Two ways
+in:
+
+- **explicit**: ``mpx.analyze(fn, *args, comm=...) -> Report`` re-traces
+  ``fn`` abstractly (no compile, no execution, no devices touched),
+  records every collective at the shared dispatch point, walks the closed
+  jaxpr, and runs the checker registry;
+- **ambient**: ``MPI4JAX_TPU_ANALYZE={off,warn,error}`` verifies every
+  spmd region / eager op as it traces — ``error`` turns any finding into
+  a trace-time :class:`AnalysisError`, which is how CI keeps
+  ``examples/`` clean (``python -m mpi4jax_tpu.analysis script.py``).
+
+The verifier is the mandatory registration layer for future ops: anything
+flowing through ``ops/_base.dispatch`` is recorded (op kind, comm, root,
+routing, payload, token edges, selected algorithm) and checked — the same
+way resilience (PR 1) and the algorithm selector (PR 2) ride the single
+dispatch point.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .checkers import CHECKERS, registered_codes, run_checkers  # noqa: F401
+from .graph import CollectiveEvent, CollectiveGraph  # noqa: F401
+from .hook import (  # noqa: F401
+    Recorder,
+    analysis_cache_token,
+    clear_analysis_caches,
+    effective_mode,
+    pop_recorder,
+    push_recorder,
+    set_analyze_mode,
+)
+from .report import (  # noqa: F401
+    CODES,
+    AnalysisError,
+    Finding,
+    Report,
+    finding_from_exception,
+)
+from .walker import check_cond_divergence  # noqa: F401
+
+
+def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
+            static_argnums=None) -> Report:
+    """Statically verify the collective structure of ``fn(*args)``.
+
+    ``fn`` is re-traced abstractly (nothing executes, nothing compiles):
+    ``args`` may be arrays or ``jax.ShapeDtypeStruct`` templates.  Three
+    calling conventions:
+
+    - ``fn`` decorated with :func:`mpi4jax_tpu.spmd`: analyzed as-is
+      (``args`` are the global arrays you would call it with); the
+      analysis re-traces the underlying per-rank function, so compiled-
+      program caches cannot hide ops from the verifier;
+    - a plain per-rank function: wrapped in ``spmd`` over ``comm`` (or
+      the default comm) first, like ``mpx.run`` would;
+    - ``wrap=False``: traced exactly as given (for eager-style functions
+      that take global arrays and call ops outside any region).
+
+    Returns a :class:`Report`; ``report.raise_if_findings()`` converts it
+    into the same :class:`AnalysisError` the
+    ``MPI4JAX_TPU_ANALYZE=error`` dispatch mode raises.  Results are
+    memoized per (fn, arg shapes, algo config); ``mpx.clear_caches()``
+    drops the memo.
+    """
+    import jax
+
+    from ..ops._algos import algo_cache_token
+    from ..parallel.region import spmd
+
+    if wrap is None:
+        wrap = not getattr(fn, "_mpx_spmd", False)
+
+    if not wrap and getattr(fn, "_mpx_spmd", False):
+        # rebuild the un-jitted twin of the spmd wrapper: jit's trace cache
+        # would otherwise serve a cached jaxpr and record nothing
+        kw = fn._mpx_spmd_kwargs
+        target = spmd(
+            fn._mpx_fn,
+            comm=comm if comm is not None else kw["comm"],
+            in_specs=kw["in_specs"],
+            out_specs=kw["out_specs"],
+            static_argnums=kw["static_argnums"],
+            jit=False,
+        )
+        if static_argnums is None:
+            static_argnums = kw["static_argnums"]
+    elif wrap:
+        target = spmd(fn, comm=comm, jit=False)
+    else:
+        target = fn
+
+    statics = _normalize_statics(static_argnums, len(args))
+    from .hook import _analyze_cache
+
+    key = _cache_key(jax, fn, comm, args, statics, wrap, algo_cache_token())
+    if key is not None and key in _analyze_cache:
+        return _analyze_cache[key]
+
+    rec = Recorder("collect")
+    push_recorder(rec)
+    fatal = None
+    closed = None
+    try:
+        closed = jax.make_jaxpr(target, static_argnums=statics)(*args)
+    except Exception as e:  # only MPX-tagged raises become findings
+        fatal = finding_from_exception(e)
+        if fatal is None:
+            raise
+    finally:
+        pop_recorder()
+
+    graph = rec.graph()
+    findings = run_checkers(graph)
+    if fatal is not None:
+        # the aborted trace is ONE defect: the graph checkers may have
+        # replayed the same hazard from the events recorded before the
+        # raise — keep only the fatal finding for its code
+        findings = [f for f in findings if f.code != fatal.code]
+        findings.insert(0, fatal)
+    if closed is not None:
+        findings.extend(check_cond_divergence(closed))
+    report = Report(findings=tuple(findings), events=tuple(rec.events),
+                    meta=dict(graph.meta))
+    if key is not None:
+        _analyze_cache[key] = report
+    return report
+
+
+def _normalize_statics(static_argnums, nargs) -> tuple:
+    if static_argnums is None:
+        return ()
+    if isinstance(static_argnums, int):
+        static_argnums = (static_argnums,)
+    return tuple(sorted(i if i >= 0 else i + nargs for i in static_argnums))
+
+
+def _cache_key(jax, fn, comm, args, statics, wrap, algo_token):
+    dyn = tuple(a for i, a in enumerate(args) if i not in statics)
+    stat_vals = tuple(args[i] for i in statics)
+    leaves, treedef = jax.tree.flatten(dyn)
+    avals = tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+        else repr(leaf)
+        for leaf in leaves
+    )
+    key = (fn, comm, stat_vals, treedef, avals, wrap, algo_token)
+    try:
+        hash(key)
+    except TypeError:
+        return None  # unhashable statics/fn: analyze uncached
+    return key

@@ -1,0 +1,34 @@
+"""Analyze whole scripts: ``python -m mpi4jax_tpu.analysis script.py ...``.
+
+Runs each script with ``MPI4JAX_TPU_ANALYZE=error`` (unless the caller
+already set a mode), so every spmd region and eager op the script traces
+is verified and ANY finding fails the run — the CI ``analyze`` lane runs
+this over everything in ``examples/`` (.github/workflows/test.yml).
+"""
+
+import os
+import runpy
+import sys
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: python -m mpi4jax_tpu.analysis script.py [...]",
+              file=sys.stderr)
+        return 2
+    os.environ.setdefault("MPI4JAX_TPU_ANALYZE", "error")
+    mode = os.environ["MPI4JAX_TPU_ANALYZE"]
+    saved_argv = sys.argv
+    for path in argv:
+        print(f"[mpx.analyze] running {path} with MPI4JAX_TPU_ANALYZE={mode}")
+        sys.argv = [path]
+        try:
+            runpy.run_path(path, run_name="__main__")
+        finally:
+            sys.argv = saved_argv
+    print(f"[mpx.analyze] {len(argv)} script(s) analyzed clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

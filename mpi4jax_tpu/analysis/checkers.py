@@ -1,0 +1,282 @@
+"""The checker registry: pure functions over a :class:`CollectiveGraph`.
+
+Each checker declares the ``MPX1xx`` codes it can emit (every code in
+``report.CODES`` must be owned by exactly one checker or one tagged raise
+site — tests/test_analysis_pure.py asserts the registry covers the
+catalog).  Checkers are pure: graph in, findings out, no jax — so every
+future op or algorithm that records richer events gets verified without
+touching this module, and the whole registry runs under any JAX version.
+
+Two kinds of rules live in the verifier:
+
+- **trace-aborting rules** (MPX101-106): already hard errors at their
+  raise sites (ops, rankspec, validation), now tagged with their code via
+  ``report.mpx_error`` so ``mpx.analyze`` converts the raise into a
+  Finding.  The graph checkers below re-implement them structurally so
+  hand-built graphs (and future front-ends that build graphs without
+  tracing) get the same verdicts;
+- **stream rules** (MPX107, MPX109, MPX110): only expressible over the
+  whole op stream — they never raise at dispatch and are the reason the
+  env mode (``MPI4JAX_TPU_ANALYZE=warn|error``) exists.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from .graph import CollectiveGraph
+from .report import CODES, Finding
+
+# ops whose lowering consults the payload-aware selector (ops/_algos.py);
+# scan is deliberately absent — its prefix lowering has no ring form
+ALGO_OPS = ("allreduce", "reduce", "bcast", "reduce_scatter")
+
+# selector constants mirrored from ops/_algos.py (kept literal here so the
+# checkers stay importable without jax; test_analysis_pure pins equality)
+RING_MIN_GROUP = 4
+
+CHECKERS: List[tuple] = []  # (codes, fn)
+
+
+def checker(*codes: str) -> Callable:
+    for c in codes:
+        assert c in CODES, f"unknown MPX code {c}"
+
+    def register(fn):
+        CHECKERS.append((codes, fn))
+        return fn
+
+    return register
+
+
+def run_checkers(graph: CollectiveGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for _, fn in CHECKERS:
+        findings.extend(fn(graph))
+    findings.sort(key=lambda f: (f.index if f.index is not None else -1,
+                                 f.code))
+    return findings
+
+
+def registered_codes() -> set:
+    return {c for codes, _ in CHECKERS for c in codes}
+
+
+# ---------------------------------------------------------------------------
+# point-to-point matching (MPX101 / MPX102 / MPX106 / MPX110)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX101", "MPX102", "MPX106", "MPX110")
+def check_p2p_matching(graph: CollectiveGraph) -> List[Finding]:
+    """Replay FIFO matching per (comm, tag) channel over the event stream."""
+    findings: List[Finding] = []
+    for (comm_uid, tag), events in sorted(graph.by_channel().items(),
+                                          key=lambda kv: str(kv[0])):
+        # eager p2p uses deferred pairing (the send never enters dispatch,
+        # so the stream sees only the recv) — its matching is validated by
+        # the eager queues themselves, not replayed here
+        events = [e for e in events if not e.eager]
+        pending: List = []  # unmatched send events, FIFO
+        for e in events:
+            if e.op == "send":
+                pending.append(e)
+                continue
+            # recv
+            if not pending:
+                findings.append(Finding(
+                    code="MPX102", op=e.op, index=e.index,
+                    message=(f"recv(tag={tag}) on comm {comm_uid} has no "
+                             "matching send queued (matching is FIFO per "
+                             "(comm, tag) within one region)"),
+                    suggestion=("issue the matching send earlier in the "
+                                "same parallel region, or check the comm/"
+                                "tag pair"),
+                ))
+                continue
+            if len(pending) >= 2 and "queue_depth" not in e.extra:
+                e.extra["queue_depth"] = len(pending)
+            s = pending.pop(0)
+            if (s.dtype and e.dtype and s.dtype != e.dtype) or (
+                    s.shape and e.shape and
+                    _nelems(s.shape) != _nelems(e.shape)):
+                findings.append(Finding(
+                    code="MPX106", op=e.op, index=e.index,
+                    message=(f"recv template {e.shape}/{e.dtype} does not "
+                             f"match the send at {s.where()} "
+                             f"({s.shape}/{s.dtype}): MPI type-signature "
+                             "rule (shapes may differ only at equal "
+                             "element count)"),
+                    suggestion="make both sides agree in dtype and element "
+                               "count",
+                ))
+        for s in pending:
+            findings.append(Finding(
+                code="MPX101", op=s.op, index=s.index,
+                message=(f"send(tag={tag}) on comm {comm_uid} is never "
+                         "matched by a recv before the region ends "
+                         "(matching is FIFO per (comm, tag); the reference "
+                         "would deadlock at MPI_Finalize)"),
+                suggestion=("add the matching recv on the same comm and "
+                            "tag, or drop the send"),
+            ))
+    # ambiguity advisories (depth annotated by the live recv, or replayed
+    # above for hand-built graphs)
+    for e in graph.events:
+        depth = e.extra.get("queue_depth", 0)
+        if e.op == "recv" and depth >= 2:
+            findings.append(Finding(
+                code="MPX110", op=e.op, index=e.index,
+                message=(f"recv(tag={e.tag}) matched while {depth} sends "
+                         "were pending on this (comm, tag); FIFO picked "
+                         "the oldest"),
+                suggestion=("use distinct tags (or a Clone()d comm) if the "
+                            "pending sends are not interchangeable"),
+            ))
+    return findings
+
+
+def _nelems(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+# ---------------------------------------------------------------------------
+# structural statics (MPX103 / MPX104 / MPX105)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX103", "MPX104")
+def check_static_structure(graph: CollectiveGraph) -> List[Finding]:
+    """Events flagged non-static at dispatch (the live raise sites tag the
+    same hazards; this covers graphs built without tracing)."""
+    findings: List[Finding] = []
+    for e in graph.events:
+        if e.extra.get("bare_int_routing"):
+            findings.append(Finding(
+                code="MPX103", op=e.op, index=e.index,
+                message=(f"{e.op} routing was a bare int rank; under SPMD "
+                         "routing describes all ranks at once"),
+                suggestion="use pairs=[(src, dst)], shift(k), or a "
+                           "{src: dst} dict",
+            ))
+        if e.extra.get("traced_structure"):
+            findings.append(Finding(
+                code="MPX104", op=e.op, index=e.index,
+                message=(f"{e.op} structural argument "
+                         f"({e.extra['traced_structure']}) was a JAX "
+                         "tracer; roots/tags/routing must be static"),
+                suggestion="pass a Python int (mark it static through jit "
+                           "with static_argnums)",
+            ))
+    return findings
+
+
+@checker("MPX105")
+def check_root_range(graph: CollectiveGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for e in graph.events:
+        if e.root is None or e.min_size is None:
+            continue
+        if not 0 <= e.root < e.min_size:
+            kind = "smallest group" if e.split else "comm"
+            findings.append(Finding(
+                code="MPX105", op=e.op, index=e.index,
+                message=(f"{e.op} root {e.root} out of range for the "
+                         f"{kind} (size {e.min_size})"),
+                suggestion=f"use a root in [0, {e.min_size})",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# token discipline (MPX107)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX107")
+def check_token_chains(graph: CollectiveGraph) -> List[Finding]:
+    """Dropped/forked tokens: op ``e`` produced a token that nothing ever
+    consumes, while a LATER op on the same comm threads a token that was
+    already in circulation before ``e`` — the classic fork::
+
+        t = create_token()
+        a, t1 = allreduce(x, token=t)
+        b, t2 = allreduce(y, token=t)   # forked from t; t1 dropped
+
+    The final token of a chain is legitimately unconsumed; it only becomes
+    a finding when an older token is used after it.
+    """
+    findings: List[Finding] = []
+    for comm_uid, events in sorted(graph.by_comm().items()):
+        chain = [e for e in events
+                 if e.token_in is not None or e.token_out is not None]
+        consumed = {e.token_in for e in chain if e.token_in is not None}
+        first_seen: dict = {}
+        for pos, e in enumerate(chain):
+            for t in (e.token_in, e.token_out):
+                if t is not None and t not in first_seen:
+                    first_seen[t] = pos
+        for pos, e in enumerate(chain):
+            if e.token_out is None or e.token_out in consumed:
+                continue
+            if e.token_out == e.token_in:  # notoken passthrough
+                continue
+            stale = next(
+                (f for f in chain[pos + 1:]
+                 if f.token_in is not None
+                 and first_seen.get(f.token_in, len(chain)) <= pos),
+                None,
+            )
+            if stale is not None:
+                findings.append(Finding(
+                    code="MPX107", op=e.op, index=e.index,
+                    message=(f"the token produced by {e.where()} is never "
+                             f"consumed, but {stale.where()} on the same "
+                             "comm threads an older token — the chain was "
+                             "forked and this op's ordering dropped"),
+                    suggestion=(f"thread {e.where()}'s output token into "
+                               f"{stale.where()} (each op consumes the "
+                               "previous op's token)"),
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# perf advisory (MPX109)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX109")
+def check_crossover_proximity(graph: CollectiveGraph) -> List[Finding]:
+    """Payload within 2x of the ring/butterfly crossover under algo=auto:
+    shape-polymorphic retraces straddling the threshold silently flip the
+    lowering (same math, different perf) between traces."""
+    if graph.meta.get("collective_algo", "auto") != "auto":
+        return []
+    crossover = graph.meta.get("ring_crossover_bytes")
+    if not crossover:
+        return []
+    findings: List[Finding] = []
+    for e in graph.events:
+        if e.op not in ALGO_OPS or e.algo in (None, "native"):
+            continue
+        k = e.comm_size
+        if k is None or k < RING_MIN_GROUP:
+            continue
+        if crossover / 2 <= e.payload_bytes < crossover * 2:
+            findings.append(Finding(
+                code="MPX109", op=e.op, index=e.index,
+                message=(f"{e.op} payload ({e.payload_bytes} B) is within "
+                         f"2x of the ring crossover ({crossover} B) under "
+                         "algo=auto: retraces at nearby shapes may pick "
+                         f"different lowerings (this trace chose "
+                         f"'{e.algo}')"),
+                suggestion=("pin MPI4JAX_TPU_COLLECTIVE_ALGO=butterfly or "
+                            "=ring for this workload, or move "
+                            "MPI4JAX_TPU_RING_CROSSOVER_BYTES away from "
+                            "the working payload size"),
+            ))
+    return findings

@@ -1,0 +1,76 @@
+"""The collective graph: what the verifier sees of a traced program.
+
+One :class:`CollectiveEvent` is recorded per op at the shared dispatch
+point (ops/_base.py) — op kind, communicator identity, static structure
+(root, routing pairs, tag), payload size/dtype, the token edges, and the
+algorithm the payload-aware selector picked.  A :class:`CollectiveGraph`
+is the ordered stream of one trace plus the configuration snapshot the
+checkers need (algo mode, crossover bytes).
+
+Token edges are recorded as opaque ids (``id()`` of the token's carrier
+value at trace time; the recorder pins the carriers so ids cannot be
+reused within one recording).  Checkers treat ids purely as equality
+handles.
+
+Dependency-free (no jax) so hand-built graphs drive the checkers in
+tests/test_analysis_pure.py under any JAX version.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class CollectiveEvent:
+    """One collective as seen at dispatch.  Mutable: ops annotate fields
+    that only become known inside their body (routing pairs, match depth,
+    selected algorithm) via ``analysis.hook.annotate``."""
+
+    index: int
+    op: str
+    comm_uid: int = 0
+    comm_axes: Tuple[str, ...] = ()
+    comm_size: Optional[int] = None     # static group size, if it has one
+    min_size: Optional[int] = None      # smallest group (root bound)
+    split: bool = False                 # color-split comm?
+    payload_bytes: int = 0
+    dtype: str = ""
+    shape: Tuple[int, ...] = ()
+    root: Optional[int] = None
+    pairs: Optional[Tuple[Tuple[int, int], ...]] = None
+    tag: Optional[int] = None
+    reduction: Optional[str] = None
+    algo: Optional[str] = None          # "native" | "butterfly" | "ring"
+    token_in: Optional[int] = None
+    token_out: Optional[int] = None
+    eager: bool = False
+    extra: Dict = field(default_factory=dict)
+
+    def where(self) -> str:
+        return f"{self.op}#{self.index}"
+
+
+@dataclass
+class CollectiveGraph:
+    """Ordered event stream of one trace + the config snapshot."""
+
+    events: List[CollectiveEvent] = field(default_factory=list)
+    # {"collective_algo": ..., "ring_crossover_bytes": ...}
+    meta: Dict = field(default_factory=dict)
+
+    def by_channel(self) -> Dict[Tuple[int, Optional[int]], List[CollectiveEvent]]:
+        """Point-to-point events grouped by (comm_uid, tag) channel, in
+        stream order — the FIFO matching domains."""
+        out: Dict[Tuple[int, Optional[int]], List[CollectiveEvent]] = {}
+        for e in self.events:
+            if e.op in ("send", "recv"):
+                out.setdefault((e.comm_uid, e.tag), []).append(e)
+        return out
+
+    def by_comm(self) -> Dict[int, List[CollectiveEvent]]:
+        out: Dict[int, List[CollectiveEvent]] = {}
+        for e in self.events:
+            out.setdefault(e.comm_uid, []).append(e)
+        return out

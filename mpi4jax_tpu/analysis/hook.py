@@ -1,0 +1,238 @@
+"""Dispatch-point recording + the ``MPI4JAX_TPU_ANALYZE`` env mode.
+
+Two front-ends share this machinery:
+
+- ``mpx.analyze(fn, *args)`` pushes an explicit :class:`Recorder` and
+  re-traces ``fn``; every op flowing through the shared dispatch point
+  (ops/_base.py) records a :class:`~.graph.CollectiveEvent`;
+- the env mode (``MPI4JAX_TPU_ANALYZE={off,warn,error}``) arms the
+  region context instead: events accumulate per spmd region (or per
+  eager one-op program) and the checkers run when the region's trace
+  completes — ``warn`` emits a warning, ``error`` raises
+  :class:`~.report.AnalysisError` at trace time.
+
+Recording is pure host-side bookkeeping: it never adds an equation to the
+trace, so the lowered HLO is byte-identical whether the verifier is off,
+warning, or erroring (pinned by tests/test_analysis.py).  The mode is
+still folded into every compiled-program cache key
+(``analysis_cache_token``): a cached program skips tracing, and trace
+time is when the verifier looks.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional
+
+from ..utils import config
+from .checkers import run_checkers
+from .graph import CollectiveEvent, CollectiveGraph
+from .report import Report
+
+_UNSET = object()
+_mode_override = _UNSET
+
+
+def set_analyze_mode(mode: Optional[str]) -> None:
+    """Programmatic override of ``MPI4JAX_TPU_ANALYZE`` (``None`` returns
+    control to the environment), mirroring the resilience ``set_*``
+    overrides."""
+    global _mode_override
+    if mode is None:
+        _mode_override = _UNSET
+        return
+    if mode not in config.ANALYZE_MODES:
+        raise ValueError(
+            f"analyze mode must be one of {config.ANALYZE_MODES}, got {mode!r}"
+        )
+    _mode_override = mode
+
+
+def effective_mode() -> str:
+    if _mode_override is not _UNSET:
+        return _mode_override
+    return config.analyze_mode()
+
+
+def analysis_cache_token() -> tuple:
+    """Folded into the compiled-program cache keys (ops/_base.py eager
+    cache, parallel/region.py spmd cache): flipping the mode must retrace
+    — the verifier only sees programs as they trace."""
+    return (effective_mode(),)
+
+
+class Recorder:
+    """Event sink for one recording scope (an ``analyze`` call or one
+    armed region)."""
+
+    def __init__(self, mode: str):
+        self.mode = mode
+        self.events: List[CollectiveEvent] = []
+        # live token carriers: events store id()s, so carriers must stay
+        # alive for the recording or a GC'd token's id could be reused
+        self.pins: List = []
+
+    def graph(self) -> CollectiveGraph:
+        return CollectiveGraph(events=self.events, meta=config_snapshot())
+
+
+def config_snapshot() -> dict:
+    return {
+        "collective_algo": config.collective_algo(),
+        "ring_crossover_bytes": config.ring_crossover_bytes(),
+    }
+
+
+# explicit-analyze recorders (mpx.analyze); innermost wins
+_recorder_stack: List[Recorder] = []
+
+# (event, recorder) currently between begin/end (annotate targets the
+# innermost event; end_event pins the produced token on its recorder)
+_open_events: List[tuple] = []
+
+
+def recording() -> bool:
+    """True while ``mpx.analyze`` is re-tracing: dispatch must bypass its
+    compiled-program caches (a cache hit skips tracing, and tracing is
+    what records events)."""
+    return bool(_recorder_stack)
+
+
+def push_recorder(rec: Recorder) -> None:
+    _recorder_stack.append(rec)
+
+
+def pop_recorder() -> Recorder:
+    rec = _recorder_stack.pop()
+    # drop any events left open by an exception mid-op (a later annotate
+    # must never target a stale event from an aborted trace)
+    while _open_events and _open_events[-1][1] is rec:
+        _open_events.pop()
+    return rec
+
+
+def arm_context(ctx) -> None:
+    """Attach an env-mode recorder to a fresh region context (spmd body or
+    eager one-op program).  No-op when the verifier is off or an explicit
+    ``analyze`` recorder is already capturing."""
+    if _recorder_stack:
+        return
+    mode = effective_mode()
+    if mode != "off":
+        ctx.analysis_recorder = Recorder(mode)
+
+
+def _target(ctx) -> Optional[Recorder]:
+    if _recorder_stack:
+        return _recorder_stack[-1]
+    return getattr(ctx, "analysis_recorder", None) if ctx is not None else None
+
+
+def begin_event(opname: str, comm, arrays, token, ana: Optional[dict],
+                ctx, eager: bool = False) -> Optional[CollectiveEvent]:
+    """Record the dispatch of one op.  Returns None (fast path) unless a
+    recorder is active; otherwise the open event, to be closed with
+    ``end_event`` after the op body ran."""
+    rec = _target(ctx)
+    if rec is None:
+        return None
+    try:
+        size = comm.Get_size()
+    except RuntimeError:
+        size = None
+    try:
+        min_size = comm.min_size()
+    except RuntimeError:
+        min_size = None
+    a0 = arrays[0] if arrays else None
+    evt = CollectiveEvent(
+        index=len(rec.events),
+        op=opname,
+        comm_uid=comm.uid,
+        comm_axes=tuple(comm.axes),
+        comm_size=size,
+        min_size=min_size,
+        split=comm.groups is not None,
+        payload_bytes=(int(a0.size) * a0.dtype.itemsize) if a0 is not None else 0,
+        dtype=str(a0.dtype) if a0 is not None else "",
+        shape=tuple(a0.shape) if a0 is not None else (),
+        eager=eager,
+    )
+    if ana:
+        for k, v in ana.items():
+            setattr(evt, k, v)
+    if token is not None:
+        evt.token_in = id(token.value)
+        rec.pins.append(token.value)
+    rec.events.append(evt)
+    _open_events.append((evt, rec))
+    return evt
+
+
+def end_event(evt: CollectiveEvent, out) -> None:
+    """Close an open event: record the produced token edge."""
+    assert _open_events and _open_events[-1][0] is evt
+    _, rec = _open_events.pop()
+    from ..ops.token import Token
+
+    if out and isinstance(out[-1], Token):
+        evt.token_out = id(out[-1].value)
+        rec.pins.append(out[-1].value)
+
+
+def abort_event(evt: CollectiveEvent) -> None:
+    """Unwind an open event whose op body raised (the raise itself is the
+    diagnostic — tagged at the raise site)."""
+    if _open_events and _open_events[-1][0] is evt:
+        _open_events.pop()
+
+
+def annotate(**fields) -> None:
+    """Fill event fields only the op body knows (resolved routing pairs,
+    FIFO queue depth at match time, the selected algorithm).  No-op when
+    nothing records — safe to call unconditionally from op bodies and
+    ``_algos`` appliers."""
+    if not _open_events:
+        return
+    evt = _open_events[-1][0]
+    for k, v in fields.items():
+        if k in ("queue_depth", "bare_int_routing", "traced_structure"):
+            evt.extra[k] = v
+        else:
+            setattr(evt, k, v)
+
+
+def finish_context(ctx, where: str) -> None:
+    """Run the checkers over a region's recorded stream (env mode only) and
+    surface findings per the mode."""
+    rec = getattr(ctx, "analysis_recorder", None)
+    if rec is None or not rec.events:
+        return
+    ctx.analysis_recorder = None
+    graph = rec.graph()
+    findings = run_checkers(graph)
+    if not findings:
+        return
+    report = Report(findings=tuple(findings), events=tuple(rec.events),
+                    meta=dict(graph.meta))
+    if rec.mode == "error":
+        report.raise_if_findings()
+    warnings.warn(
+        f"MPI4JAX_TPU_ANALYZE: findings in {where}:\n{report.render()}",
+        stacklevel=2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# analyze() memoization (cleared by mpx.clear_caches)
+# ---------------------------------------------------------------------------
+
+_analyze_cache: dict = {}
+
+
+def analyze_cache() -> dict:
+    return _analyze_cache
+
+
+def clear_analysis_caches() -> None:
+    _analyze_cache.clear()

@@ -1,0 +1,201 @@
+"""Findings, reports, and the MPX error-code catalog.
+
+Every rule docs/sharp_bits.md states in prose carries a stable ``MPX1xx``
+code here, so a diagnostic can be grepped, suppressed in a code review, or
+cross-referenced from the docs the way compiler warnings are.  Codes are
+append-only: a released code never changes meaning.
+
+This module is dependency-free (no jax, no package siblings) so the raise
+sites that tag their exceptions (ops, rankspec, validation) can import it
+from anywhere without cycles, and the pure-Python test half
+(tests/test_analysis_pure.py) can load it under any JAX version.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+ERROR = "error"
+ADVISORY = "advisory"
+
+
+@dataclass(frozen=True)
+class CodeInfo:
+    """Catalog entry for one diagnostic code."""
+
+    code: str
+    title: str
+    severity: str
+    doc: str
+
+
+# The checker catalog (docs/analysis.md mirrors this table; the docs-sync
+# lint in tests/test_lint.py asserts every code below appears there).
+CODES = {
+    c.code: c
+    for c in (
+        CodeInfo(
+            "MPX101", "unmatched send", ERROR,
+            "A send was never matched by a recv on the same (comm, tag) "
+            "before its parallel region (or flush/exit, for eager sends) "
+            "ended.  Matching is FIFO per (comm, tag); the reference "
+            "implementation would deadlock at run time.",
+        ),
+        CodeInfo(
+            "MPX102", "recv without matching send", ERROR,
+            "A recv found no queued send on its (comm, tag).  Under SPMD "
+            "the matching send must appear earlier in the same region "
+            "(FIFO per channel); the reference would block forever.",
+        ),
+        CodeInfo(
+            "MPX103", "bare-int routing", ERROR,
+            "A point-to-point routing spec was a bare int rank.  One SPMD "
+            "program describes all ranks at once, so 'dest=1' would mean "
+            "every rank sends to rank 1 — not a permutation.",
+        ),
+        CodeInfo(
+            "MPX104", "traced structural argument", ERROR,
+            "A root, tag, or routing spec was a JAX tracer.  Structure "
+            "must be static Python values: one traced program serves all "
+            "ranks, so structural choices cannot depend on traced data.",
+        ),
+        CodeInfo(
+            "MPX105", "root out of range", ERROR,
+            "A static root index does not exist on the communicator (on a "
+            "color split it must be a valid group position in EVERY "
+            "group).",
+        ),
+        CodeInfo(
+            "MPX106", "send/recv type-signature mismatch", ERROR,
+            "The two sides of a sendrecv (or a matched send/recv pair) "
+            "disagree in dtype or element count.  MPI's type-signature "
+            "rule; under SPMD a count mismatch cannot be routed at all.",
+        ),
+        CodeInfo(
+            "MPX107", "dropped or forked token", ERROR,
+            "A collective's output token is never consumed while a later "
+            "collective on the same comm threads an older token.  The "
+            "ordering the dropped token was meant to pin is silently "
+            "lost (and differs between token and notoken modes).",
+        ),
+        CodeInfo(
+            "MPX108", "collective under one branch of cond", ERROR,
+            "A lax.cond has collectives in some branches but not others. "
+            "If the predicate ever varies across ranks (notoken mode has "
+            "no token ordering to save you), participating ranks hang in "
+            "the collective while the others skip it.",
+        ),
+        CodeInfo(
+            "MPX109", "payload near algorithm crossover", ADVISORY,
+            "Under MPI4JAX_TPU_COLLECTIVE_ALGO=auto this payload lands "
+            "within 2x of MPI4JAX_TPU_RING_CROSSOVER_BYTES, so shape-"
+            "polymorphic retraces may flip between the butterfly and ring "
+            "lowerings nondeterministically (different perf, same math).",
+        ),
+        CodeInfo(
+            "MPX110", "ambiguous FIFO match", ADVISORY,
+            "A recv matched while two or more sends were pending on its "
+            "(comm, tag).  FIFO picks the oldest; if the sends are not "
+            "interchangeable, use distinct tags or a Clone()d comm.",
+        ),
+    )
+}
+
+
+def mpx_error(exc_type, code: str, message: str):
+    """Build an exception tagged with a stable MPX code.
+
+    The code rides along as ``exc.mpx_code`` (so ``mpx.analyze`` can
+    convert the raise into a :class:`Finding`) and is appended to the
+    message (so plain tracebacks are greppable).  Raise sites use this
+    instead of bare ``raise TypeError(...)`` for every rule the checker
+    catalog covers.
+    """
+    assert code in CODES, f"unknown MPX code {code}"
+    exc = exc_type(f"{message} [{code}]")
+    exc.mpx_code = code
+    return exc
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: a stable code, a one-line message, a suggested fix."""
+
+    code: str
+    message: str
+    suggestion: str = ""
+    op: Optional[str] = None
+    index: Optional[int] = None
+
+    @property
+    def severity(self) -> str:
+        return CODES[self.code].severity
+
+    def render(self) -> str:
+        where = f" at {self.op}#{self.index}" if self.op is not None else ""
+        line = f"{self.code} [{self.severity}]{where}: {self.message}"
+        if self.suggestion:
+            line += f"\n    fix: {self.suggestion}"
+        return line
+
+
+def finding_from_exception(exc) -> Optional[Finding]:
+    """Convert an ``mpx_error``-tagged exception into a Finding (or None
+    for untagged exceptions, which should propagate)."""
+    code = getattr(exc, "mpx_code", None)
+    if code is None:
+        return None
+    return Finding(code=code, message=str(exc),
+                   suggestion=CODES[code].doc.split(".")[0] + ".")
+
+
+@dataclass(frozen=True)
+class Report:
+    """Result of one analysis pass: the findings, the event stream they
+    were derived from (``events`` entries are
+    :class:`~mpi4jax_tpu.analysis.graph.CollectiveEvent`), and the config
+    snapshot the checkers saw (``meta``: collective_algo, crossover)."""
+
+    findings: Tuple[Finding, ...] = ()
+    events: Tuple = ()
+    meta: Dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    @property
+    def errors(self) -> Tuple[Finding, ...]:
+        return tuple(f for f in self.findings if f.severity == ERROR)
+
+    @property
+    def advisories(self) -> Tuple[Finding, ...]:
+        return tuple(f for f in self.findings if f.severity == ADVISORY)
+
+    def render(self) -> str:
+        if not self.findings:
+            return (f"mpx.analyze: clean ({len(self.events)} collective(s) "
+                    "analyzed)")
+        head = (f"mpx.analyze: {len(self.errors)} error(s), "
+                f"{len(self.advisories)} advisory(ies) over "
+                f"{len(self.events)} collective(s)")
+        return "\n".join([head] + [f.render() for f in self.findings])
+
+    def __str__(self) -> str:
+        return self.render()
+
+    def raise_if_findings(self) -> None:
+        if self.findings:
+            raise AnalysisError(self.findings, self.render())
+
+
+class AnalysisError(RuntimeError):
+    """Raised by ``MPI4JAX_TPU_ANALYZE=error`` (and
+    ``Report.raise_if_findings``) when any finding fired.  The structured
+    findings are available as ``.findings``."""
+
+    def __init__(self, findings, message):
+        super().__init__(message)
+        self.findings = tuple(findings)

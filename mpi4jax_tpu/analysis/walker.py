@@ -1,0 +1,112 @@
+"""Closed-jaxpr walker: structural checks no event stream can see.
+
+The ops lower to plain ``jax.lax`` collectives, so a traced program's
+jaxpr contains ``psum``/``ppermute``/``all_gather``/... equations wherever
+communication happens — including inside control-flow sub-jaxprs that the
+dispatch-point recorder observes only as a flat stream.  This walker
+descends the whole jaxpr tree (duck-typed: anything with ``.eqns`` is a
+jaxpr, anything with ``.jaxpr`` is a closed jaxpr, params may hold jaxprs
+or lists of them) and flags ``lax.cond`` equations whose branches disagree
+about communicating (MPX108): if the predicate ever varies across ranks,
+the communicating branch hangs waiting for ranks that took the other one.
+
+Duck typing keeps this module importable (and unit-testable with fake
+jaxpr objects) under any JAX version.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .report import Finding
+
+# primitive-name prefixes that perform cross-rank communication (matched
+# by prefix so renames like psum -> psum2/psum_invariant across JAX
+# versions stay covered).  Deliberately NOT listed: pbroadcast/pcast —
+# in the VMA collective type system those are typing promotions that
+# lower to nothing, and flagging them would false-positive every branch
+# that merely re-types a value.
+COLLECTIVE_PRIMITIVE_PREFIXES = (
+    "psum",
+    "pmin",
+    "pmax",
+    "ppermute",
+    "all_gather",
+    "all_to_all",
+    "psum_scatter",
+    "reduce_scatter",
+)
+
+
+def _iter_jaxprs(v):
+    """Yield every jaxpr reachable from a params value (jaxpr, closed
+    jaxpr, or (nested) sequence thereof)."""
+    if hasattr(v, "eqns"):
+        yield v
+    elif hasattr(v, "jaxpr"):
+        yield from _iter_jaxprs(v.jaxpr)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _iter_jaxprs(x)
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        yield from _iter_jaxprs(v)
+
+
+def is_collective(primitive_name: str) -> bool:
+    return primitive_name.startswith(COLLECTIVE_PRIMITIVE_PREFIXES)
+
+
+def count_collectives(jaxpr) -> int:
+    """Number of collective equations in ``jaxpr``, including all nested
+    sub-jaxprs (control flow, pjit, shard_map, custom_* wrappers)."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        if is_collective(eqn.primitive.name):
+            n += 1
+        for sub in _sub_jaxprs(eqn):
+            n += count_collectives(sub)
+    return n
+
+
+def find_cond_divergences(jaxpr) -> List[dict]:
+    """All ``cond`` equations (at any depth) whose branches disagree on
+    whether they communicate.  Returns records with per-branch collective
+    counts."""
+    out: List[dict] = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "cond":
+            counts = [
+                sum(count_collectives(j) for j in _iter_jaxprs(b))
+                for b in eqn.params.get("branches", ())
+            ]
+            if any(counts) and not all(counts):
+                out.append({"counts": counts})
+        # descend regardless: nested conds inside branches/bodies
+        for sub in _sub_jaxprs(eqn):
+            out.extend(find_cond_divergences(sub))
+    return out
+
+
+def check_cond_divergence(closed_jaxpr) -> List[Finding]:
+    """MPX108 findings for a traced program's closed jaxpr."""
+    findings: List[Finding] = []
+    for rec in find_cond_divergences(
+            next(_iter_jaxprs(closed_jaxpr), closed_jaxpr)):
+        counts = rec["counts"]
+        with_c = [i for i, c in enumerate(counts) if c]
+        without = [i for i, c in enumerate(counts) if not c]
+        findings.append(Finding(
+            code="MPX108", op="cond",
+            message=(f"lax.cond branches disagree about communicating: "
+                     f"branch(es) {with_c} contain "
+                     f"{sum(counts)} collective(s), branch(es) {without} "
+                     "contain none — a rank-varying predicate hangs the "
+                     "communicating side"),
+            suggestion=("hoist the collective out of the cond, or make "
+                        "every branch issue the same collectives (e.g. "
+                        "reduce a masked value)"),
+        ))
+    return findings

@@ -387,6 +387,7 @@ def apply_reduce_scatter(xl, op, comm):
     the chunked-allreduce elementwise caveat does not apply here.
     """
     from ._base import Op, apply_butterfly_allreduce, as_varying
+    from ..analysis.hook import annotate
 
     k = comm.Get_size()  # static; raises the clear error on unequal splits
     xl = as_varying(xl, comm.axes)
@@ -396,12 +397,15 @@ def apply_reduce_scatter(xl, op, comm):
     if (algo == "auto" and op is Op.SUM and comm.groups is None
             and len(comm.axes) == 1):
         try:
-            return lax.psum_scatter(
+            res = lax.psum_scatter(
                 xl, comm.axes[0], scatter_dimension=0, tiled=False
             )
+            annotate(algo="native")
+            return res
         except NotImplementedError:  # shard_map/backend gap: fall through
             pass
     algo = resolve_algo(algo, xl.size * xl.dtype.itemsize, k, ring_ok=True)
+    annotate(algo=algo)
     if algo == "ring":
         return apply_ring_reduce_scatter(xl, op, comm, k)
     full = apply_butterfly_allreduce(xl, op, comm)

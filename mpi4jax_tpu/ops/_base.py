@@ -36,6 +36,11 @@ from ..parallel.region import (
 from ..utils.debug import get_logging, get_runtime_tracing, op_scope
 from ..utils.dtypes import check_dtype
 
+# the trace-time collective verifier rides the same single dispatch point
+# as resilience and the algorithm selector (imported last: analysis only
+# depends on utils.config, so the package import order stays acyclic)
+from ..analysis import hook as _analysis
+
 
 class Op(enum.Enum):
     """Reduction operations (replaces MPI.Op handles, ref _src/utils.py:141-145).
@@ -95,6 +100,14 @@ _LOCAL_COMBINE = {
 }
 
 
+def reduction_name(op) -> str:
+    """Static display name of a reduction for the trace-time verifier's
+    event stream (``mpi4jax_tpu/analysis``)."""
+    if isinstance(op, Op):
+        return op.value
+    return getattr(op, "__name__", "callable")
+
+
 def combine_fn(op: OpLike) -> Callable:
     if isinstance(op, Op):
         return _LOCAL_COMBINE[op]
@@ -149,10 +162,13 @@ def apply_doubling_bcast(xl, comm: Comm, root: int):
     # before dispatch, but this helper is callable on its own.)
     kmin = min(len(g) for g in groups)
     if not 0 <= root < kmin:
-        raise ValueError(
+        from ..analysis.report import mpx_error
+
+        raise mpx_error(
+            ValueError, "MPX105",
             f"apply_doubling_bcast: root {root} out of range for the "
             f"smallest group (size {kmin}); root must be a valid group "
-            "position in every group"
+            "position in every group",
         )
     kmax = max(len(g) for g in groups)
     if kmax == 1:
@@ -208,6 +224,7 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
     algo = collective_algo()
     if (algo == "auto" and comm.groups is None and isinstance(op, Op)
             and op in _NATIVE_COLLECTIVE):
+        _analysis.annotate(algo="native")
         return _NATIVE_COLLECTIVE[op](x, axes)
     k = _algos.static_group_size(comm)
     ring_ok = k is not None and k > 1 and (
@@ -215,6 +232,7 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
     )
     algo = _algos.resolve_algo(algo, x.size * x.dtype.itemsize,
                                k or 1, ring_ok)
+    _analysis.annotate(algo=algo)
     if algo == "ring":
         return _algos.apply_ring_allreduce(x, op, comm, k)
     return apply_butterfly_allreduce(x, op, comm)
@@ -375,18 +393,20 @@ _EAGER_CACHE_MAX = 128
 
 
 def clear_caches() -> None:
-    """Drain the eager one-op compiled-program cache.
+    """Drain the eager one-op compiled-program cache and the memoized
+    ``mpx.analyze`` reports.
 
-    Each entry pins a compiled executable plus its mesh; call this after
-    retiring a mesh, or when flipping a trace-shaping environment variable
-    mid-process by hand (the knobs this library reads —
-    ``MPI4JAX_TPU_COLLECTIVE_ALGO``, the resilience flags, tracing/logging
-    — are already folded into the cache key, so toggling them retraces
-    without an explicit clear).  ``spmd``-decorated functions hold their
-    own per-function program caches keyed the same way; they are dropped
-    with the function object.
+    Each eager entry pins a compiled executable plus its mesh; call this
+    after retiring a mesh, or when flipping a trace-shaping environment
+    variable mid-process by hand (the knobs this library reads —
+    ``MPI4JAX_TPU_COLLECTIVE_ALGO``, the resilience flags,
+    ``MPI4JAX_TPU_ANALYZE``, tracing/logging — are already folded into the
+    cache key, so toggling them retraces without an explicit clear).
+    ``spmd``-decorated functions hold their own per-function program
+    caches keyed the same way; they are dropped with the function object.
     """
     _eager_cache.clear()
+    _analysis.clear_analysis_caches()
 
 
 def group_select_gather(comm: Comm, xl):
@@ -412,7 +432,8 @@ def check_global_shape(opname: str, a, size: int) -> None:
 
 
 def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
-             static_key: Optional[tuple] = None):
+             static_key: Optional[tuple] = None,
+             ana: Optional[dict] = None):
     """Run op ``body`` either inline (inside a parallel region) or eagerly.
 
     ``body(comm, arrays, token) -> (outputs..., token)`` operates on
@@ -423,6 +444,12 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     path through ``xla.apply_primitive`` (ref _src/utils.py:34-35).  Outputs
     use the same convention, so eager results have shape
     ``(size, *local_out_shape)``.
+
+    ``ana`` is the op's static structure as the trace-time verifier sees
+    it (root, tag, reduction, ... — mpi4jax_tpu/analysis/): every op that
+    flows through this dispatch point is recorded when ``mpx.analyze`` or
+    ``MPI4JAX_TPU_ANALYZE`` is active, and recording is pure host-side
+    bookkeeping — the traced program (and thus the HLO) is untouched.
     """
     comm = resolve_comm(comm)
     for a in arrays:
@@ -444,7 +471,16 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
         # so every op accepts them (collectives are variant->invariant typed)
         arrays = tuple(as_varying(a, comm.axes) for a in arrays)
         with op_scope(opname):
-            return _run_body(opname, comm, body, arrays, token)
+            evt = _analysis.begin_event(opname, comm, arrays, token, ana, ctx)
+            try:
+                out = _run_body(opname, comm, body, arrays, token)
+            except BaseException:
+                if evt is not None:
+                    _analysis.abort_event(evt)
+                raise
+            if evt is not None:
+                _analysis.end_event(evt, out)
+            return out
 
     if comm.mesh is None:
         raise RuntimeError(
@@ -463,7 +499,12 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     # trace; ``None`` marks the call uncacheable (e.g. a Status out-param
     # that must be filled at trace time)
     cache_key = None
-    if static_key is not None:
+    if (static_key is not None and not _analysis.recording()
+            and _analysis.effective_mode() == "off"):
+        # an active mpx.analyze recorder — or the ambient warn/error mode —
+        # bypasses the cache entirely: a cache hit would skip tracing,
+        # tracing is when events are recorded, and queue-state-dependent
+        # findings (MPX110) can differ between calls that share a program
         from ..utils.config import prefer_notoken
 
         from ..resilience.runtime import cache_token as resilience_token
@@ -473,7 +514,8 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
         # key, or toggling it would silently keep serving the old program
         cache_key = (opname, comm.mesh, comm.uid, static_key,
                      get_runtime_tracing(), get_logging(), prefer_notoken(),
-                     resilience_token(), algo_cache_token())
+                     resilience_token(), algo_cache_token(),
+                     _analysis.analysis_cache_token())
         cached = _eager_cache.get(cache_key)
         if cached is not None:
             _eager_cache.move_to_end(cache_key)
@@ -482,14 +524,24 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
 
     def wrapped(arrs, tok):
         ctx = RegionContext(comm)
+        _analysis.arm_context(ctx)
         _region_stack.append(ctx)
         try:
             with op_scope(opname):
                 # shard_map hands us (1, *local); body wants (*local,)
-                out = _run_body(
-                    opname, comm, body, tuple(a[0] for a in arrs), tok
-                )
+                locals_ = tuple(a[0] for a in arrs)
+                evt = _analysis.begin_event(opname, comm, locals_, tok, ana,
+                                            ctx, eager=True)
+                try:
+                    out = _run_body(opname, comm, body, locals_, tok)
+                except BaseException:
+                    if evt is not None:
+                        _analysis.abort_event(evt)
+                    raise
+                if evt is not None:
+                    _analysis.end_event(evt, out)
             ctx.check_drained()
+            _analysis.finish_context(ctx, f"eager {opname}")
         finally:
             _region_stack.pop()
         *results, tok_out = out

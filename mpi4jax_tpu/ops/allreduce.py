@@ -23,7 +23,7 @@ from typing import Optional
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import SUM, Op, OpLike, apply_allreduce, dispatch
+from ._base import SUM, Op, OpLike, apply_allreduce, dispatch, reduction_name
 from .token import Token, consume, produce
 
 
@@ -46,4 +46,5 @@ def allreduce(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
     # custom callable ops are uncacheable: their captured state can change
     # without changing identity (enum ops are pure values)
     return dispatch("allreduce", comm, body, (x,), token,
-                    static_key=(op,) if isinstance(op, Op) else None)
+                    static_key=(op,) if isinstance(op, Op) else None,
+                    ana={"reduction": reduction_name(op)})

@@ -47,17 +47,24 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
 
     def body(comm, arrays, token):
         from . import _algos
+        from ..analysis.hook import annotate
         from ..utils.config import collective_algo
 
         (xl,) = arrays
         size = comm.min_size()  # on a color split, root must fit EVERY group
         if not 0 <= root < size:
-            raise ValueError(f"bcast root {root} out of range for size {size}")
+            from ..analysis.report import mpx_error
+
+            raise mpx_error(
+                ValueError, "MPX105",
+                f"bcast root {root} out of range for size {size}",
+            )
         xl = consume(token, xl)
         rank = comm.Get_rank()
         log_op("MPI_Bcast", rank, f"{xl.size} items from root {root}")
         algo = collective_algo()
         if comm.groups is None and algo == "auto":
+            annotate(algo="native")
             # whole-axes fast path: one native AllReduce HLO
             if jnp.issubdtype(xl.dtype, jnp.bool_):
                 masked = jnp.where(rank == root, xl.astype(jnp.uint8), 0)
@@ -78,10 +85,12 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
                 algo, xl.size * xl.dtype.itemsize, k or 1,
                 ring_ok=k is not None and k > 1,
             )
+            annotate(algo=picked)
             if picked == "ring":
                 res = _algos.apply_vdg_bcast(xl, comm, root, k)
             else:
                 res = apply_doubling_bcast(xl, comm, root)
         return res, produce(token, res)
 
-    return dispatch("bcast", comm, body, (x,), token, static_key=(root,))
+    return dispatch("bcast", comm, body, (x,), token, static_key=(root,),
+                    ana={"root": root})

@@ -35,7 +35,12 @@ def gather(x, root: int, *, comm: Optional[Comm] = None,
         (xl,) = arrays
         size = comm.Get_size()
         if not 0 <= root < size:
-            raise ValueError(f"gather root {root} out of range for size {size}")
+            from ..analysis.report import mpx_error
+
+            raise mpx_error(
+                ValueError, "MPX105",
+                f"gather root {root} out of range for size {size}",
+            )
         xl = consume(token, xl)
         log_op("MPI_Gather", comm.Get_rank(),
                f"sending {xl.size} items to root {root}")
@@ -49,4 +54,5 @@ def gather(x, root: int, *, comm: Optional[Comm] = None,
             res = lax.all_gather(xl, comm.axes, axis=0, tiled=False)
         return res, produce(token, res)
 
-    return dispatch("gather", comm, body, (x,), token, static_key=(root,))
+    return dispatch("gather", comm, body, (x,), token, static_key=(root,),
+                    ana={"root": root})

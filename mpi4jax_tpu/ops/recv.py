@@ -45,12 +45,15 @@ def _check_recv_match(pending, template, source, comm):
             )
     if pending.value.dtype != template.dtype or (
             pending.value.size != template.size):
-        raise ValueError(
+        from ..analysis.report import mpx_error
+
+        raise mpx_error(
+            ValueError, "MPX106",
             f"recv: template shape/dtype {template.shape}/{template.dtype} "
             f"does not match sent {pending.value.shape}/"
             f"{pending.value.dtype} (shapes may differ only at equal "
             "element count; the output is typed by the template, ref "
-            "recv.py:246)"
+            "recv.py:246)",
         )
 
 
@@ -68,18 +71,27 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
         return _eager_recv(x, source, tag, c, status, token)
 
     def body(comm, arrays, token):
+        from ..analysis.hook import annotate
+        from ..analysis.report import mpx_error
+
         (template,) = arrays
         ctx = current_context()
         q = ctx.queue(comm.uid, tag)
         if not q:
-            raise RuntimeError(
+            raise mpx_error(
+                RuntimeError, "MPX102",
                 f"recv(tag={tag}): no matching send queued on this comm. "
                 "Under SPMD, the matching send must appear earlier in the "
                 "same parallel region (the reference would deadlock here at "
-                "run time; this framework turns it into a trace error)."
+                "run time; this framework turns it into a trace error).",
             )
+        if len(q) >= 2:
+            # FIFO will pick the oldest of several pending sends — the
+            # trace-time verifier surfaces this as an MPX110 advisory
+            annotate(queue_depth=len(q))
         pending = q.popleft()
         _check_recv_match(pending, template, source, comm)
+        annotate(pairs=pending.pairs)
         payload = as_varying(consume(token, pending.value), comm.axes)
         log_op("MPI_Recv", comm.Get_rank(),
                f"{payload.size} items along {list(pending.pairs)} (tag {tag})")
@@ -88,7 +100,7 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
         _fill_status(status, pairs, comm, payload.size, payload.dtype, tag)
         return res, produce(token, res)
 
-    return dispatch("recv", comm, body, (x,), token)
+    return dispatch("recv", comm, body, (x,), token, ana={"tag": tag})
 
 
 def _eager_recv(x, source, tag, comm, status, token):
@@ -98,14 +110,17 @@ def _eager_recv(x, source, tag, comm, status, token):
     ``x`` and the queued payload are GLOBAL arrays (leading axis = ranks,
     the eager convention); matching/validation mirrors the in-region path.
     """
+    from ..analysis.report import mpx_error
+
     q = _eager_queue(comm.uid, tag)
     if not q:
-        raise RuntimeError(
+        raise mpx_error(
+            RuntimeError, "MPX102",
             f"recv(tag={tag}): no matching eager send queued on this comm. "
             "Standalone eager recv pairs with a prior standalone eager send "
             "on the same comm and tag (the reference would block here until "
             "one arrived; this framework turns the missing-send case into "
-            "an immediate error)."
+            "an immediate error).",
         )
     # peek, don't pop: a recv that fails ANY argument check must not
     # consume the message (MPI semantics — the send stays matchable by a
@@ -124,6 +139,10 @@ def _eager_recv(x, source, tag, comm, status, token):
     pairs = pending.pairs  # GLOBAL (resolved by the send side)
 
     def body(comm, arrays, token):
+        from ..analysis.hook import annotate
+
+        if len(q) >= 2:
+            annotate(queue_depth=len(q))
         xl, template = arrays
         payload = consume(token, xl)
         log_op("MPI_Recv", comm.Get_rank(),
@@ -135,7 +154,8 @@ def _eager_recv(x, source, tag, comm, status, token):
     static_key = None if status is not None else (pairs, tag, "eager_pair")
     try:
         out = dispatch("recv", comm, body, (pending.value, x), token,
-                       static_key=static_key)
+                       static_key=static_key,
+                       ana={"tag": tag, "pairs": pairs})
     except jax.errors.UnexpectedTracerError as e:
         # backstop for liveness cases the proactive probe cannot see
         q.popleft()

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import Op, OpLike, apply_allreduce, dispatch
+from ._base import Op, OpLike, apply_allreduce, dispatch, reduction_name
 from .token import Token, consume, produce
 
 
@@ -38,7 +38,12 @@ def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None,
         (xl,) = arrays
         size = comm.min_size()  # on a color split, root must fit EVERY group
         if not 0 <= root < size:
-            raise ValueError(f"reduce root {root} out of range for size {size}")
+            from ..analysis.report import mpx_error
+
+            raise mpx_error(
+                ValueError, "MPX105",
+                f"reduce root {root} out of range for size {size}",
+            )
         xl = consume(token, xl)
         rank = comm.Get_rank()  # group-local on a color split, like the root
         log_op("MPI_Reduce", rank, f"{xl.size} items to root {root}")
@@ -47,4 +52,5 @@ def reduce(x, op: OpLike, root: int, *, comm: Optional[Comm] = None,
         return res, produce(token, res)
 
     return dispatch("reduce", comm, body, (x,), token,
-                    static_key=(op, root) if isinstance(op, Op) else None)
+                    static_key=(op, root) if isinstance(op, Op) else None,
+                    ana={"root": root, "reduction": reduction_name(op)})

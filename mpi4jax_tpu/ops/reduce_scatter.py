@@ -32,7 +32,7 @@ from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
 from ._algos import apply_reduce_scatter
-from ._base import SUM, Op, OpLike, dispatch
+from ._base import SUM, Op, OpLike, dispatch, reduction_name
 from .token import Token, consume, produce
 
 
@@ -66,4 +66,5 @@ def reduce_scatter(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
     # custom callable ops are uncacheable: their captured state can change
     # without changing identity (enum ops are pure values)
     return dispatch("reduce_scatter", comm, body, (x,), token,
-                    static_key=(op,) if isinstance(op, Op) else None)
+                    static_key=(op,) if isinstance(op, Op) else None,
+                    ana={"reduction": reduction_name(op)})

@@ -20,7 +20,15 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
-from ._base import SUM, Op, OpLike, _permute_axis, combine_fn, dispatch
+from ._base import (
+    SUM,
+    Op,
+    OpLike,
+    _permute_axis,
+    combine_fn,
+    dispatch,
+    reduction_name,
+)
 from .token import Token, consume, produce
 
 
@@ -65,4 +73,5 @@ def scan(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
         return acc, produce(token, acc)
 
     return dispatch("scan", comm, body, (x,), token,
-                    static_key=(op,) if isinstance(op, Op) else None)
+                    static_key=(op,) if isinstance(op, Op) else None,
+                    ana={"reduction": reduction_name(op)})

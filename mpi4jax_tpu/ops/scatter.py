@@ -33,7 +33,12 @@ def scatter(x, root: int, *, comm: Optional[Comm] = None,
         (xl,) = arrays
         size = comm.Get_size()
         if not 0 <= root < size:
-            raise ValueError(f"scatter root {root} out of range for size {size}")
+            from ..analysis.report import mpx_error
+
+            raise mpx_error(
+                ValueError, "MPX105",
+                f"scatter root {root} out of range for size {size}",
+            )
         if xl.ndim == 0 or xl.shape[0] != size:
             raise ValueError(
                 f"scatter input must have leading axis == comm size ({size}), "
@@ -58,4 +63,5 @@ def scatter(x, root: int, *, comm: Optional[Comm] = None,
             res = exchanged[root]
         return res, produce(token, res)
 
-    return dispatch("scatter", comm, body, (x,), token, static_key=(root,))
+    return dispatch("scatter", comm, body, (x,), token, static_key=(root,),
+                    ana={"root": root})

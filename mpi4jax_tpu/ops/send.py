@@ -61,13 +61,16 @@ def check_eager_drained() -> None:
     ``flush()`` (and thus at interpreter exit)."""
     leftover = {k: len(q) for k, q in _eager_sends.items() if q}
     if leftover:
-        raise RuntimeError(
+        from ..analysis.report import mpx_error
+
+        raise mpx_error(
+            RuntimeError, "MPX101",
             f"unmatched eager send(s) at flush/exit: "
             f"{{(comm_uid, tag): count}} = {leftover}. Every standalone "
             "eager send must be matched by an eager recv on the same comm "
             "and tag before flush/exit (deferred pairing: the transfer only "
             "happens at the recv; the reference's blocking send would "
-            "deadlock here instead)."
+            "deadlock here instead).",
         )
 
 
@@ -104,8 +107,11 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
         return token if token is not None else create_token()
 
     def body(comm, arrays, token):
+        from ..analysis.hook import annotate
+
         (xl,) = arrays
         pairs = resolve_routing(comm, None, dest, what="send")  # GLOBAL
+        annotate(pairs=pairs)
         xl = consume(token, xl)
         log_op("MPI_Send", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)} (tag {tag})")
@@ -113,5 +119,5 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
         ctx.queue(comm.uid, tag).append(PendingSend(xl, pairs, token))
         return (produce(token, xl),)
 
-    out = dispatch("send", comm, body, (x,), token)
+    out = dispatch("send", comm, body, (x,), token, ana={"tag": tag})
     return out[0]

@@ -113,18 +113,22 @@ def sendrecv(
     ``Status.tag`` reports ``sendtag`` — the tag the message was actually
     sent with.
     """
+    from ..analysis.report import mpx_error
+
     if sendbuf.dtype != recvbuf.dtype:
-        raise ValueError(
+        raise mpx_error(
+            ValueError, "MPX106",
             f"sendrecv requires matching send/recv dtypes (MPI type-signature "
-            f"rule); got {sendbuf.dtype} vs {recvbuf.dtype}"
+            f"rule); got {sendbuf.dtype} vs {recvbuf.dtype}",
         )
     if sendbuf.shape != recvbuf.shape and sendbuf.size != recvbuf.size:
-        raise ValueError(
+        raise mpx_error(
+            ValueError, "MPX106",
             f"sendrecv: send/recv buffers may differ in shape only when their "
             f"element counts match (the output is typed by recvbuf, ref "
             f"sendrecv.py:369; under SPMD every rank's recv shape is the same "
             f"static recvbuf shape, so mismatched counts cannot be routed); "
-            f"got {sendbuf.shape} vs {recvbuf.shape}. See docs/sharp_bits.md."
+            f"got {sendbuf.shape} vs {recvbuf.shape}. See docs/sharp_bits.md.",
         )
 
     # Eager-path caching: resolve the routing spec to concrete pairs ONCE,
@@ -146,10 +150,13 @@ def sendrecv(
             static_key = (resolved_pairs, sendtag, recvtag)
 
     def body(comm, arrays, token):
+        from ..analysis.hook import annotate
+
         xl, rbuf = arrays
         pairs = resolved_pairs
         if pairs is None:  # in-region: resolve at trace time, already GLOBAL
             pairs = resolve_routing(comm, source, dest, what="sendrecv")
+        annotate(pairs=pairs)
         xl = consume(token, xl)
         log_op("MPI_Sendrecv", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)}")
@@ -158,5 +165,6 @@ def sendrecv(
         return res, produce(token, res)
 
     return dispatch(
-        "sendrecv", comm, body, (sendbuf, recvbuf), token, static_key=static_key
+        "sendrecv", comm, body, (sendbuf, recvbuf), token,
+        static_key=static_key, ana={"tag": sendtag},
     )

@@ -25,6 +25,16 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 
+def _is_tracer(x) -> bool:
+    """Duck-typed tracer probe (jax stays an optional import here so the
+    routing layer remains loadable in pure-Python contexts)."""
+    try:
+        import jax.core
+    except ImportError:  # pragma: no cover - jax is always present in prod
+        return False
+    return isinstance(x, jax.core.Tracer)
+
+
 class shift:
     """Ring (or edge-stopping) shift pattern: rank ``r`` sends to ``r + k``.
 
@@ -67,19 +77,30 @@ def normalize_dest(spec: RankSpecLike, size: int, *,
     Validates that the pairs form a partial permutation (no duplicate sources
     or destinations) — the contract ``CollectivePermute`` requires.
     """
+    from ..analysis.report import mpx_error
+
     if spec is None:
         raise ValueError(
             f"{what}: routing spec is required here (got None). Under SPMD, "
             "point-to-point routing describes all ranks at once; use "
             "shift(k), a {src: dst} dict, or [(src, dst), ...] pairs."
         )
+    if _is_tracer(spec):
+        raise mpx_error(
+            TypeError, "MPX104",
+            f"{what}: routing spec was a JAX tracer. Routing is structure — "
+            "it must be static Python values known at trace time (one SPMD "
+            "program serves all ranks); if you are passing it through jit, "
+            "mark it static (static_argnums).",
+        )
     if isinstance(spec, int):
-        raise TypeError(
+        raise mpx_error(
+            TypeError, "MPX103",
             f"{what}: a bare int rank is ambiguous under SPMD (every rank "
             "executes the same program, so 'dest=1' would mean all ranks send "
             "to rank 1 — not a valid permutation). Describe the full pattern: "
             "pairs=[(0, 1)] for a single message, shift(k) for rings, or a "
-            "{src: dst} dict."
+            "{src: dst} dict.",
         )
     pairs: List[Tuple[int, int]]
     if isinstance(spec, shift):

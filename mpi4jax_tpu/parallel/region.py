@@ -46,6 +46,9 @@ class RegionContext:
         # region's outputs) consumes it, so the synchronizing collective is
         # never dead-code-eliminated and subsequent ops are ordered after it.
         self.pending_sync = None
+        # env-mode collective verifier sink, armed by analysis.hook when
+        # MPI4JAX_TPU_ANALYZE != off (None otherwise — zero overhead)
+        self.analysis_recorder = None
 
     def queue(self, comm_uid: int, tag: int) -> deque:
         return self.send_queues.setdefault((comm_uid, tag), deque())
@@ -53,12 +56,15 @@ class RegionContext:
     def check_drained(self) -> None:
         leftover = {k: len(q) for k, q in self.send_queues.items() if q}
         if leftover:
-            raise RuntimeError(
+            from ..analysis.report import mpx_error
+
+            raise mpx_error(
+                RuntimeError, "MPX101",
                 f"parallel region ended with unmatched send(s): "
                 f"{{(comm_uid, tag): count}} = {leftover}. Every send must be "
                 "matched by a recv on the same comm and tag within the same "
-                "region (the SPMD analog of the reference's matched-pair "
-                "requirement)."
+                "region (matching is FIFO per (comm, tag); the SPMD analog "
+                "of the reference's matched-pair requirement).",
             )
 
 
@@ -186,6 +192,7 @@ def spmd(
             # the key (mirrors _eager_cache in ops/_base.py), or toggling
             # tracing/logging/prefer_notoken after the first call would
             # silently keep serving the stale compiled program
+            from ..analysis.hook import analysis_cache_token
             from ..ops._algos import algo_cache_token
             from ..resilience.runtime import cache_token as resilience_token
             from ..utils.config import prefer_notoken
@@ -193,7 +200,8 @@ def spmd(
 
             key = (c.mesh, c.uid, statics, static_vals, kw_names, n_dyn,
                    get_runtime_tracing(), get_logging(), prefer_notoken(),
-                   resilience_token(), algo_cache_token())
+                   resilience_token(), algo_cache_token(),
+                   analysis_cache_token())
             sm = program_cache.get(key)
             if sm is None:
                 axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])
@@ -208,7 +216,10 @@ def spmd(
                 squeeze_out = out_specs is None
 
                 def body(*a):
+                    from ..analysis import hook as _analysis
+
                     ctx = RegionContext(c)
+                    _analysis.arm_context(ctx)
                     _region_stack.append(ctx)
                     try:
                         if squeeze_in:
@@ -231,6 +242,9 @@ def spmd(
                         if squeeze_out:
                             out = jax.tree.map(lambda v: v[None], out)
                         ctx.check_drained()
+                        _analysis.finish_context(
+                            ctx, f"spmd region {getattr(f, '__name__', f)!s}"
+                        )
                         return out
                     finally:
                         _region_stack.pop()
@@ -243,6 +257,15 @@ def spmd(
                 program_cache[key] = sm
             return sm(*dyn_args, *(kwargs[k] for k in kw_names))
 
+        # breadcrumbs for mpx.analyze: it rebuilds an UN-jitted twin from
+        # the underlying per-rank function, because jit's trace cache
+        # would otherwise serve a cached jaxpr and record no events
+        wrapped._mpx_spmd = True
+        wrapped._mpx_fn = f
+        wrapped._mpx_spmd_kwargs = dict(
+            comm=comm, in_specs=in_specs, out_specs=out_specs,
+            static_argnums=statics_raw,
+        )
         return wrapped
 
     if fn is not None:

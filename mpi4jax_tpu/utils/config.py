@@ -1,45 +1,106 @@
-"""Environment-variable flag system.
+"""Environment-variable flag system: the declared registry.
 
 TPU-native analog of the reference's env-flag configuration
 (ref: mpi4jax/_src/decorators.py:29-34 truthy parser; mpi4jax/_src/utils.py:175-177
 ``MPI4JAX_PREFER_NOTOKEN``; mpi4jax/_src/xla_bridge/__init__.py:24-28
 ``MPI4JAX_DEBUG``).
 
-Recognized variables:
-
-- ``MPI4JAX_TPU_DEBUG``     — per-op debug logging (``r{rank} | {id} | …`` format).
-- ``MPI4JAX_TPU_TRACE``     — native runtime op tracing: host-side begin/end
-  log lines with measured wall-clock latency per collective, via the C++
-  host-hooks library (CPU backend; see mpi4jax_tpu/native.py).
-- ``MPI4JAX_TPU_PREFER_NOTOKEN`` — make the token API delegate to the notoken
-  (implicit-ordering) implementation.
-- ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the JAX version advisory.
-- ``MPI4JAX_TPU_WATCHDOG_TIMEOUT`` — collective watchdog (resilience/watchdog.py):
-  seconds a single collective may stay in flight before the process is killed
-  with per-rank in-flight-op diagnostics.  Unset/0 disables (default).
-- ``MPI4JAX_TPU_FAULT_SPEC`` — deterministic fault injection
-  (resilience/faultinject.py): semicolon-separated clauses, e.g.
-  ``delay:rank=1:op=allreduce:after=3:secs=2``, ``die:rank=0:op=barrier:after=1``,
-  ``corrupt:nan:rank=2:op=allreduce``.  Empty disables (default).
-- ``MPI4JAX_TPU_CHECK_NUMERICS`` — abort (via the ``abort_if`` fail-fast path)
-  when a collective's inputs or outputs contain NaN/Inf, naming the op.
-  Off by default; when off, the lowered HLO is byte-identical to a build
-  without the guards (resilience/numerics.py).
-- ``MPI4JAX_TPU_COLLECTIVE_ALGO`` — ``auto`` (default) / ``butterfly`` /
-  ``ring``: the reduction-family algorithm (ops/_algos.py).  ``auto`` picks
-  per call from static payload bytes and group size; the explicit values
-  force one lowering (benchmarks, equivalence tests, escape hatch).
-- ``MPI4JAX_TPU_RING_CROSSOVER_BYTES`` — payload size (bytes) at which
-  ``auto`` switches from the log-depth butterfly to the bandwidth-optimal
-  ring lowerings.  Default 1 MiB.
+Every ``MPI4JAX_TPU_*`` variable the library reads is DECLARED in
+``FLAGS`` below (name, type, default, docstring) and read through
+``_getenv`` — reading an undeclared flag raises here at runtime, and the
+in-repo lint pack (tests/test_lint.py) statically rejects any
+``os.environ``/``os.getenv``/``parse_env_*`` read of an undeclared
+``MPI4JAX_TPU_*`` name anywhere under ``mpi4jax_tpu/``.  The same lint
+asserts every declared flag is documented in the docs flag tables
+(docs/usage.md / docs/resilience.md).
 """
 
 import math
 import os
-from typing import Optional
+from typing import NamedTuple, Optional, Tuple
+
+
+class Flag(NamedTuple):
+    """One declared environment flag."""
+
+    name: str
+    type: str            # "bool" | "float" | "int" | "str" | "choice"
+    default: object
+    doc: str
+    choices: Optional[Tuple[str, ...]] = None
+
+
+ANALYZE_MODES = ("off", "warn", "error")
+COLLECTIVE_ALGOS = ("auto", "butterfly", "ring")
+
+# default ring/butterfly crossover: 1 MiB — below it the butterfly's
+# ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
+# the ring's O(size) vs O(size·log k) byte volume dominates.  Measured per
+# platform by ``benchmarks/micro.py --save`` (docs/microbenchmarks.md).
+DEFAULT_RING_CROSSOVER_BYTES = 1 << 20
+
+FLAGS = {
+    f.name: f
+    for f in (
+        Flag("MPI4JAX_TPU_DEBUG", "bool", False,
+             "Per-op debug logging (``r{rank} | {id} | ...`` format)."),
+        Flag("MPI4JAX_TPU_TRACE", "bool", False,
+             "Native runtime op tracing: host-side begin/end log lines "
+             "with measured wall-clock latency per collective, via the "
+             "C++ host-hooks library (see mpi4jax_tpu/native.py)."),
+        Flag("MPI4JAX_TPU_PREFER_NOTOKEN", "bool", False,
+             "Make the token API delegate to the notoken "
+             "(implicit-ordering) implementation."),
+        Flag("MPI4JAX_TPU_NO_WARN_JAX_VERSION", "bool", False,
+             "Silence the JAX version advisory."),
+        Flag("MPI4JAX_TPU_WATCHDOG_TIMEOUT", "float", None,
+             "Collective watchdog (resilience/watchdog.py): seconds a "
+             "single collective may stay in flight before the process is "
+             "killed with per-rank in-flight-op diagnostics.  Unset/0 "
+             "disables."),
+        Flag("MPI4JAX_TPU_FAULT_SPEC", "str", "",
+             "Deterministic fault injection (resilience/faultinject.py): "
+             "semicolon-separated clauses, e.g. "
+             "``delay:rank=1:op=allreduce:after=3:secs=2``.  Empty "
+             "disables."),
+        Flag("MPI4JAX_TPU_CHECK_NUMERICS", "bool", False,
+             "Abort (via the ``abort_if`` fail-fast path) when a "
+             "collective's inputs or outputs contain NaN/Inf, naming the "
+             "op (resilience/numerics.py).  When off, the lowered HLO is "
+             "byte-identical to a build without the guards."),
+        Flag("MPI4JAX_TPU_COLLECTIVE_ALGO", "choice", "auto",
+             "Reduction-family algorithm (ops/_algos.py): ``auto`` picks "
+             "per call from static payload bytes and group size; "
+             "``butterfly``/``ring`` force one lowering.",
+             choices=COLLECTIVE_ALGOS),
+        Flag("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "int",
+             DEFAULT_RING_CROSSOVER_BYTES,
+             "Payload size (bytes) at which ``auto`` switches from the "
+             "log-depth butterfly to the bandwidth-optimal ring "
+             "lowerings.  Default 1 MiB."),
+        Flag("MPI4JAX_TPU_ANALYZE", "choice", "off",
+             "Trace-time collective verifier (analysis/): ``warn`` runs "
+             "the MPX checkers over every spmd region / eager op as it "
+             "traces and warns on findings; ``error`` raises "
+             "``AnalysisError`` instead.  ``off`` (default) records "
+             "nothing; the lowered HLO is byte-identical in every mode.",
+             choices=ANALYZE_MODES),
+    )
+}
 
 TRUTHY = ("true", "1", "on", "yes")
 FALSY = ("false", "0", "off", "no", "")
+
+
+def _getenv(name: str) -> Optional[str]:
+    """The single environment read point: the flag must be declared."""
+    if name not in FLAGS:
+        raise RuntimeError(
+            f"environment flag {name} is not declared in "
+            "mpi4jax_tpu.utils.config.FLAGS; declare it (name, type, "
+            "default, docstring) before reading it"
+        )
+    return os.environ.get(name)
 
 
 def parse_env_bool(name: str, default: bool = False) -> bool:
@@ -48,7 +109,7 @@ def parse_env_bool(name: str, default: bool = False) -> bool:
     Raises ``ValueError`` on unrecognized values, like the reference's
     truthy/falsy parser (ref: mpi4jax/_src/decorators.py:29-34).
     """
-    raw = os.environ.get(name)
+    raw = _getenv(name)
     if raw is None:
         return default
     val = raw.lower().strip()
@@ -73,7 +134,7 @@ def trace_enabled() -> bool:
 def parse_env_float(name: str, default: Optional[float] = None) -> Optional[float]:
     """Parse a non-negative finite float environment variable (empty/unset ->
     ``default``)."""
-    raw = os.environ.get(name)
+    raw = _getenv(name)
     if raw is None or not raw.strip():
         return default
     try:
@@ -90,6 +151,21 @@ def parse_env_float(name: str, default: Optional[float] = None) -> Optional[floa
         raise ValueError(
             f"Environment variable {name}={raw!r} must be a finite "
             "number >= 0"
+        )
+    return val
+
+
+def _parse_env_choice(name: str) -> str:
+    """Parse a declared choice-typed flag (empty/unset -> default)."""
+    flag = FLAGS[name]
+    raw = _getenv(name)
+    if raw is None or not raw.strip():
+        return flag.default
+    val = raw.lower().strip()
+    if val not in flag.choices:
+        raise ValueError(
+            f"Environment variable {name}={raw!r} must be one of "
+            f"{flag.choices}"
         )
     return val
 
@@ -112,22 +188,13 @@ def fault_spec() -> str:
     Parsed by ``mpi4jax_tpu.resilience.parse_fault_spec`` (grammar in
     docs/resilience.md).
     """
-    return os.environ.get("MPI4JAX_TPU_FAULT_SPEC", "").strip()
+    return (_getenv("MPI4JAX_TPU_FAULT_SPEC") or "").strip()
 
 
 def check_numerics() -> bool:
     """Whether collectives guard their inputs/outputs against NaN/Inf
     (``MPI4JAX_TPU_CHECK_NUMERICS``; see mpi4jax_tpu/resilience/numerics.py)."""
     return parse_env_bool("MPI4JAX_TPU_CHECK_NUMERICS", False)
-
-
-COLLECTIVE_ALGOS = ("auto", "butterfly", "ring")
-
-# default ring/butterfly crossover: 1 MiB — below it the butterfly's
-# ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
-# the ring's O(size) vs O(size·log k) byte volume dominates.  Measured per
-# platform by ``benchmarks/micro.py --save`` (docs/microbenchmarks.md).
-DEFAULT_RING_CROSSOVER_BYTES = 1 << 20
 
 
 def collective_algo() -> str:
@@ -137,22 +204,13 @@ def collective_algo() -> str:
     bytes and group size (ops/_algos.py).  ``butterfly`` / ``ring`` force
     one lowering everywhere it is expressible.
     """
-    raw = os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO")
-    if raw is None or not raw.strip():
-        return "auto"
-    val = raw.lower().strip()
-    if val not in COLLECTIVE_ALGOS:
-        raise ValueError(
-            f"Environment variable MPI4JAX_TPU_COLLECTIVE_ALGO={raw!r} must "
-            f"be one of {COLLECTIVE_ALGOS}"
-        )
-    return val
+    return _parse_env_choice("MPI4JAX_TPU_COLLECTIVE_ALGO")
 
 
 def ring_crossover_bytes() -> int:
     """Payload bytes at which ``auto`` prefers the ring lowerings
     (``MPI4JAX_TPU_RING_CROSSOVER_BYTES``; default 1 MiB)."""
-    raw = os.environ.get("MPI4JAX_TPU_RING_CROSSOVER_BYTES")
+    raw = _getenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES")
     if raw is None or not raw.strip():
         return DEFAULT_RING_CROSSOVER_BYTES
     try:
@@ -168,6 +226,12 @@ def ring_crossover_bytes() -> int:
             "must be >= 0"
         )
     return val
+
+
+def analyze_mode() -> str:
+    """Trace-time collective verifier mode (``MPI4JAX_TPU_ANALYZE``):
+    ``off`` (default) / ``warn`` / ``error`` — see mpi4jax_tpu/analysis/."""
+    return _parse_env_choice("MPI4JAX_TPU_ANALYZE")
 
 
 def prefer_notoken() -> bool:

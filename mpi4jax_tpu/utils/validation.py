@@ -62,13 +62,16 @@ def enforce_types(**type_specs):
                     # tracer" error. In this framework rank-valued tracers are
                     # fine for data, but structural args (roots, tags) must be
                     # static Python values.
-                    raise TypeError(
+                    from ..analysis.report import mpx_error
+
+                    raise mpx_error(
+                        TypeError, "MPX104",
                         f"{fn.__name__}: argument {name!r} was a JAX tracer "
                         f"(expected static {_type_name(spec)}). Structural "
                         "arguments like roots, tags, and routing specs must be "
                         "Python values known at trace time; if you are passing "
                         "them through jit, mark them static "
-                        "(e.g. static_argnums)."
+                        "(e.g. static_argnums).",
                     )
                 raise TypeError(
                     f"{fn.__name__}: argument {name!r} has wrong type "

@@ -1,0 +1,375 @@
+"""Trace-time verifier: the traced integration half (docs/analysis.md).
+
+Every hazard program docs/sharp_bits.md can express today — unmatched
+send, bare-int dest, traced root, out-of-range root, dropped token,
+signature mismatch, cond divergence, crossover proximity, ambiguous
+FIFO — reproduced as a fixture and driven through BOTH front-ends:
+
+- ``mpx.analyze`` (abstract re-trace, findings as a Report);
+- the ``MPI4JAX_TPU_ANALYZE=error`` dispatch path (trace-time raise).
+
+Plus the zero-cost contract (HLO byte-identical across modes) and the
+``clear_caches`` retrace test mirroring the PR-2 algo-toggle test.
+The pure-Python checker half lives in tests/test_analysis_pure.py.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from helpers import ranks_arange, world
+
+
+@pytest.fixture(autouse=True)
+def _reset_analysis(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE", raising=False)
+    yield
+    mpx.set_analyze_mode(None)
+    mpx.clear_caches()
+
+
+def codes(report):
+    return [f.code for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# hazard fixtures (one per sharp bit), through mpx.analyze
+# ---------------------------------------------------------------------------
+
+
+def fx_unmatched_send(x):
+    mpx.send(x, dest=mpx.shift(1))
+    return x
+
+
+def fx_recv_without_send(x):
+    y, _ = mpx.recv(x)
+    return y
+
+
+def fx_bare_int_dest(x):
+    y, _ = mpx.sendrecv(x, x, dest=1)
+    return y
+
+
+def fx_traced_root(x):
+    comm = mpx.get_default_comm()
+    res, _ = mpx.bcast(x, comm.Get_rank())  # traced value as structure
+    return res
+
+
+def fx_root_out_of_range(x):
+    res, _ = mpx.bcast(x, 17)
+    return res
+
+
+def fx_signature_mismatch(x):
+    y, _ = mpx.sendrecv(x, x.astype(jnp.int32), dest=mpx.shift(1))
+    return y
+
+
+def fx_dropped_token(x):
+    t = mpx.create_token()
+    a, t1 = mpx.allreduce(x, token=t)
+    b, t2 = mpx.allreduce(x * 2, token=t)  # forked from t: t1 is dropped
+    return a + b
+
+
+def fx_ambiguous_fifo(x):
+    t1 = mpx.send(x, dest=mpx.shift(1))
+    t2 = mpx.send(x * 2, dest=mpx.shift(1), token=t1)
+    a, _ = mpx.recv(x, token=t2)
+    b, _ = mpx.recv(x, token=t2)
+    return a + b
+
+
+def fx_clean(x):
+    t = mpx.create_token()
+    a, t = mpx.allreduce(x, token=t)
+    b, t = mpx.sendrecv(a, a, dest=mpx.shift(1), token=t)
+    return b
+
+
+HAZARDS = [
+    (fx_unmatched_send, "MPX101", "unmatched send"),
+    (fx_recv_without_send, "MPX102", "no matching send"),
+    (fx_bare_int_dest, "MPX103", "bare int"),
+    (fx_traced_root, "MPX104", "tracer"),
+    (fx_root_out_of_range, "MPX105", "out of range"),
+    (fx_signature_mismatch, "MPX106", "dtypes"),
+    (fx_dropped_token, "MPX107", "older token"),
+    (fx_ambiguous_fifo, "MPX110", "FIFO"),
+]
+
+
+@pytest.mark.parametrize("fn,code,fragment", HAZARDS,
+                         ids=[h[1] for h in HAZARDS])
+def test_hazard_fixture_flagged_by_analyze(fn, code, fragment):
+    report = mpx.analyze(fn, ranks_arange((4,)))
+    # exactly one finding per defect: a trace-aborting hazard must not be
+    # double-reported by the graph checkers replaying the same events
+    assert codes(report).count(code) == 1, report.render()
+    finding = next(f for f in report.findings if f.code == code)
+    assert fragment in finding.message
+    rendered = report.render()
+    assert code in rendered
+
+
+@pytest.mark.parametrize("fn,code,fragment", HAZARDS,
+                         ids=[h[1] for h in HAZARDS])
+def test_hazard_fixture_flagged_by_dispatch_env_mode(fn, code, fragment):
+    """The same fixtures through the ambient MPI4JAX_TPU_ANALYZE=error
+    path: structural hazards raise their tagged exception at trace time;
+    stream hazards raise AnalysisError when the region's trace completes."""
+    mpx.set_analyze_mode("error")
+    x = ranks_arange((4,))
+    with pytest.raises(Exception, match=code) as ei:
+        np.asarray(mpx.run(fn, x))
+    exc = ei.value
+    assert getattr(exc, "mpx_code", None) == code or isinstance(
+        exc, mpx.AnalysisError)
+
+
+def test_clean_program_analyzes_clean():
+    report = mpx.analyze(fx_clean, ranks_arange((4,)))
+    assert report.ok, report.render()
+    assert len(report.events) == 2  # allreduce + sendrecv
+    assert "clean" in report.render()
+
+
+def test_clean_program_runs_under_error_mode():
+    _, size = world()
+    mpx.set_analyze_mode("error")
+    out = np.asarray(mpx.run(fx_clean, ranks_arange((4,))))
+    assert out.shape == (size, 4)
+
+
+def test_warn_mode_warns_instead_of_raising():
+    mpx.set_analyze_mode("warn")
+    with pytest.warns(UserWarning, match="MPX107"):
+        out = mpx.run(fx_dropped_token, ranks_arange((4,)))
+    assert np.asarray(out).shape == (world()[1], 4)
+
+
+# ---------------------------------------------------------------------------
+# MPX108: cond divergence (jaxpr walker, analyze-only)
+# ---------------------------------------------------------------------------
+
+
+def test_mpx108_cond_divergence_flagged():
+    def f(x):
+        def talk(v):
+            s, _ = mpx.allreduce(v, op=mpx.SUM)
+            return mpx.varying(s)
+
+        def quiet(v):
+            return v
+
+        return jax.lax.cond(x.sum() > 0, talk, quiet, x)
+
+    report = mpx.analyze(f, ranks_arange((4,)))
+    assert "MPX108" in codes(report), report.render()
+    finding = next(f for f in report.findings if f.code == "MPX108")
+    assert "disagree" in finding.message
+
+
+def test_mpx108_negative_both_branches_communicate():
+    def f(x):
+        def a(v):
+            s, _ = mpx.allreduce(v, op=mpx.SUM)
+            return mpx.varying(s)
+
+        def b(v):
+            s, _ = mpx.allreduce(v, op=mpx.MAX)
+            return mpx.varying(s)
+
+        return jax.lax.cond(x.sum() > 0, a, b, x)
+
+    report = mpx.analyze(f, ranks_arange((4,)))
+    assert "MPX108" not in codes(report), report.render()
+
+
+# ---------------------------------------------------------------------------
+# MPX109: crossover proximity (payload-aware selector advisory)
+# ---------------------------------------------------------------------------
+
+
+def _prod_reduce(x):
+    # PROD has no native HLO collective, so the payload-aware selector
+    # (ops/_algos.py) is consulted and the event carries the chosen algo
+    res, _ = mpx.allreduce(x, op=mpx.PROD)
+    return res
+
+
+def test_mpx109_near_crossover_advisory(monkeypatch):
+    _, size = world()
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "4096")
+    x = ranks_arange((1024,))  # 4096 B/rank: exactly at the crossover
+    report = mpx.analyze(_prod_reduce, x)
+    assert codes(report) == ["MPX109"], report.render()
+    (f,) = report.findings
+    assert f.severity == "advisory"
+    assert "within 2x" in f.message
+
+    mpx.set_analyze_mode("error")
+    with pytest.raises(mpx.AnalysisError, match="MPX109"):
+        mpx.run(_prod_reduce, x)
+
+
+def test_mpx109_negative_far_from_crossover(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", str(1 << 24))
+    report = mpx.analyze(_prod_reduce, ranks_arange((8,)))
+    assert report.ok, report.render()
+    (evt,) = report.events
+    assert evt.algo == "butterfly"  # selector consulted, advisory silent
+
+
+def test_mpx109_forced_algo_is_deterministic_hence_clean(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "4096")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    report = mpx.analyze(_prod_reduce, ranks_arange((1024,)))
+    assert report.ok, report.render()
+
+
+# ---------------------------------------------------------------------------
+# the event stream (graph extraction)
+# ---------------------------------------------------------------------------
+
+
+def test_event_stream_records_structure():
+    _, size = world()
+
+    def f(x):
+        a, t = mpx.bcast(x, 2)
+        b, t = mpx.sendrecv(a, a, dest=mpx.shift(1), sendtag=7, token=t)
+        c, t = mpx.allreduce(b, op=mpx.SUM, token=t)
+        return c
+
+    report = mpx.analyze(f, ranks_arange((4,)))
+    assert report.ok, report.render()
+    bcast_e, sr_e, ar_e = report.events
+    assert (bcast_e.op, bcast_e.root) == ("bcast", 2)
+    assert bcast_e.comm_size == size and not bcast_e.split
+    assert sr_e.op == "sendrecv" and sr_e.tag == 7
+    assert sr_e.pairs == tuple(((r, (r + 1) % size) for r in range(size)))
+    assert ar_e.reduction == "sum"
+    assert ar_e.algo == "native"
+    assert ar_e.payload_bytes == 4 * 4
+    # the token chain is linear: each op consumes the previous token
+    assert sr_e.token_in == bcast_e.token_out
+    assert ar_e.token_in == sr_e.token_out
+
+
+def test_analyze_spmd_decorated_function():
+    @mpx.spmd
+    def step(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM)
+        return res
+
+    # the decorated wrapper is analyzed via its underlying per-rank body
+    # (jit caches cannot hide ops from the verifier) — even AFTER a real
+    # call populated the jit caches
+    x = ranks_arange((4,))
+    np.asarray(step(x))
+    report = mpx.analyze(step, x)
+    assert report.ok
+    assert [e.op for e in report.events] == ["allreduce"]
+
+
+def test_analyze_eager_style_function():
+    x = ranks_arange((4,))
+
+    def eager(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM)
+        return res
+
+    report = mpx.analyze(eager, x, wrap=False)
+    assert report.ok
+    assert [e.op for e in report.events] == ["allreduce"]
+    assert report.events[0].eager
+
+
+def test_eager_dispatch_env_mode(monkeypatch):
+    """The ambient mode covers eager one-op programs too, and flipping the
+    mode retraces (the mode is folded into the eager cache key)."""
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "4096")
+    x = ranks_arange((1024,))
+    # populate the off-mode cache first: the error-mode flip must not be
+    # hidden by the cached program
+    np.asarray(mpx.allreduce(x, op=mpx.PROD)[0])
+    mpx.set_analyze_mode("error")
+    with pytest.raises(mpx.AnalysisError, match="MPX109"):
+        mpx.allreduce(x, op=mpx.PROD)
+
+
+# ---------------------------------------------------------------------------
+# zero-cost contract + caches
+# ---------------------------------------------------------------------------
+
+
+def test_hlo_byte_identical_across_modes():
+    """The acceptance-criteria pin: recording is host-side bookkeeping, so
+    the lowered HLO with the verifier off is byte-identical to warn and
+    error modes (off-mode lowering == seed lowering by construction: the
+    traced program contains no analysis code in any mode)."""
+    x = ranks_arange((16,))
+
+    def lowered():
+        @mpx.spmd
+        def f(xl):
+            a, t = mpx.allreduce(xl, op=mpx.SUM)
+            b, t = mpx.sendrecv(a, a, dest=mpx.shift(1), token=t)
+            return b
+
+        return jax.jit(f).lower(x).as_text()
+
+    mpx.set_analyze_mode(None)
+    off = lowered()
+    mpx.set_analyze_mode("warn")
+    assert lowered() == off
+    mpx.set_analyze_mode("error")
+    assert lowered() == off
+
+
+def test_analyze_memo_and_clear_caches(monkeypatch):
+    """Mirrors the PR-2 algo-toggle retrace test: the analyze memo must be
+    keyed on the algorithm config (a crossover flip changes the verdict
+    without clear_caches) and mpx.clear_caches() must drop the memo."""
+    x = ranks_arange((1024,))
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", str(1 << 24))
+    r1 = mpx.analyze(_prod_reduce, x)
+    assert r1.ok
+    assert mpx.analyze(_prod_reduce, x) is r1  # memoized
+    # flipping the crossover must re-analyze (config is in the memo key),
+    # and the same payload now sits at the crossover: advisory fires
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "4096")
+    r2 = mpx.analyze(_prod_reduce, x)
+    assert r2 is not r1
+    assert codes(r2) == ["MPX109"]
+    # clear_caches drops the memo: same config, fresh report object
+    r3 = mpx.analyze(_prod_reduce, x)
+    assert r3 is r2
+    mpx.clear_caches()
+    r4 = mpx.analyze(_prod_reduce, x)
+    assert r4 is not r2 and codes(r4) == ["MPX109"]
+
+
+def test_off_mode_records_nothing():
+    """With the verifier off (default), regions carry no recorder and no
+    events — the zero-overhead contract for the hot path."""
+    from mpi4jax_tpu.parallel.region import RegionContext
+
+    assert RegionContext(None).analysis_recorder is None
+    mpx.set_analyze_mode(None)
+    # a hazard program traces fine with the verifier off (seed behavior:
+    # MPX107/109/110 were never hard errors)
+    with warnings.catch_warnings():
+        # any verifier warning would fail the test (jax's own unrelated
+        # warnings are left alone)
+        warnings.filterwarnings("error", message=".*MPI4JAX_TPU_ANALYZE.*")
+        out = mpx.run(fx_dropped_token, ranks_arange((4,)))
+    assert np.asarray(out).shape == (world()[1], 4)

@@ -132,7 +132,15 @@ def test_unsupported_dtype():
 
 
 def test_parse_env_bool(monkeypatch):
-    # ref tests/test_decorators.py truthy-env parsing
+    # ref tests/test_decorators.py truthy-env parsing.  Reads go through
+    # the declared-flag registry (utils/config.py FLAGS), so the probe
+    # flag is declared for the duration of the test.
+    from mpi4jax_tpu.utils import config as _config
+
+    monkeypatch.setitem(
+        _config.FLAGS, "MPI4JAX_TPU_TESTFLAG",
+        _config.Flag("MPI4JAX_TPU_TESTFLAG", "bool", False, "test probe"),
+    )
     for v in ("1", "true", "ON", "yes"):
         monkeypatch.setenv("MPI4JAX_TPU_TESTFLAG", v)
         assert parse_env_bool("MPI4JAX_TPU_TESTFLAG") is True
@@ -144,6 +152,13 @@ def test_parse_env_bool(monkeypatch):
         parse_env_bool("MPI4JAX_TPU_TESTFLAG")
     monkeypatch.delenv("MPI4JAX_TPU_TESTFLAG")
     assert parse_env_bool("MPI4JAX_TPU_TESTFLAG", True) is True
+
+
+def test_undeclared_flag_read_raises(monkeypatch):
+    # the registry is the single read point: undeclared MPI4JAX_TPU_*
+    # reads fail loudly (and are a lint failure — tests/test_lint.py)
+    with pytest.raises(RuntimeError, match="not declared"):
+        parse_env_bool("MPI4JAX_TPU_NOT_A_FLAG")
 
 
 def test_capability_probes():

@@ -1,14 +1,24 @@
-"""In-repo lint: unused imports.
+"""In-repo lint pack: unused imports, undeclared env flags, docs sync.
 
 CI runs flake8 (see .github/workflows/test.yml), but the dev sandbox may not
-have it installed — this AST-based check keeps the one lint class that has
-actually bitten this repo (unused imports surviving across rounds, VERDICT
-r1/r2) enforceable everywhere the test suite runs.
+have it installed — these AST-based checks keep the lint classes that have
+actually bitten this repo enforceable everywhere the test suite runs:
+
+- unused imports (surviving across rounds, VERDICT r1/r2);
+- ``MPI4JAX_TPU_*`` environment flags read anywhere under ``mpi4jax_tpu/``
+  without being declared in the ``utils/config.py`` registry (name, type,
+  default, docstring — the single source of truth the docs and the
+  runtime ``_getenv`` guard share);
+- declared flags missing from the docs flag tables
+  (docs/usage.md / docs/resilience.md).
 """
 
 import ast
+import importlib
 import pathlib
 import re
+import sys
+import types
 
 import pytest
 
@@ -79,3 +89,107 @@ def test_no_unused_imports(path):
         if name not in used
     ]
     assert not unused, "unused imports:\n" + "\n".join(unused)
+
+
+# ---------------------------------------------------------------------------
+# env-flag registry checks (loaded without importing mpi4jax_tpu, so the
+# lint runs even where the installed JAX is below the package's hard floor)
+# ---------------------------------------------------------------------------
+
+_ISO_NAME = "_mpx_lint_iso"
+
+
+def _load_config():
+    if _ISO_NAME not in sys.modules:
+        root = types.ModuleType(_ISO_NAME)
+        root.__path__ = [str(REPO / "mpi4jax_tpu")]
+        sys.modules[_ISO_NAME] = root
+        sub = types.ModuleType(f"{_ISO_NAME}.utils")
+        sub.__path__ = [str(REPO / "mpi4jax_tpu" / "utils")]
+        sys.modules[f"{_ISO_NAME}.utils"] = sub
+        root.utils = sub
+        importlib.import_module(f"{_ISO_NAME}.utils.config")
+    return sys.modules[f"{_ISO_NAME}.utils.config"]
+
+
+PKG_SOURCES = [p for p in SOURCES
+               if p.is_relative_to(REPO / "mpi4jax_tpu")]
+
+# call names whose first string argument is an env-flag read: the raw
+# os.environ surface plus the config-module parse helpers (which go through
+# the registry's _getenv at runtime — the lint catches it statically)
+_ENV_READ_FUNCS = {
+    "getenv",          # os.getenv("...")
+    "get", "pop", "setdefault",  # os.environ.get / .pop / .setdefault
+    "parse_env_bool", "parse_env_float", "_getenv", "_parse_env_choice",
+}
+
+_FLAG_RE = re.compile(r"^MPI4JAX_TPU_\w+$")
+
+
+def _env_flag_reads(tree):
+    """(flag_name, lineno) for every MPI4JAX_TPU_* environment read."""
+    out = []
+    for node in ast.walk(tree):
+        key = None
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+                fn, "id", None)
+            if name in _ENV_READ_FUNCS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    key = arg.value
+        elif isinstance(node, ast.Subscript):
+            # os.environ["..."] — any literal-keyed subscript is cheap to
+            # inspect; non-flag strings are filtered below
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                key = sl.value
+        if key is not None and _FLAG_RE.match(key):
+            out.append((key, node.lineno))
+    return out
+
+
+@pytest.mark.parametrize(
+    "path", PKG_SOURCES, ids=lambda p: str(p.relative_to(REPO)))
+def test_no_undeclared_env_flags(path):
+    """Every MPI4JAX_TPU_* flag read under mpi4jax_tpu/ must be declared in
+    the utils/config.py registry (name, type, default, docstring)."""
+    config = _load_config()
+    tree = ast.parse(path.read_text())
+    undeclared = [
+        f"{path.relative_to(REPO)}:{line}: {name}"
+        for name, line in _env_flag_reads(tree)
+        if name not in config.FLAGS
+    ]
+    assert not undeclared, (
+        "undeclared environment flags (declare them in "
+        "mpi4jax_tpu/utils/config.py FLAGS):\n" + "\n".join(undeclared)
+    )
+
+
+def test_registry_flags_are_wellformed():
+    config = _load_config()
+    for name, flag in config.FLAGS.items():
+        assert _FLAG_RE.match(name), name
+        assert flag.name == name
+        assert flag.type in ("bool", "float", "int", "str", "choice")
+        assert flag.doc.strip(), f"{name} needs a docstring"
+        if flag.type == "choice":
+            assert flag.choices and flag.default in flag.choices, name
+
+
+def test_docs_list_every_registered_flag():
+    """Docs-sync: each declared flag must appear in the docs flag tables
+    (docs/usage.md or docs/resilience.md) — a flag without documentation
+    is indistinguishable from an undocumented sharp bit."""
+    config = _load_config()
+    docs = "\n".join(
+        (REPO / "docs" / f).read_text() for f in ("usage.md", "resilience.md")
+    )
+    missing = [name for name in config.FLAGS if name not in docs]
+    assert not missing, (
+        "flags declared in utils/config.py but absent from the docs flag "
+        "tables (docs/usage.md / docs/resilience.md): " + ", ".join(missing)
+    )
