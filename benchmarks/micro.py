@@ -216,6 +216,12 @@ def main():
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.add_argument("--save", action="store_true",
                    help="write the sweep to benchmarks/results/")
+    p.add_argument("--telemetry", action="store_true",
+                   help="run under MPI4JAX_TPU_TELEMETRY=counters and embed "
+                        "a per-section counter snapshot (algorithm "
+                        "selections, bytes, cache stats) in the payload, so "
+                        "saved BENCH files carry which algorithm actually "
+                        "ran for each sweep (docs/observability.md)")
     p.add_argument("--sizes-mb", type=float, nargs="+",
                    default=[0.004, 0.25, 1, 4, 16, 64])
     p.add_argument("--sizes-kb", type=float, nargs="+",
@@ -227,10 +233,42 @@ def main():
     comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
     n = comm.Get_size()
 
-    ar = bench_allreduce(comm, args.sizes_mb)
-    pp = bench_sendrecv_ring(comm, args.sizes_kb)
-    pr = bench_prod_and_split(comm, args.sizes_mb[:4])
-    al = bench_allreduce_algos(comm, args.sizes_mb)
+    telemetry_sections = {}
+
+    def _section(name, fn, *fn_args):
+        """Run one sweep; under --telemetry, bracket it with a counter
+        reset/snapshot so each section's snapshot attributes ITS traffic
+        (algo selections per op, bytes, cache churn) and nothing else's.
+        cache_stats are process-cumulative (reset only by clear_caches),
+        so the section embeds the DELTA over the sweep."""
+        if not args.telemetry:
+            return fn(*fn_args)
+        mpx.telemetry.reset()
+        cache_before = mpx.cache_stats()
+        rows = fn(*fn_args)
+        cache_after = mpx.cache_stats()
+        snap = mpx.telemetry.snapshot()
+        telemetry_sections[name] = {
+            "ops": snap["ops"],
+            "meters": snap["meters"],
+            "cache_stats": {
+                k: (cache_after[k] - cache_before[k]
+                    if k in ("hits", "misses", "evictions")
+                    else cache_after[k])
+                for k in cache_after
+            },
+        }
+        return rows
+
+    if args.telemetry:
+        mpx.set_telemetry_mode("counters")
+
+    ar = _section("allreduce", bench_allreduce, comm, args.sizes_mb)
+    pp = _section("sendrecv_ring", bench_sendrecv_ring, comm, args.sizes_kb)
+    pr = _section("prod_butterfly", bench_prod_and_split, comm,
+                  args.sizes_mb[:4])
+    al = _section("allreduce_algos", bench_allreduce_algos, comm,
+                  args.sizes_mb)
 
     payload = {
         "platform": devices[0].platform,
@@ -250,6 +288,9 @@ def main():
         "prod_butterfly": pr,
         "allreduce_algos": al,
     }
+    if args.telemetry:
+        payload["telemetry"] = telemetry_sections
+        mpx.set_telemetry_mode(None)
     if args.save:
         path = save_results(payload)
         print(f"saved: {path}", file=sys.stderr)

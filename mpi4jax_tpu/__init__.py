@@ -38,6 +38,7 @@ from .ops import (  # noqa: F401
     alltoall,
     barrier,
     bcast,
+    cache_stats,
     clear_caches,
     create_token,
     gather,
@@ -79,7 +80,9 @@ from .analysis import (  # noqa: F401
     analyze,
     set_analyze_mode,
 )
-from .utils.profiling import profile_ops  # noqa: F401
+from . import telemetry  # noqa: F401
+from .telemetry import set_telemetry_mode  # noqa: F401
+from .utils.profiling import ProfileSummary, profile_ops  # noqa: F401
 
 # JAX version advisory at import (ref mpi4jax/_src/__init__.py:6-8).
 from .utils.jax_compat import check_jax_version as _check_jax_version
@@ -142,7 +145,12 @@ __all__ = [
     "shift",
     "flush",
     "clear_caches",
+    "cache_stats",
     "profile_ops",
+    "ProfileSummary",
+    # runtime telemetry (docs/observability.md)
+    "telemetry",
+    "set_telemetry_mode",
     # resilience (docs/resilience.md)
     "set_watchdog_timeout",
     "set_fault_spec",

@@ -162,6 +162,17 @@ def abort_if(pred, rank, message: str):
 
     def _cb(p, r):
         if p:
+            # a tripped guard is about to kill the process: record it as
+            # a telemetry incident first (meter + flushed events-tier
+            # journal instant) so the post-mortem timeline shows WHERE
+            # the job died, not just that it died
+            try:
+                from .telemetry import journal as _tjournal
+
+                _tjournal.incident("numeric_guard.trips",
+                                   "numeric_guard_trip", r, message)
+            except Exception:
+                pass
             host_fatal(r, message)
 
     jax.debug.callback(_cb, pred, rank, ordered=False)
@@ -234,6 +245,21 @@ def watchdog_disarm(call_id: str, rank, dep):
 # base lives inside the C++ hook (host_hooks.cc WallclockImpl) for the
 # same reason.
 _py_wallclock_base: Optional[float] = None
+
+
+def host_clock():
+    """Host-side ``(mono, wall)`` clock pair for the telemetry journal
+    (telemetry/journal.py): ``mono`` is monotonic seconds on the SAME
+    process base as the pure-Python ``wallclock`` fallback, so journal
+    timestamps are directly comparable with in-graph ``wallclock()``
+    values; ``wall`` is ``time.time()``, the cross-process alignment
+    clock the merge CLI lays timelines out on."""
+    import time
+
+    global _py_wallclock_base
+    if _py_wallclock_base is None:
+        _py_wallclock_base = time.perf_counter()
+    return time.perf_counter() - _py_wallclock_base, time.time()
 
 
 def wallclock(dep=None):

@@ -20,6 +20,7 @@ from ._base import (  # noqa: F401
     SUM,
     Op,
     OpLike,
+    cache_stats,
     clear_caches,
     varying,
 )

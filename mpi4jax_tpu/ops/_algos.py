@@ -388,6 +388,7 @@ def apply_reduce_scatter(xl, op, comm):
     """
     from ._base import Op, apply_butterfly_allreduce, as_varying
     from ..analysis.hook import annotate
+    from ..telemetry.core import annotate as t_annotate
 
     k = comm.Get_size()  # static; raises the clear error on unequal splits
     xl = as_varying(xl, comm.axes)
@@ -401,11 +402,13 @@ def apply_reduce_scatter(xl, op, comm):
                 xl, comm.axes[0], scatter_dimension=0, tiled=False
             )
             annotate(algo="native")
+            t_annotate(algo="native")
             return res
         except NotImplementedError:  # shard_map/backend gap: fall through
             pass
     algo = resolve_algo(algo, xl.size * xl.dtype.itemsize, k, ring_ok=True)
     annotate(algo=algo)
+    t_annotate(algo=algo)
     if algo == "ring":
         return apply_ring_reduce_scatter(xl, op, comm, k)
     full = apply_butterfly_allreduce(xl, op, comm)

@@ -36,10 +36,12 @@ from ..parallel.region import (
 from ..utils.debug import get_logging, get_runtime_tracing, op_scope
 from ..utils.dtypes import check_dtype
 
-# the trace-time collective verifier rides the same single dispatch point
-# as resilience and the algorithm selector (imported last: analysis only
-# depends on utils.config, so the package import order stays acyclic)
+# the trace-time collective verifier and the telemetry layer ride the same
+# single dispatch point as resilience and the algorithm selector (imported
+# last: analysis and telemetry.core only depend on utils.config, so the
+# package import order stays acyclic)
 from ..analysis import hook as _analysis
+from ..telemetry import core as _telemetry
 
 
 class Op(enum.Enum):
@@ -225,6 +227,7 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
     if (algo == "auto" and comm.groups is None and isinstance(op, Op)
             and op in _NATIVE_COLLECTIVE):
         _analysis.annotate(algo="native")
+        _telemetry.annotate(algo="native")
         return _NATIVE_COLLECTIVE[op](x, axes)
     k = _algos.static_group_size(comm)
     ring_ok = k is not None and k > 1 and (
@@ -233,6 +236,7 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
     algo = _algos.resolve_algo(algo, x.size * x.dtype.itemsize,
                                k or 1, ring_ok)
     _analysis.annotate(algo=algo)
+    _telemetry.annotate(algo=algo)
     if algo == "ring":
         return _algos.apply_ring_allreduce(x, op, comm, k)
     return apply_butterfly_allreduce(x, op, comm)
@@ -341,41 +345,64 @@ def _run_body(opname: str, comm: Comm, body, arrays, token):
     - the resilience plan when any resilience feature is on (fault
       injection, numeric guards, collective watchdog; see
       mpi4jax_tpu/resilience/runtime.py) — this is the single dispatch
-      point that makes all 12 ops injectable/guardable without per-op code.
+      point that makes all 12 ops injectable/guardable without per-op code;
+    - the telemetry record and, in the ``events`` tier, the journal
+      begin/end bracket (mpi4jax_tpu/telemetry/) — counters are pure
+      host-side bookkeeping (no graph change); the events bracket threads
+      journal callbacks with the same data dependencies as the trace
+      hooks.
 
     Data dependencies pin everything around the collective: inputs are tied
-    after ``op_begin``/fault probe/watchdog arm, and ``op_end``/watchdog
-    disarm/output guards are tied to the first output.  With tracing off and
-    every resilience feature off (the default) the body runs untouched — the
-    lowered HLO is byte-identical to an uninstrumented build (pinned by
-    tests/test_resilience.py)."""
+    after ``op_begin``/fault probe/watchdog arm/journal begin, and
+    ``op_end``/watchdog disarm/output guards/journal end are tied to the
+    first output.  The journal begin sits AFTER the resilience probe so an
+    injected straggler delay shows up as late *arrival* — exactly what the
+    cross-rank skew column attributes.  With tracing off, every resilience
+    feature off, and telemetry off or counters-only (the default is off)
+    the body's traced program is untouched — the lowered HLO is
+    byte-identical to an uninstrumented build (pinned by
+    tests/test_resilience.py and tests/test_telemetry.py)."""
     from .. import native
     from ..resilience import runtime as _resilience
+    from ..telemetry import bracket as _tbracket
 
     plan = _resilience.plan_for(opname)
     tracing = get_runtime_tracing() and native.runtime_tracing_supported()
-    if plan is None and not tracing:
+    rec = _telemetry.open_op(opname, comm, arrays)
+    if plan is None and not tracing and rec is None:
         return body(comm, arrays, token)
 
-    call_id = _next_call_id()
-    rank = comm.Get_rank()
-    name = _mpi_opname(opname)
-    if plan is not None:
-        arrays, token = plan.before(name, call_id, comm, arrays, token)
-    if tracing:
-        begin = native.op_begin(name, call_id, rank, "")
-        arrays = tuple(native._tie(a, begin) for a in arrays)
-    out = body(comm, arrays, token)
-    results = [r for r in out if r is not None]
-    dep = results[0]
-    from .token import Token
+    try:
+        call_id = _next_call_id()
+        name = _mpi_opname(opname)
+        ebr = _tbracket.bracket_for(rec)
+        if plan is not None:
+            arrays, token = plan.before(name, call_id, comm, arrays, token)
+        if ebr is not None:
+            arrays, token = ebr.begin(call_id, comm, arrays, token)
+        if tracing:
+            # computed only when consumed: a dangling axis_index equation
+            # would break the counters-mode HLO byte-identity pin
+            rank = comm.Get_rank()
+            begin = native.op_begin(name, call_id, rank, "")
+            arrays = tuple(native._tie(a, begin) for a in arrays)
+        out = body(comm, arrays, token)
+        results = [r for r in out if r is not None]
+        dep = results[0]
+        from .token import Token
 
-    if isinstance(dep, Token):
-        dep = dep.value
-    if tracing:
-        native.op_end(name, call_id, rank, dep)
-    if plan is not None:
-        plan.after(name, call_id, comm, dep, results)
+        if isinstance(dep, Token):
+            dep = dep.value
+        if tracing:
+            native.op_end(name, call_id, rank, dep)
+        if ebr is not None:
+            ebr.end(call_id, comm, dep)
+        if plan is not None:
+            plan.after(name, call_id, comm, dep, results)
+    except BaseException:
+        _telemetry.abort_op(rec)
+        raise
+    _telemetry.close_op(rec)
     return out
 
 
@@ -391,21 +418,48 @@ from collections import OrderedDict
 _eager_cache: "OrderedDict" = OrderedDict()
 _EAGER_CACHE_MAX = 128
 
+# hit/miss/eviction accounting: _EAGER_CACHE_MAX eviction used to be
+# silent, making cache thrash (many distinct routing patterns cycling 128
+# entries) invisible.  Mirrored into the telemetry meters when telemetry
+# is on; always available via cache_stats().
+_eager_cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def cache_stats() -> dict:
+    """Eager compiled-program cache accounting:
+    ``{"hits", "misses", "evictions", "size"}``.
+
+    ``misses`` counts cacheable dispatches that compiled a new program
+    (uncacheable dispatches — e.g. a Status out-param — count neither
+    way); a high eviction rate means the working set exceeds the LRU
+    bound and eager calls are recompiling in cycles.  Reset by
+    ``clear_caches()``.
+    """
+    return dict(_eager_cache_stats, size=len(_eager_cache))
+
+
+def _bump_cache_stat(name: str) -> None:
+    _eager_cache_stats[name] += 1
+    _telemetry.meter(f"eager_cache.{name}")
+
 
 def clear_caches() -> None:
-    """Drain the eager one-op compiled-program cache and the memoized
-    ``mpx.analyze`` reports.
+    """Drain the eager one-op compiled-program cache (resetting its
+    hit/miss/eviction stats) and the memoized ``mpx.analyze`` reports.
 
     Each eager entry pins a compiled executable plus its mesh; call this
     after retiring a mesh, or when flipping a trace-shaping environment
     variable mid-process by hand (the knobs this library reads —
     ``MPI4JAX_TPU_COLLECTIVE_ALGO``, the resilience flags,
-    ``MPI4JAX_TPU_ANALYZE``, tracing/logging — are already folded into the
-    cache key, so toggling them retraces without an explicit clear).
-    ``spmd``-decorated functions hold their own per-function program
-    caches keyed the same way; they are dropped with the function object.
+    ``MPI4JAX_TPU_ANALYZE``, ``MPI4JAX_TPU_TELEMETRY``, tracing/logging —
+    are already folded into the cache key, so toggling them retraces
+    without an explicit clear).  ``spmd``-decorated functions hold their
+    own per-function program caches keyed the same way; they are dropped
+    with the function object.
     """
     _eager_cache.clear()
+    for k in _eager_cache_stats:
+        _eager_cache_stats[k] = 0
     _analysis.clear_analysis_caches()
 
 
@@ -515,12 +569,24 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
         cache_key = (opname, comm.mesh, comm.uid, static_key,
                      get_runtime_tracing(), get_logging(), prefer_notoken(),
                      resilience_token(), algo_cache_token(),
-                     _analysis.analysis_cache_token())
+                     _analysis.analysis_cache_token(),
+                     _telemetry.telemetry_cache_token())
         cached = _eager_cache.get(cache_key)
         if cached is not None:
             _eager_cache.move_to_end(cache_key)
-            results, tok_out = cached(tuple(arrays), token)
+            _bump_cache_stat("hits")
+            sm_hit, tele_cell = cached
+            # dispatch runs per call even on a hit, so the eager tier
+            # counts per call — from the entry's stash for THIS call's
+            # signature (jit retraces per signature; each retrace lands
+            # its records under its own signature inside capture_eager)
+            sig = _telemetry.call_signature(arrays)
+            with _telemetry.capture_eager(tele_cell, sig):
+                results, tok_out = sm_hit(tuple(arrays), token)
+            _telemetry.count_eager_call(tele_cell, sig)
             return (*results, tok_out)
+        _bump_cache_stat("misses")
+        _telemetry.meter(f"recompiles.eager.{opname}")
 
     def wrapped(arrs, tok):
         ctx = RegionContext(comm)
@@ -561,9 +627,14 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     ))
     # insert into the cache only after the first call succeeds — a
     # trace/compile failure must not leave a broken entry to be replayed
-    results, tok_out = sm(tuple(arrays), token)
+    tele_cell = _telemetry.EagerCell()
+    sig = _telemetry.call_signature(arrays)
+    with _telemetry.capture_eager(tele_cell, sig):
+        results, tok_out = sm(tuple(arrays), token)
+    _telemetry.count_eager_call(tele_cell, sig)
     if cache_key is not None:
-        _eager_cache[cache_key] = sm
+        _eager_cache[cache_key] = (sm, tele_cell)
         if len(_eager_cache) > _EAGER_CACHE_MAX:
             _eager_cache.popitem(last=False)
+            _bump_cache_stat("evictions")
     return (*results, tok_out)

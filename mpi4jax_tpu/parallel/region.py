@@ -195,14 +195,26 @@ def spmd(
             from ..analysis.hook import analysis_cache_token
             from ..ops._algos import algo_cache_token
             from ..resilience.runtime import cache_token as resilience_token
+            from ..telemetry import core as _telemetry
             from ..utils.config import prefer_notoken
             from ..utils.debug import get_logging, get_runtime_tracing
 
             key = (c.mesh, c.uid, statics, static_vals, kw_names, n_dyn,
                    get_runtime_tracing(), get_logging(), prefer_notoken(),
                    resilience_token(), algo_cache_token(),
-                   analysis_cache_token())
+                   analysis_cache_token(),
+                   _telemetry.telemetry_cache_token())
             sm = program_cache.get(key)
+            if sm is not None:
+                _telemetry.meter("spmd_cache.hits")
+            else:
+                # per-function recompile meter: a retrace storm (e.g. a
+                # flag flapping per step, or unhashed static args) shows
+                # up as a climbing recompiles.spmd.<name> count
+                _telemetry.meter("spmd_cache.misses")
+                _telemetry.meter(
+                    f"recompiles.spmd.{getattr(f, '__name__', 'fn')}"
+                )
             if sm is None:
                 axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])
                 ispecs = in_specs if in_specs is not None else axes_spec

@@ -207,6 +207,16 @@ def reset_fault_state() -> None:
 
 def _fault_line(rank: int, text: str) -> None:
     print(f"r{rank} | FAULT | {text}", file=sys.stderr, flush=True)
+    # injections are telemetry incidents too (metered; an events-tier
+    # instant puts them on the merged timeline next to the collective
+    # they disrupted).  Guarded — telemetry is optional under the
+    # isolated test loader, and a fault probe must never die on
+    # observability plumbing.
+    try:
+        from ..telemetry import journal
+    except ImportError:
+        return
+    journal.incident("faults.injected", "fault", rank, text)
 
 
 def probe_host(indexed_clauses, mpi_name: str, rank) -> int:

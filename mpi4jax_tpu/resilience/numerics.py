@@ -40,6 +40,10 @@ def guard_values(mpi_name: str, call_id: str, rank, values, stage: str):
     ]
     if not preds:
         return None
+    from ..telemetry.core import meter
+
+    meter("numeric_guard.sites")  # instrumented sites; trips metered in
+    #                               native.abort_if's fallback callback
     pred = reduce(jnp.logical_or, preds)
     return native.abort_if(
         pred,

@@ -45,6 +45,17 @@ __all__ = [
 _POLL_INTERVAL = 0.1
 
 
+def _telemetry_incident(meter_name, name, rank, detail=""):
+    """Mirror a watchdog lifecycle event into the telemetry layer via the
+    shared incident helper.  Guarded: the telemetry package is optional
+    under the isolated test loader."""
+    try:
+        from ..telemetry import journal
+    except ImportError:
+        return
+    journal.incident(meter_name, name, rank, detail)
+
+
 def _default_on_timeout(entries, expired):
     """Dump per-rank in-flight diagnostics, then die via the abort path."""
     from .. import native
@@ -135,6 +146,12 @@ class _Registry:
             time.sleep(_POLL_INTERVAL)
             expired = self.check_expired()
             if expired is not None:
+                _telemetry_incident(
+                    "watchdog.expiries", "watchdog_expired",
+                    expired["rank"],
+                    f"{expired['opname']} call {expired['call_id']} "
+                    f"exceeded {expired['timeout']:g}s",
+                )
                 self.on_timeout(self.snapshot(), expired)
                 return  # only reachable with a non-fatal on_timeout override
 
@@ -172,6 +189,16 @@ def arm_in_graph(mpi_name: str, call_id: str, comm, rank, timeout: float):
     must be tied to (so arming precedes the collective's execution)."""
     from .. import native
 
+    # metered HERE — the shared entry of both implementations — so the
+    # native C++ path counts too (trace-time semantics: one per armed
+    # collective site; the C++ registry's run-time arms are not visible
+    # from Python)
+    try:
+        from ..telemetry import core as _tcore
+    except ImportError:
+        pass
+    else:
+        _tcore.meter("watchdog.arms")
     axes = repr(comm.axes)
     if native.watchdog_supported():
         return native.watchdog_arm(mpi_name, call_id, rank, axes, timeout)

@@ -1,0 +1,54 @@
+"""Runtime telemetry: per-collective metrics, timelines, stragglers.
+
+The reference got per-op latency for free from host brackets around
+every libmpi call; the TPU-native lowering has no host call per
+collective, so this package rebuilds the observability ladder every
+production stack needs, in three always-cheap tiers gated by
+``MPI4JAX_TPU_TELEMETRY`` (docs/observability.md):
+
+- ``off`` (default) — nothing collected; HLO byte-identical to an
+  uninstrumented build (pinned by tests/test_telemetry.py);
+- ``counters`` — host-side per-(op, comm, algorithm, dtype) call/byte
+  counters and infrastructure meters (cache hits/misses/evictions,
+  recompiles per op, watchdog arms/expiries, fault injections).  Zero
+  device-side ops: HLO still byte-identical;
+- ``events`` — additionally journals a host begin/end bracket around
+  every collective (per-rank arrival + latency) to memory and, with
+  ``MPI4JAX_TPU_TELEMETRY_DIR``, per-process JSONL files.
+
+Read it back with :func:`snapshot` (this process), :func:`report`
+(cross-rank table with latency percentiles and the straggler column),
+:func:`dump` (JSON to disk), or merge the JSONL journals of all ranks
+into one Perfetto/``chrome://tracing`` timeline::
+
+    python -m mpi4jax_tpu.telemetry merge $MPI4JAX_TPU_TELEMETRY_DIR \\
+        --perfetto trace.json
+"""
+
+from .core import (  # noqa: F401
+    effective_mode,
+    meter,
+    reset,
+    set_telemetry_mode,
+    snapshot,
+    telemetry_cache_token,
+)
+from .hist import Histogram  # noqa: F401
+from .merge import chrome_trace, merge_dir, skew_table  # noqa: F401
+from .report import dump, gather_snapshots, report  # noqa: F401
+
+__all__ = [
+    "set_telemetry_mode",
+    "effective_mode",
+    "telemetry_cache_token",
+    "meter",
+    "snapshot",
+    "report",
+    "dump",
+    "reset",
+    "gather_snapshots",
+    "Histogram",
+    "merge_dir",
+    "chrome_trace",
+    "skew_table",
+]

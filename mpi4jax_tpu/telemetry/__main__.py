@@ -1,0 +1,15 @@
+"""CLI: ``python -m mpi4jax_tpu.telemetry merge <dir> --perfetto out.json``.
+
+Merges every rank's events-tier JSONL journal into one Chrome-trace-
+event timeline (rank = pid, op rows = tids — open in Perfetto or
+``chrome://tracing``) and prints the straggler attribution table.
+Exits non-zero on malformed journal lines (the CI telemetry lane's
+validation contract).  See mpi4jax_tpu/telemetry/merge.py.
+"""
+
+import sys
+
+from .merge import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

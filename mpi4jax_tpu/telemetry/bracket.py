@@ -1,0 +1,115 @@
+"""Events-tier in-graph brackets: host callbacks around each collective.
+
+Reuses the native ``op_begin``/``op_end`` hooks' data-dependency
+threading (ops/_base.py ``_run_body``): the begin callback's operand is
+the rank tied to the op's first input (and token), so it fires when this
+rank's inputs are materialized — the rank's *arrival* at the collective,
+the timestamp cross-rank skew is computed from; the end callback is tied
+to the op's first output, so begin→end is the collective's true
+in-flight bracket on this host (the watchdog uses the same one).  The
+callbacks are pure-Python ``io_callback``\\ s feeding the journal —
+``time.perf_counter`` precision everywhere, no native library required
+(the native runtime's C++ ``op_begin``/``op_end`` log path composes
+independently via ``MPI4JAX_TPU_TRACE``).
+
+Like every host callback in this codebase (fault probes, watchdog
+fallback), one fires per rank per execution on the host that owns the
+rank — which is what makes per-rank arrival times observable even on a
+single-host virtual mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import core, journal
+
+__all__ = ["bracket_for", "EventBracket"]
+
+
+def bracket_for(rec) -> Optional["EventBracket"]:
+    """The events bracket for one dispatch, or ``None`` unless the
+    ``events`` tier is on (``rec`` is the dispatch's open
+    :class:`~.core.OpRecord`)."""
+    if rec is None or not core.events_on():
+        return None
+    return EventBracket(rec)
+
+
+def _io_callback(fn, operand):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    return io_callback(
+        fn, jax.ShapeDtypeStruct((), jnp.uint32), operand, ordered=False
+    )
+
+
+class EventBracket:
+    """Begin/end journal callbacks for one op dispatch."""
+
+    __slots__ = ("rec",)
+
+    def __init__(self, rec):
+        self.rec = rec
+
+    def begin(self, call_id: str, comm, arrays, token):
+        """Emit the begin callback; returns ``(arrays, token)`` tied after
+        it so the collective cannot start before the arrival timestamp."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .. import native
+        from ..ops.token import Token
+
+        rec = self.rec
+        meta = {
+            "op": rec.op,
+            "comm_uid": str(rec.comm_uid),
+            "axes": list(rec.comm_axes),
+            "bytes": rec.bytes,
+            "dtype": rec.dtype,
+        }
+
+        def _begin(r):
+            journal.begin(call_id, int(r), meta)
+            return np.uint32(r)
+
+        rank = jnp.asarray(comm.global_rank(), jnp.uint32)
+        # arrival semantics: the callback operand depends on the op's
+        # first input (and token), so the timestamp is taken when this
+        # rank's inputs are ready — after any upstream compute, prior
+        # collectives, or injected straggler delay
+        if arrays:
+            rank = native._tie(rank, arrays[0])
+        if token is not None:
+            rank = native._tie(rank, token.value)
+        dep = _io_callback(_begin, rank)
+        # array-less, token-less dispatches (a bare barrier) give the tie
+        # below nothing to anchor to; synthesize the token exactly like
+        # resilience.runtime.Plan.before does
+        if not arrays and token is None:
+            token = Token(jnp.zeros((), jnp.uint32))
+        arrays = tuple(native._tie(a, dep) for a in arrays)
+        if token is not None:
+            token = Token(native._tie(token.value, dep))
+        return arrays, token
+
+    def end(self, call_id: str, comm, dep):
+        """Emit the end callback, tied after ``dep`` (the op's first
+        output).  Reads the algorithm annotation now — the op body has
+        run, so the selection is known."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .. import native
+
+        end_meta = {"algo": self.rec.algo}
+
+        def _end(r):
+            journal.end(call_id, int(r), end_meta)
+            return np.uint32(r)
+
+        rank = jnp.asarray(comm.global_rank(), jnp.uint32)
+        _io_callback(_end, native._tie(rank, dep))

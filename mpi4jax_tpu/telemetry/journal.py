@@ -1,0 +1,246 @@
+"""Events-tier journal: per-rank begin/end records + per-process JSONL.
+
+Each instrumented collective contributes one record per rank per
+execution: the begin bracket fires when the rank's inputs are
+materialized (its *arrival* at the collective — the number cross-rank
+skew is computed from) and the end bracket when its first output is
+ready, so ``t_end - t_begin`` is the collective's true in-flight time on
+this host, exactly the bracket the watchdog and the native trace hooks
+use.  Pairing is FIFO per ``(call_id, rank)`` — a trace site inside
+``lax.fori_loop`` fires once per iteration under one call id, the same
+aliasing the watchdog registry handles — and each completed pair gets a
+monotonically increasing ``seq`` so the N-th execution of a call site
+matches across ranks and processes (legal because SPMD executes one
+schedule everywhere).
+
+Two clocks per timestamp: ``mono`` (monotonic seconds, the latency
+clock — shared with ``native.wallclock``'s base when the native module
+is importable, pure ``time.perf_counter`` otherwise) and ``wall``
+(``time.time()``, the cross-process alignment clock the merge CLI lays
+the timeline out on; NTP-grade accuracy, see docs/observability.md).
+
+With ``MPI4JAX_TPU_TELEMETRY_DIR`` set, every completed record is also
+appended as one JSON line to ``events-p{process}.jsonl`` in that
+directory — the input of ``python -m mpi4jax_tpu.telemetry merge``.
+Pure Python except a guarded lazy import of ``native``/``jax``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+__all__ = ["begin", "end", "instant", "snapshot_events", "reset",
+           "process_index", "JOURNAL_FILE_PREFIX"]
+
+JOURNAL_FILE_PREFIX = "events-p"
+
+# in-memory record cap: a runaway events-mode loop must degrade (drop
+# oldest + count) rather than eat the host's memory; the JSONL file keeps
+# everything
+MAX_RECORDS = 100_000
+
+_py_base: Optional[float] = None
+
+
+def _clocks():
+    """(mono, wall) seconds.  ``mono`` shares ``native.wallclock``'s
+    process base when the native module imports, so journal timestamps
+    are directly comparable with in-graph ``wallclock()`` values; the
+    pure-Python fallback keeps its own base."""
+    try:
+        from .. import native
+
+        return native.host_clock()
+    except Exception:
+        global _py_base
+        if _py_base is None:
+            _py_base = time.perf_counter()
+        return time.perf_counter() - _py_base, time.time()
+
+
+_proc_index: Optional[int] = None
+
+
+def process_index() -> int:
+    """This host's process index (0 on single-process; lazy so the module
+    imports without JAX)."""
+    global _proc_index
+    if _proc_index is None:
+        try:
+            import jax
+
+            _proc_index = int(jax.process_index())
+        except Exception:
+            _proc_index = 0
+    return _proc_index
+
+
+class _Journal:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (call_id, rank) -> deque of (mono, wall, meta)
+        self.pending = {}
+        # (call_id, rank) -> completed-pair count (the seq counter)
+        self.seqs = {}
+        self.records = []
+        self.dropped = 0
+        self._file = None
+        self._file_dir = None
+
+    def _writer(self):
+        """The JSONL appender for the configured dir (lazy-opened, reopened
+        if the dir changes, line-buffered so readers see records as soon
+        as the producing program has drained)."""
+        from ..utils import config
+
+        d = config.telemetry_dir()
+        if not d:
+            return None
+        if self._file is not None and self._file_dir == d:
+            return self._file
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"{JOURNAL_FILE_PREFIX}{process_index()}.jsonl"
+        )
+        self._file = open(path, "a", buffering=1)
+        self._file_dir = d
+        return self._file
+
+    def _emit(self, record: dict) -> None:
+        self.records.append(record)
+        if len(self.records) > MAX_RECORDS:
+            del self.records[0]
+            self.dropped += 1
+        f = self._writer()
+        if f is not None:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+
+    def begin(self, call_id: str, rank: int, meta: dict) -> None:
+        mono, wall = _clocks()
+        with self.lock:
+            self.pending.setdefault((call_id, rank), deque()).append(
+                (mono, wall, meta)
+            )
+
+    def end(self, call_id: str, rank: int, end_meta: dict) -> None:
+        mono, wall = _clocks()
+        key = (call_id, rank)
+        with self.lock:
+            dq = self.pending.get(key)
+            if not dq:
+                return  # unmatched end: begin was dropped by a reset
+            mono0, wall0, meta = dq.popleft()
+            if not dq:
+                del self.pending[key]
+            seq = self.seqs.get(key, 0)
+            self.seqs[key] = seq + 1
+            record = dict(
+                meta,
+                type="op",
+                call_id=call_id,
+                seq=seq,
+                rank=rank,
+                process=process_index(),
+                t_begin=wall0,
+                t_end=wall,
+                mono_begin=mono0,
+                mono_end=mono,
+                latency=mono - mono0,
+            )
+            record.update(end_meta)
+            self._emit(record)
+        from . import core
+
+        core.record_latency(
+            core.op_key(record.get("op", "?"), record.get("comm_uid", "?"),
+                        record.get("algo", "native"),
+                        record.get("dtype", "")),
+            record["latency"],
+        )
+
+    def instant(self, name: str, rank: int, meta: dict) -> None:
+        mono, wall = _clocks()
+        with self.lock:
+            self._emit(dict(
+                meta, type="instant", name=name, rank=int(rank),
+                process=process_index(), t=wall, mono=mono,
+            ))
+
+    def flush(self) -> None:
+        with self.lock:
+            if self._file is not None:
+                self._file.flush()
+
+    def reset(self) -> None:
+        with self.lock:
+            self.pending.clear()
+            self.seqs.clear()
+            del self.records[:]
+            self.dropped = 0
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+                self._file_dir = None
+
+
+_journal = _Journal()
+
+
+def begin(call_id: str, rank: int, meta: dict) -> None:
+    _journal.begin(call_id, rank, meta)
+
+
+def end(call_id: str, rank: int, end_meta: dict) -> None:
+    _journal.end(call_id, rank, end_meta)
+
+
+def instant(name: str, rank: int, meta: Optional[dict] = None) -> None:
+    """Journal a point event (fault injection, watchdog expiry, numeric
+    guard trip) so infrastructure incidents land on the same timeline as
+    the collectives they disrupted.  No-op unless the events tier is on."""
+    from . import core
+
+    if not core.events_on():
+        return
+    _journal.instant(name, rank, meta or {})
+
+
+def incident(meter_name: str, name: str, rank, detail: str = "") -> None:
+    """THE incident entry point for the infrastructure around the ops
+    (watchdog expiries, fault injections, numeric-guard trips): bump the
+    meter (counters tier and up) and journal an instant with the detail
+    line (events tier), flushed so a record survives an imminent process
+    death.  Callers guard the telemetry import themselves (the package
+    is optional under the isolated test loaders)."""
+    from . import core
+
+    core.meter(meter_name)
+    instant(name, int(rank), {"detail": detail} if detail else {})
+    flush()
+
+
+def snapshot_events() -> list:
+    """Copy of the in-memory records (JSON-ready dicts)."""
+    with _journal.lock:
+        return list(_journal.records)
+
+
+def dropped_records() -> int:
+    with _journal.lock:
+        return _journal.dropped
+
+
+def flush() -> None:
+    _journal.flush()
+
+
+def reset() -> None:
+    _journal.reset()

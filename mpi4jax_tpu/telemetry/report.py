@@ -1,0 +1,185 @@
+"""Cross-rank telemetry report: snapshot gathering + the per-op table.
+
+``report(comm=...)`` allgathers every process's snapshot *through our
+own collectives* (a MAX allreduce sizes the buffer, then one allgather
+moves JSON-encoded uint8 payloads — no side channel, so it works
+anywhere the ops work, multi-host included), deduplicates by process
+(on a single-host virtual mesh every rank returns the same process
+snapshot), and renders one table per (op, comm, algorithm, dtype) with
+calls, bytes, min/p50/p99 latency, and the straggler columns: max
+cross-rank arrival skew and the rank most often last to arrive
+(``merge.skew_table`` over the merged events).
+
+Heavy imports (jax, the ops) happen inside the functions: this module
+must import cleanly without JAX so ``mpi4jax_tpu.telemetry`` stays
+loadable under the isolated test loader.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from . import core, merge
+from .hist import Histogram
+
+__all__ = ["snapshot", "report", "dump", "gather_snapshots"]
+
+snapshot = core.snapshot
+
+
+def dump(path: str, include_events: bool = True) -> str:
+    """Write this process's full snapshot (events included by default) as
+    JSON to ``path``; returns ``path``."""
+    with open(path, "w") as f:
+        json.dump(core.snapshot(include_events=include_events), f,
+                  indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def gather_snapshots(comm=None) -> List[dict]:
+    """Every process's snapshot, gathered through our own collectives.
+
+    Must run OUTSIDE any parallel region (it dispatches eager ops).  Each
+    rank contributes its process's snapshot; the result is deduplicated
+    to one snapshot per process.  Events are included when the events
+    tier is on (they carry the arrival times the skew columns need).
+    """
+    import numpy as np
+
+    from .. import MAX, allgather, allreduce
+    from ..parallel.region import resolve_comm
+
+    comm = resolve_comm(comm)
+    if comm.mesh is None:
+        raise RuntimeError(
+            "telemetry.report/gather_snapshots needs a comm bound to a "
+            "mesh (they dispatch eager collectives to move snapshots)"
+        )
+    local = json.dumps(
+        core.snapshot(include_events=core.events_on()), sort_keys=True
+    ).encode()
+    size = comm.world_size()
+
+    # size the buffer: MAX-allreduce the encoded lengths (every process
+    # supplies the full global array; the mesh takes each device's row
+    # from the process that owns the device, so row r is rank r's length)
+    lengths = np.full((size, 1), len(local), np.int32)
+    maxlen_g, _ = allreduce(lengths, op=MAX, comm=comm)
+    maxlen = int(np.asarray(maxlen_g)[0, 0])
+
+    payload = np.zeros((size, maxlen), np.uint8)
+    payload[:, :len(local)] = np.frombuffer(local, np.uint8)
+    gathered, _ = allgather(payload, comm=comm)
+    rows = np.asarray(gathered)[0]  # (size, maxlen), row r = rank r
+
+    snaps = {}
+    for row in rows:
+        text = bytes(row).rstrip(b"\x00").decode()
+        snap = json.loads(text)
+        snaps.setdefault(snap.get("process", 0), snap)
+    return [snaps[p] for p in sorted(snaps)]
+
+
+def _merge_counters(snaps: List[dict]) -> dict:
+    """Sum op counters and merge latency histograms across process
+    snapshots; returns ``{key: row}`` in snapshot-row format."""
+    out: dict = {}
+    for snap in snaps:
+        for key, row in snap.get("ops", {}).items():
+            dst = out.setdefault(key, {
+                **{k: row[k] for k in
+                   ("op", "comm_uid", "algo", "dtype")},
+                "calls": 0, "bytes": 0, "hist": Histogram(),
+            })
+            dst["calls"] += row.get("calls", 0)
+            dst["bytes"] += row.get("bytes", 0)
+            if "latency" in row:
+                dst["hist"] = dst["hist"].merge(
+                    Histogram.from_dict(row["latency"])
+                )
+    return out
+
+
+def _merged_events(snaps: List[dict]) -> list:
+    events = []
+    for snap in snaps:
+        events.extend(snap.get("events", []))
+    return events
+
+
+def _fmt_us(seconds) -> str:
+    if seconds is None:
+        return "-"
+    return f"{seconds * 1e6:,.1f}"
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):,.1f}M"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):,.1f}K"
+    return str(n)
+
+
+def render(snaps: List[dict]) -> str:
+    """The per-op table for a set of gathered process snapshots."""
+    ops = _merge_counters(snaps)
+    events = _merged_events(snaps)
+    skews = merge.skew_table(events) if events else {"per_op": {},
+                                                    "per_rank": {}}
+
+    header = (
+        f"{'op':<16} {'comm':>4} {'algo':<10} {'dtype':<9} {'calls':>7} "
+        f"{'bytes':>9} {'execs':>6} {'min us':>9} {'p50 us':>9} "
+        f"{'p99 us':>9} {'skew us':>9} {'straggler':>9}"
+    )
+    lines = [header, "-" * len(header)]
+    # the table's straggler column charges the rank with the most
+    # last-arrivals overall; the per-rank chart below has the full story
+    worst_rank = None
+    if skews["per_rank"]:
+        worst_rank = max(
+            skews["per_rank"],
+            key=lambda r: skews["per_rank"][r]["last_arrivals"],
+        )
+    for key in sorted(ops):
+        row = ops[key]
+        h = row["hist"]
+        sk = skews["per_op"].get(row["op"])
+        lines.append(
+            f"{row['op']:<16} {row['comm_uid']:>4} {row['algo']:<10} "
+            f"{row['dtype']:<9} {row['calls']:>7} "
+            f"{_fmt_bytes(row['bytes']):>9} {h.count:>6} "
+            f"{_fmt_us(h.min):>9} {_fmt_us(h.quantile(0.5)):>9} "
+            f"{_fmt_us(h.quantile(0.99)):>9} "
+            f"{_fmt_us(sk['max_skew']) if sk else '-':>9} "
+            f"{('r' + str(worst_rank)) if sk else '-':>9}"
+        )
+    total_meters = {}
+    for snap in snaps:
+        for name, n in snap.get("meters", {}).items():
+            total_meters[name] = total_meters.get(name, 0) + n
+    if total_meters:
+        lines.append("")
+        lines.append("meters:")
+        for name in sorted(total_meters):
+            lines.append(f"  {name:<40} {total_meters[name]:>10}")
+    if events:
+        lines.append("")
+        lines.append(merge.render_skew(skews))
+    return "\n".join(lines)
+
+
+def report(comm=None, file=None) -> str:
+    """Gather every process's snapshot over ``comm`` and print/return the
+    per-op table (the straggler columns need the ``events`` tier; with
+    ``counters`` they render as ``-``)."""
+    from . import journal
+
+    journal.flush()
+    text = render(gather_snapshots(comm))
+    print(text, file=file if file is not None else sys.stdout)
+    return text

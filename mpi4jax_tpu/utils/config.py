@@ -32,6 +32,7 @@ class Flag(NamedTuple):
 
 ANALYZE_MODES = ("off", "warn", "error")
 COLLECTIVE_ALGOS = ("auto", "butterfly", "ring")
+TELEMETRY_MODES = ("off", "counters", "events")
 
 # default ring/butterfly crossover: 1 MiB — below it the butterfly's
 # ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
@@ -85,6 +86,21 @@ FLAGS = {
              "``AnalysisError`` instead.  ``off`` (default) records "
              "nothing; the lowered HLO is byte-identical in every mode.",
              choices=ANALYZE_MODES),
+        Flag("MPI4JAX_TPU_TELEMETRY", "choice", "off",
+             "Runtime telemetry tier (telemetry/): ``counters`` keeps "
+             "host-side per-(op, comm, algo, dtype) call/byte counters "
+             "and infrastructure meters (zero device-side ops — the "
+             "lowered HLO stays byte-identical to ``off``); ``events`` "
+             "additionally journals host-side begin/end brackets around "
+             "every collective (per-rank latency + arrival timestamps, "
+             "JSONL under ``MPI4JAX_TPU_TELEMETRY_DIR``).  ``off`` "
+             "(default) collects nothing.",
+             choices=TELEMETRY_MODES),
+        Flag("MPI4JAX_TPU_TELEMETRY_DIR", "str", "",
+             "Directory for the ``events``-tier per-process JSONL "
+             "journals (telemetry/journal.py); merged across ranks by "
+             "``python -m mpi4jax_tpu.telemetry merge``.  Empty "
+             "(default) keeps the journal in memory only."),
     )
 }
 
@@ -232,6 +248,18 @@ def analyze_mode() -> str:
     """Trace-time collective verifier mode (``MPI4JAX_TPU_ANALYZE``):
     ``off`` (default) / ``warn`` / ``error`` — see mpi4jax_tpu/analysis/."""
     return _parse_env_choice("MPI4JAX_TPU_ANALYZE")
+
+
+def telemetry_mode() -> str:
+    """Runtime telemetry tier (``MPI4JAX_TPU_TELEMETRY``): ``off``
+    (default) / ``counters`` / ``events`` — see mpi4jax_tpu/telemetry/."""
+    return _parse_env_choice("MPI4JAX_TPU_TELEMETRY")
+
+
+def telemetry_dir() -> str:
+    """Directory for the events-tier JSONL journals
+    (``MPI4JAX_TPU_TELEMETRY_DIR``; '' = in-memory journal only)."""
+    return (_getenv("MPI4JAX_TPU_TELEMETRY_DIR") or "").strip()
 
 
 def prefer_notoken() -> bool:

@@ -22,7 +22,29 @@ import os
 
 import jax
 
-__all__ = ["profile_ops"]
+__all__ = ["profile_ops", "ProfileSummary"]
+
+
+class ProfileSummary:
+    """What a ``profile_ops`` capture did: the trace directory and how
+    many live arrays the exit fence blocked on (``None`` until the
+    context exits).  A zero ``fenced_arrays`` is the tell that the
+    profiled block dropped its outputs on the floor — the work may have
+    landed outside the capture window (see ``profile_ops``)."""
+
+    __slots__ = ("trace_dir", "backend", "fenced_arrays")
+
+    def __init__(self, trace_dir: str, backend: str):
+        self.trace_dir = trace_dir
+        self.backend = backend
+        self.fenced_arrays = None
+
+    def __repr__(self):
+        return (
+            f"ProfileSummary(trace_dir={self.trace_dir!r}, "
+            f"backend={self.backend!r}, "
+            f"fenced_arrays={self.fenced_arrays})"
+        )
 
 
 @contextlib.contextmanager
@@ -31,26 +53,33 @@ def profile_ops(logdir: str, *, create_perfetto_link: bool = False):
 
     Usage::
 
-        with mpx.profile_ops("/tmp/jax-trace"):
+        with mpx.profile_ops("/tmp/jax-trace") as prof:
             out = step(state)          # any program using mpi4jax_tpu ops
+        # prof.fenced_arrays: how many live arrays the exit fence covered
 
     On exit, outstanding device work is fenced into the trace
-    (``jax.block_until_ready`` over every live array on the default
-    backend), then the trace is closed.  The fence covers everything whose
-    output is still referenced — BIND the results you are profiling
-    (``out = step(state)``, as above); a call whose outputs you drop on
-    the floor has nothing live to fence and may land outside the window
-    (``jax.block_until_ready(step(state))`` inside the block is the
-    explicit form).  Open the directory in TensorBoard/xprof and filter
-    for ``mpi4jax_tpu.<op>`` to read each collective's device time, queue
-    time, and overlap with compute — measured on the real stream,
-    including any fusion/reordering XLA applied (docs/usage.md
-    "Observability").
+    (``jax.block_until_ready`` over every live array on the DEFAULT
+    backend — not every backend: a CPU-backed sidecar array, e.g. a
+    host-staged checkpoint shard, must not stall the close of a TPU
+    capture), then the trace is closed.  Yields a :class:`ProfileSummary`
+    whose ``fenced_arrays`` count is filled in by the fence, so callers
+    (and tests) can assert the fence actually ran.  The fence covers
+    everything whose output is still referenced — BIND the results you
+    are profiling (``out = step(state)``, as above); a call whose outputs
+    you drop on the floor has nothing live to fence and may land outside
+    the window (``jax.block_until_ready(step(state))`` inside the block
+    is the explicit form).  Open the directory in TensorBoard/xprof and
+    filter for ``mpi4jax_tpu.<op>`` to read each collective's device
+    time, queue time, and overlap with compute — measured on the real
+    stream, including any fusion/reordering XLA applied (docs/usage.md
+    "Observability", docs/observability.md).
     """
     os.makedirs(logdir, exist_ok=True)
+    backend = jax.default_backend()
+    summary = ProfileSummary(logdir, backend)
     with jax.profiler.trace(logdir, create_perfetto_link=create_perfetto_link):
         try:
-            yield
+            yield summary
         finally:
             # fence: async dispatch means enclosed calls may not have
             # executed yet; blocking on live arrays lands their device work
@@ -58,4 +87,6 @@ def profile_ops(logdir: str, *, create_perfetto_link: bool = False):
             # when the profiled block raises — work dispatched before the
             # exception would otherwise land outside the window and the
             # partial trace would silently under-report.
-            jax.block_until_ready(jax.live_arrays())
+            fenced = jax.live_arrays(backend)
+            summary.fenced_arrays = len(fenced)
+            jax.block_until_ready(fenced)

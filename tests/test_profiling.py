@@ -36,6 +36,22 @@ def test_profile_ops_captures_trace(tmp_path):
     assert files, f"no trace captured under {logdir}"
 
 
+def test_profile_ops_summary_reports_fence(tmp_path):
+    """The yielded summary proves the exit fence ran: it names the trace
+    dir/backend and counts the live arrays it blocked on (scoped to the
+    DEFAULT backend — a sidecar array on another backend must not stall
+    the close)."""
+    logdir = str(tmp_path / "trace_summary")
+    with mpx.profile_ops(logdir) as prof:
+        out = jnp.ones((8, 16)) * 2
+    assert prof.trace_dir == logdir
+    assert prof.backend == "cpu"
+    # `out` is live at exit, so the fence had at least it to block on
+    assert prof.fenced_arrays >= 1
+    assert np.isfinite(np.asarray(out)).all()
+    assert "fenced_arrays=" in repr(prof)
+
+
 def test_profile_ops_nested_exceptions_close_trace(tmp_path):
     """An exception inside the window must not leave the profiler running
     (a dangling session would poison every later capture)."""

@@ -1,0 +1,769 @@
+"""Telemetry-layer tests (docs/observability.md).
+
+Covers mpi4jax_tpu/telemetry/:
+
+- tier resolution (``MPI4JAX_TPU_TELEMETRY`` env + programmatic
+  override) and the cache token every compiled-program cache key folds;
+- the counter registry: per-(op, comm, algo, dtype) call/byte counting,
+  the eager-capture per-call semantics, infrastructure meters;
+- log2 latency histograms: bucket edges, the merge property (bucket-wise
+  sum, exact count/sum/min/max sidecars), quantile bounds, dict
+  round-trips;
+- the events journal: FIFO begin/end pairing under call-id aliasing,
+  seq assignment, JSONL writing, instant (incident) events;
+- the merge CLI: JSONL validation (malformed input fails loudly — the
+  CI contract), Chrome-trace rendering (rank = pid, op rows = tids),
+  cross-rank skew + straggler attribution, and a golden-file pin of the
+  full merge (tests/data/telemetry/ → telemetry_golden_trace.json);
+- through the real dispatch (JAX half): counter correctness on the
+  token / notoken / eager paths, the HLO byte-identity pin for
+  off/counters (and non-identity for events), per-rank journal records
+  on the 8-device mesh, ``report()``'s skew table, ``cache_stats()``
+  hit/miss/eviction accounting, and mode-flip retraces.
+
+The pure half loads the telemetry modules under a private package name
+(``_load_isolated``) so it runs even where the installed JAX is below
+the package floor; the JAX-integration half skips there (mirroring
+tests/test_resilience.py).
+"""
+
+import importlib
+import json
+import os
+import pathlib
+import sys
+import time
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+DATA = REPO / "tests" / "data"
+
+try:
+    import mpi4jax_tpu as _mpx_probe  # noqa: F401
+
+    HAS_MPX = True
+except RuntimeError:  # JAX below the package floor (utils/jax_compat.py)
+    HAS_MPX = False
+
+needs_mpx = pytest.mark.skipif(
+    not HAS_MPX, reason="mpi4jax_tpu import refused (JAX below hard floor)"
+)
+
+_ISO_NAME = "_mpx_telemetry_iso"
+
+
+def _load_isolated():
+    """Load the pure telemetry modules under a private package name (same
+    trick as tests/test_resilience.py): bypasses the package __init__'s
+    JAX-floor check while preserving relative imports, and isolates
+    module state from any real ``mpi4jax_tpu`` import in this process."""
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "telemetry"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in (
+        "utils.config",
+        "telemetry.hist",
+        "telemetry.core",
+        "telemetry.journal",
+        "telemetry.merge",
+    ):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+config = ISO.utils.config
+hist = ISO.telemetry.hist
+core = ISO.telemetry.core
+journal = ISO.telemetry.journal
+merge = ISO.telemetry.merge
+
+
+class FakeComm:
+    def __init__(self, uid=0, axes=("i",)):
+        self.uid = uid
+        self.axes = axes
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state():
+    """Every test starts and ends with no override, empty counters and
+    journal, and no telemetry environment variables."""
+    core.set_telemetry_mode(None)
+    core.reset()
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in ("MPI4JAX_TPU_TELEMETRY", "MPI4JAX_TPU_TELEMETRY_DIR")
+    }
+    yield
+    core.set_telemetry_mode(None)
+    core.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + cache token
+# ---------------------------------------------------------------------------
+
+
+def test_mode_default_env_and_override():
+    assert core.effective_mode() == "off"
+    os.environ["MPI4JAX_TPU_TELEMETRY"] = "counters"
+    assert core.effective_mode() == "counters"
+    assert config.telemetry_mode() == "counters"
+    core.set_telemetry_mode("events")           # override shadows env
+    assert core.effective_mode() == "events"
+    core.set_telemetry_mode(None)               # env rules again
+    assert core.effective_mode() == "counters"
+    os.environ["MPI4JAX_TPU_TELEMETRY"] = "bogus"
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_TELEMETRY"):
+        core.effective_mode()
+    with pytest.raises(ValueError, match="telemetry mode"):
+        core.set_telemetry_mode("bogus")
+
+
+def test_telemetry_dir_parsing():
+    assert config.telemetry_dir() == ""
+    os.environ["MPI4JAX_TPU_TELEMETRY_DIR"] = "  /tmp/x  "
+    assert config.telemetry_dir() == "/tmp/x"
+
+
+def test_cache_token_reflects_mode():
+    tokens = set()
+    for mode in ("off", "counters", "events"):
+        core.set_telemetry_mode(mode)
+        tokens.add(core.telemetry_cache_token())
+    # each tier must change the compiled-program cache key, or flipping
+    # it would silently keep serving the old program
+    assert len(tokens) == 3
+
+
+# ---------------------------------------------------------------------------
+# counters + meters
+# ---------------------------------------------------------------------------
+
+
+def test_meters_gated_by_mode():
+    core.meter("x.y")                           # off: dropped
+    core.set_telemetry_mode("counters")
+    core.meter("x.y")
+    core.meter("x.y", 2)
+    assert core.snapshot()["meters"] == {"x.y": 3}
+    core.reset()
+    assert core.snapshot()["meters"] == {}
+
+
+def test_op_record_lifecycle_counts_traced_dispatch():
+    import numpy as np
+
+    core.set_telemetry_mode("counters")
+    rec = core.open_op("allreduce", FakeComm(uid=3),
+                       (np.ones((8,), np.float32),))
+    core.annotate(algo="ring")
+    core.close_op(rec)
+    snap = core.snapshot()
+    (key,) = snap["ops"]
+    assert key == "allreduce|3|ring|float32"
+    row = snap["ops"][key]
+    assert row["calls"] == 1 and row["bytes"] == 32
+    assert snap["meters"]["algo.allreduce.ring"] == 1
+    # off: open_op refuses (zero-cost default)
+    core.set_telemetry_mode(None)
+    assert core.open_op("allreduce", FakeComm(), ()) is None
+
+
+def test_abort_discards_open_record():
+    core.set_telemetry_mode("counters")
+    rec = core.open_op("bcast", FakeComm(), ())
+    core.abort_op(rec)
+    assert core.snapshot()["ops"] == {}
+
+
+def test_eager_capture_counts_per_call_not_per_trace():
+    import numpy as np
+
+    core.set_telemetry_mode("counters")
+    cell = core.EagerCell()
+    x = np.ones((4,), np.float32)
+    sig = core.call_signature((x,))
+    # first call: traces (record captured on the cell, not counted)
+    with core.capture_eager(cell, sig):
+        rec = core.open_op("allreduce", FakeComm(), (x,))
+        core.annotate(algo="butterfly")
+        core.close_op(rec)
+    assert core.snapshot()["ops"] == {}
+    core.count_eager_call(cell, sig)            # ...the dispatch loop counts
+    # second call: pure cache hit — no trace, count from the stash
+    with core.capture_eager(cell, sig):
+        pass
+    core.count_eager_call(cell, sig)
+    (row,) = core.snapshot()["ops"].values()
+    assert row["calls"] == 2 and row["bytes"] == 32
+    assert row["algo"] == "butterfly"
+
+
+def test_eager_capture_stash_is_per_signature():
+    """Regression: a shape-alternating eager workload must count each
+    call with ITS shape's bytes/algo — the stash of the most recent
+    trace must not leak onto hits of a different signature."""
+    import numpy as np
+
+    core.set_telemetry_mode("counters")
+    cell = core.EagerCell()
+    small = np.ones((4,), np.float32)
+    big = np.ones((1024,), np.float32)
+    for x, algo in ((small, "butterfly"), (big, "ring")):
+        sig = core.call_signature((x,))
+        with core.capture_eager(cell, sig):     # each shape traces once
+            rec = core.open_op("allreduce", FakeComm(), (x,))
+            core.annotate(algo=algo)
+            core.close_op(rec)
+        core.count_eager_call(cell, sig)
+    # now a pure hit with the SMALL shape again (no retrace)
+    sig = core.call_signature((small,))
+    with core.capture_eager(cell, sig):
+        pass
+    core.count_eager_call(cell, sig)
+    rows = {r["algo"]: r for r in core.snapshot()["ops"].values()}
+    assert rows["butterfly"]["calls"] == 2          # small counted twice
+    assert rows["butterfly"]["bytes"] == 2 * 16     # with ITS bytes
+    assert rows["ring"]["calls"] == 1
+    assert rows["ring"]["bytes"] == 4096
+
+
+def test_eager_capture_exception_does_not_poison_stash():
+    import numpy as np
+
+    core.set_telemetry_mode("counters")
+    cell = core.EagerCell()
+    x = np.ones((4,), np.float32)
+    sig = core.call_signature((x,))
+    with core.capture_eager(cell, sig):
+        rec = core.open_op("allreduce", FakeComm(), (x,))
+        core.annotate(algo="butterfly")
+        core.close_op(rec)
+    with pytest.raises(RuntimeError):
+        with core.capture_eager(cell, sig):
+            rec = core.open_op("allreduce", FakeComm(), (x,))
+            core.close_op(rec)                  # partial retrace...
+            raise RuntimeError("boom")          # ...then the call dies
+    # the good stash survives: later hits still count the full record set
+    core.count_eager_call(cell, sig)
+    (row,) = core.snapshot()["ops"].values()
+    assert row["calls"] == 1 and row["algo"] == "butterfly"
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_index_edges():
+    assert hist.bucket_index(1.0) == 0
+    assert hist.bucket_index(1.5) == 0
+    assert hist.bucket_index(2.0) == 1
+    assert hist.bucket_index(0.5) == -1
+    assert hist.bucket_index(1e-6) == -20
+    assert hist.bucket_index(0.0) == hist.MIN_BUCKET      # clamp
+    assert hist.bucket_index(-1.0) == hist.MIN_BUCKET     # clamp
+    assert hist.bucket_index(1e30) == hist.MAX_BUCKET     # clamp
+    lo = hist.bucket_value(0)
+    assert 1.0 < lo < 2.0                                 # geometric mid
+
+
+def test_histogram_merge_property():
+    import random
+
+    rng = random.Random(1234)
+    a = [rng.uniform(1e-7, 1e-2) for _ in range(200)]
+    b = [rng.uniform(1e-6, 1e-1) for _ in range(137)]
+    ha, hb, hall = hist.Histogram(), hist.Histogram(), hist.Histogram()
+    for v in a:
+        ha.record(v)
+        hall.record(v)
+    for v in b:
+        hb.record(v)
+        hall.record(v)
+    merged = ha.merge(hb)
+    # merge == record-everything, exactly (fixed buckets: no rebinning)
+    assert merged.counts == hall.counts
+    assert merged.count == hall.count == 337
+    assert merged.sum == pytest.approx(hall.sum)
+    assert merged.min == hall.min and merged.max == hall.max
+    # inputs untouched
+    assert ha.count == 200 and hb.count == 137
+    # quantiles are bucket estimates clamped into [min, max], monotone
+    q = [merged.quantile(x) for x in (0.0, 0.5, 0.9, 0.99, 1.0)]
+    assert all(merged.min <= v <= merged.max for v in q)
+    assert q == sorted(q)
+
+
+def test_histogram_dict_round_trip_and_single_sample():
+    h = hist.Histogram()
+    h.record(3.5e-4)
+    d = h.to_dict()
+    h2 = hist.Histogram.from_dict(json.loads(json.dumps(d)))
+    assert h2.counts == h.counts and h2.count == 1
+    assert h2.min == h2.max == 3.5e-4
+    # a single-sample histogram reports its sample, not a bucket midpoint
+    assert h2.quantile(0.5) == 3.5e-4
+    assert hist.Histogram().quantile(0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+_META = {"op": "allreduce", "comm_uid": "0", "axes": ["i"], "bytes": 64,
+         "dtype": "float32"}
+
+
+def test_journal_fifo_aliasing_and_seq():
+    core.set_telemetry_mode("events")
+    # two begins under ONE call id before any end (a fori_loop trace site)
+    journal.begin("0000000a", 0, _META)
+    journal.begin("0000000a", 0, _META)
+    journal.end("0000000a", 0, {"algo": "ring"})
+    journal.end("0000000a", 0, {"algo": "ring"})
+    recs = journal.snapshot_events()
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert all(r["type"] == "op" and r["op"] == "allreduce" for r in recs)
+    assert all(r["latency"] >= 0 for r in recs)
+    assert all(r["t_end"] >= r["t_begin"] for r in recs)
+    assert recs[0]["algo"] == "ring" and recs[0]["bytes"] == 64
+    # latency fed the per-op histogram under the annotated key
+    snap = core.snapshot()
+    assert snap["ops"]["allreduce|0|ring|float32"]["latency"]["count"] == 2
+    # unmatched end after a reset is dropped, not an error
+    journal.reset()
+    journal.end("0000000a", 0, {})
+    assert journal.snapshot_events() == []
+
+
+def test_journal_instant_gated_by_events_tier():
+    journal.instant("fault", 1, {"detail": "x"})          # off: dropped
+    core.set_telemetry_mode("counters")
+    journal.instant("fault", 1, {"detail": "x"})          # counters: dropped
+    assert journal.snapshot_events() == []
+    core.set_telemetry_mode("events")
+    journal.instant("fault", 1, {"detail": "x"})
+    (rec,) = journal.snapshot_events()
+    assert rec["type"] == "instant" and rec["name"] == "fault"
+    assert rec["rank"] == 1 and "t" in rec
+
+
+def test_journal_writes_jsonl(tmp_path):
+    core.set_telemetry_mode("events")
+    os.environ["MPI4JAX_TPU_TELEMETRY_DIR"] = str(tmp_path)
+    journal.begin("0000000b", 2, _META)
+    journal.end("0000000b", 2, {"algo": "native"})
+    journal.flush()
+    (path,) = tmp_path.glob("*.jsonl")
+    assert path.name.startswith(journal.JOURNAL_FILE_PREFIX)
+    (line,) = path.read_text().splitlines()
+    rec = json.loads(line)
+    assert rec["op"] == "allreduce" and rec["rank"] == 2
+    for field in ("call_id", "seq", "t_begin", "t_end", "latency",
+                  "process"):
+        assert field in rec
+    core.reset()  # closes the file handle
+
+
+# ---------------------------------------------------------------------------
+# merge + chrome trace + skew
+# ---------------------------------------------------------------------------
+
+
+def _write_journal(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def _op_rec(rank, t0, dur, cid="00000001", seq=0, op="allreduce", **kw):
+    return dict(
+        {"type": "op", "op": op, "call_id": cid, "seq": seq, "rank": rank,
+         "process": rank, "t_begin": t0, "t_end": t0 + dur,
+         "latency": dur, "bytes": 64, "dtype": "float32", "algo": "ring"},
+        **kw,
+    )
+
+
+def test_merge_validates_malformed_lines(tmp_path):
+    p = tmp_path / "events-p0.jsonl"
+    p.write_text('{"type": "op"\n')                       # not JSON
+    with pytest.raises(merge.MalformedJournal, match="events-p0.jsonl:1"):
+        merge.read_journal(str(p))
+    p.write_text('{"type": "nope"}\n')
+    with pytest.raises(merge.MalformedJournal, match="unknown record type"):
+        merge.read_journal(str(p))
+    p.write_text('{"type": "op", "op": "allreduce"}\n')
+    with pytest.raises(merge.MalformedJournal, match="missing field"):
+        merge.read_journal(str(p))
+    p.write_text('[1, 2]\n')
+    with pytest.raises(merge.MalformedJournal, match="JSON object"):
+        merge.read_journal(str(p))
+    # empty dir is an error too (nothing to merge)
+    with pytest.raises(FileNotFoundError):
+        merge.merge_dir(str(tmp_path / "empty"))
+
+
+def test_merge_dedupes_and_sorts(tmp_path):
+    a = _op_rec(0, 10.0, 0.5)
+    b = _op_rec(1, 10.2, 0.5)
+    _write_journal(tmp_path / "events-p0.jsonl", [a, a])  # dup in-file
+    _write_journal(tmp_path / "events-p1.jsonl", [b])
+    recs = merge.merge_dir(str(tmp_path))
+    assert [r["rank"] for r in recs] == [0, 1]            # t_begin order
+
+
+def test_chrome_trace_structure_and_skew():
+    recs = [
+        _op_rec(0, 10.000, 0.5),
+        _op_rec(1, 10.002, 0.5),
+        _op_rec(0, 11.000, 0.3, seq=1),
+        _op_rec(1, 11.010, 0.3, seq=1),
+        {"type": "instant", "name": "fault", "rank": 1, "process": 1,
+         "t": 10.9, "detail": "delay injected"},
+    ]
+    trace = merge.chrome_trace(recs)
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    inst = [e for e in events if e["ph"] == "i"]
+    assert len(xs) == 4 and len(inst) == 1
+    # rank = pid; op rows = tids (one per op name, consistent across pids)
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert len({e["tid"] for e in xs}) == 1                # one op name
+    assert xs[0]["dur"] == pytest.approx(0.5 * 1e6)        # µs
+    assert min(e["ts"] for e in xs) == 0.0                 # rebased
+    names = {(m["name"], m.get("pid"), m.get("tid")) for m in metas}
+    assert ("process_name", 0, None) in names
+    assert any(m["name"] == "thread_name" and
+               m["args"]["name"] == "allreduce" for m in metas)
+    assert inst[0]["s"] == "p" and inst[0]["pid"] == 1
+
+    table = merge.skew_table(recs)
+    row = table["per_op"]["allreduce"]
+    assert row["groups"] == 2
+    assert row["max_skew"] == pytest.approx(0.010)
+    assert row["mean_skew"] == pytest.approx(0.006)
+    assert table["per_rank"][1]["last_arrivals"] == 2      # the straggler
+    assert table["per_rank"][0]["last_arrivals"] == 0
+    text = merge.render_skew(table)
+    assert "allreduce" in text and "r1" in text
+
+
+def test_skew_needs_two_ranks():
+    table = merge.skew_table([_op_rec(0, 1.0, 0.1)])
+    assert table["per_op"] == {} and table["per_rank"] == {}
+    assert "2 ranks" in merge.render_skew(table)
+
+
+def test_merge_cli_end_to_end(tmp_path, capsys):
+    _write_journal(tmp_path / "events-p0.jsonl",
+                   [_op_rec(0, 10.0, 0.5)])
+    _write_journal(tmp_path / "events-p1.jsonl",
+                   [_op_rec(1, 10.1, 0.5)])
+    out = tmp_path / "trace.json"
+    rc = merge.main(["merge", str(tmp_path), "--perfetto", str(out)])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "2 rank(s)" in printed and "last arrivals" in printed
+    trace = json.loads(out.read_text())
+    assert trace["traceEvents"]
+    # malformed input: non-zero exit, error on stderr (the CI contract)
+    (tmp_path / "events-p2.jsonl").write_text("garbage\n")
+    rc = merge.main(["merge", str(tmp_path), "--no-skew"])
+    captured = capsys.readouterr()
+    assert rc == 2 and "events-p2.jsonl:1" in captured.err
+
+
+def test_merge_golden_file():
+    """Full-merge pin: the committed 2-process journals render to exactly
+    the committed Chrome trace (deterministic ordering + rebasing)."""
+    recs = merge.merge_dir(str(DATA / "telemetry"))
+    got = merge.chrome_trace(recs)
+    expected = json.loads((DATA / "telemetry_golden_trace.json").read_text())
+    assert got == expected
+    # and the injected 2ms straggler in the fixture is attributed
+    table = merge.skew_table(recs)
+    assert table["per_op"]["allreduce"]["max_skew"] == pytest.approx(
+        0.002, abs=1e-4)
+    assert table["per_rank"][1]["last_arrivals"] == 3
+
+
+# ===========================================================================
+# JAX-integration half (needs a working mpi4jax_tpu import)
+# ===========================================================================
+
+
+@pytest.fixture
+def real_telemetry():
+    """Clean real-package telemetry state around a traced test."""
+    import mpi4jax_tpu as mpx
+
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode(None)
+    yield mpx.telemetry
+    mpx.set_telemetry_mode(None)
+    mpx.telemetry.reset()
+    mpx.clear_caches()
+
+
+def _allreduce_calls(snap):
+    return sum(r["calls"] for r in snap["ops"].values()
+               if r["op"] == "allreduce")
+
+
+@needs_mpx
+def test_counters_token_notoken_and_eager_paths(real_telemetry):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as mpx
+    from mpi4jax_tpu.experimental import notoken
+
+    telemetry = real_telemetry
+    mpx.set_telemetry_mode("counters")
+
+    # token path, traced: counts once per TRACE (the host only sees the
+    # trace; the second call is a program-cache hit)
+    @mpx.spmd
+    def f(x):
+        res, tok = mpx.allreduce(x, op=mpx.SUM)
+        res2, _ = mpx.allreduce(res, op=mpx.SUM, token=tok)
+        return res2
+
+    x = jnp.ones((8, 4))
+    np.asarray(f(x))
+    np.asarray(f(x))
+    snap = telemetry.snapshot()
+    assert _allreduce_calls(snap) == 2                 # two dispatch sites
+    assert snap["meters"]["spmd_cache.hits"] == 1
+    assert snap["meters"]["spmd_cache.misses"] == 1
+    assert snap["meters"]["recompiles.spmd.f"] == 1
+
+    # notoken path rides the same dispatch
+    @mpx.spmd
+    def g(x):
+        return notoken.allreduce(x, op=mpx.SUM)
+
+    np.asarray(g(x))
+    assert _allreduce_calls(telemetry.snapshot()) == 3
+
+    # eager path: counts once per CALL, cache hit or not
+    mpx.clear_caches()
+    mpx.allreduce(x, op=mpx.SUM)                       # compile
+    mpx.allreduce(x, op=mpx.SUM)                       # cache hit
+    snap = telemetry.snapshot()
+    assert _allreduce_calls(snap) == 5
+    row = next(r for r in snap["ops"].values() if r["op"] == "allreduce")
+    assert row["bytes"] > 0 and row["dtype"] == "float32"
+    assert snap["meters"]["eager_cache.hits"] == 1
+    assert snap["meters"]["eager_cache.misses"] == 1
+
+
+@needs_mpx
+def test_algo_selection_metered(real_telemetry):
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    mpx.set_telemetry_mode("counters")
+    x = jnp.ones((8, 4))
+    mpx.allreduce(x, op=mpx.SUM)                       # native HLO path
+    mpx.allreduce(x, op=mpx.PROD)                      # butterfly (small)
+    meters = real_telemetry.snapshot()["meters"]
+    assert meters["algo.allreduce.native"] == 1
+    assert meters["algo.allreduce.butterfly"] == 1
+    snap_keys = {r["algo"] for r in
+                 real_telemetry.snapshot()["ops"].values()}
+    assert {"native", "butterfly"} <= snap_keys
+
+
+@needs_mpx
+def test_hlo_byte_identical_off_and_counters(real_telemetry, monkeypatch):
+    """Acceptance pin: ``off`` (default) is byte-identical to an
+    uninstrumented build, ``counters`` is byte-identical to ``off``
+    (host-side bookkeeping only), and ``events`` is NOT (so the pin
+    cannot pass vacuously)."""
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+    from mpi4jax_tpu.telemetry import core as real_core
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM)
+        return res
+
+    x = jnp.ones((8, 4))
+    default_off = jax.jit(f).lower(x).as_text()
+    with monkeypatch.context() as m:
+        # the uninstrumented build: dispatch never opens a record
+        m.setattr(real_core, "open_op", lambda *a, **k: None)
+        uninstrumented = jax.jit(f).lower(x).as_text()
+    assert default_off == uninstrumented
+
+    mpx.set_telemetry_mode("counters")
+    counters = jax.jit(f).lower(x).as_text()
+    assert counters == default_off
+
+    mpx.set_telemetry_mode("events")
+    events = jax.jit(f).lower(x).as_text()
+    assert events != default_off
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    return pred()
+
+
+@needs_mpx
+def test_events_journal_per_rank_and_merge(real_telemetry, tmp_path,
+                                           monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+    from mpi4jax_tpu.telemetry import journal as real_journal
+
+    monkeypatch.setenv("MPI4JAX_TPU_TELEMETRY_DIR", str(tmp_path))
+    mpx.set_telemetry_mode("events")
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM)
+        return res
+
+    jax.block_until_ready(f(jnp.ones((8, 4))))
+    # end callbacks may trail block_until_ready (unordered io_callback)
+    assert _wait_for(lambda: len(real_journal.snapshot_events()) >= 8)
+    real_journal.flush()
+
+    recs = [r for r in real_journal.snapshot_events() if r["type"] == "op"]
+    assert {r["rank"] for r in recs} == set(range(8))
+    assert all(r["op"] == "allreduce" and r["latency"] >= 0 for r in recs)
+    assert all(r["bytes"] == 16 and r["dtype"] == "float32" for r in recs)
+    # per-call_id cross-rank matching: all 8 ranks share one (cid, seq)
+    assert len({(r["call_id"], r["seq"]) for r in recs}) == 1
+
+    # the JSONL on disk merges into a valid Chrome trace
+    mpx.telemetry.reset()  # close the journal file
+    merged = merge.merge_dir(str(tmp_path))
+    assert len([r for r in merged if r["type"] == "op"]) >= 8
+    trace = merge.chrome_trace(merged)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == set(range(8))
+    table = merge.skew_table(merged)
+    assert table["per_op"]["allreduce"]["groups"] >= 1
+
+
+@needs_mpx
+def test_report_renders_per_op_table_with_skew(real_telemetry):
+    import io
+
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+    from mpi4jax_tpu.telemetry import journal as real_journal
+
+    mpx.set_telemetry_mode("events")
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM)
+        return res
+
+    jax.block_until_ready(f(jnp.ones((8, 4))))
+    assert _wait_for(lambda: len(real_journal.snapshot_events()) >= 8)
+
+    buf = io.StringIO()
+    text = mpx.telemetry.report(file=buf)
+    assert buf.getvalue().strip() == text.strip()
+    assert "allreduce" in text
+    assert "skew us" in text and "straggler" in text
+    assert "p50 us" in text and "p99 us" in text
+    # the straggler column names a rank once events span the mesh
+    assert " r" in text
+
+
+@needs_mpx
+def test_dump_writes_snapshot_json(real_telemetry, tmp_path):
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    mpx.set_telemetry_mode("counters")
+    mpx.allreduce(jnp.ones((8, 4)), op=mpx.SUM)
+    path = mpx.telemetry.dump(str(tmp_path / "snap.json"))
+    snap = json.loads(pathlib.Path(path).read_text())
+    assert snap["mode"] == "counters"
+    assert any(r["op"] == "allreduce" for r in snap["ops"].values())
+
+
+@needs_mpx
+def test_eager_cache_stats_and_evictions(real_telemetry, monkeypatch):
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+    from mpi4jax_tpu.ops import _base
+
+    mpx.clear_caches()
+    assert mpx.cache_stats() == {
+        "hits": 0, "misses": 0, "evictions": 0, "size": 0,
+    }
+    x = jnp.ones((8, 4))
+    mpx.allreduce(x, op=mpx.SUM)
+    s = mpx.cache_stats()
+    assert s["misses"] == 1 and s["size"] == 1 and s["hits"] == 0
+    mpx.allreduce(x, op=mpx.SUM)
+    assert mpx.cache_stats()["hits"] == 1
+    # shrink the LRU bound: the next distinct program must evict
+    monkeypatch.setattr(_base, "_EAGER_CACHE_MAX", 1)
+    mpx.allreduce(x, op=mpx.MAX)
+    s = mpx.cache_stats()
+    assert s["evictions"] == 1 and s["size"] == 1
+    mpx.clear_caches()
+    assert mpx.cache_stats() == {
+        "hits": 0, "misses": 0, "evictions": 0, "size": 0,
+    }
+
+
+@needs_mpx
+def test_mode_flip_retraces_eager_program(real_telemetry):
+    """The telemetry tier is folded into the eager cache key: flipping it
+    must retrace (a stale program would silently keep the old
+    instrumentation)."""
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    mpx.clear_caches()
+    x = jnp.ones((8, 4))
+    mpx.allreduce(x, op=mpx.SUM)
+    mpx.set_telemetry_mode("counters")
+    mpx.allreduce(x, op=mpx.SUM)
+    mpx.set_telemetry_mode(None)
+    mpx.allreduce(x, op=mpx.SUM)                # back to the first program
+    s = mpx.cache_stats()
+    assert s["misses"] == 2 and s["hits"] == 1
