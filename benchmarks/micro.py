@@ -190,6 +190,98 @@ def bench_allreduce_algos(comm, sizes_mb, iters=20):
     return rows
 
 
+def bench_fusion(comm, counts=(8, 32), size_kb=64, iters=1):
+    """The collective-fusion sweep (``--fusion-sweep``): N small allreduces
+    per program, fused (``MPI4JAX_TPU_FUSION=auto``, issue-then-consume
+    idiom) vs unfused, reporting per-op wall µs — per-call dispatch plus
+    per-collective latency, the two costs bucketing removes
+    (docs/overlap.md).  The fusion mode is folded into the program cache
+    keys, so each setting compiles its own program."""
+    n = comm.Get_size()
+    nelem = max(1, int(size_kb * 1e3 / 4))
+    rows = []
+    saved = os.environ.get("MPI4JAX_TPU_FUSION")
+    try:
+        for count in counts:
+            row = {"count": count, "size_kb": round(nelem * 4 / 1e3, 2)}
+            for label, mode in (("unfused", "off"), ("fused", "auto")):
+                os.environ["MPI4JAX_TPU_FUSION"] = mode
+
+                @mpx.spmd(comm=comm)
+                def prog(xs):
+                    # the fusion idiom: issue the whole batch, then
+                    # consume — under auto the first use flushes one
+                    # fused flat-buffer collective per dtype bucket
+                    red = [mpx.allreduce(x, op=mpx.SUM)[0] for x in xs]
+                    return [mpx.varying(r * (1.0 / n)) for r in red]
+
+                xs = tuple(
+                    jnp.full((n, nelem), float(i % 5 + 1), jnp.float32)
+                    for i in range(count)
+                )
+                t = _time_program(prog, (xs,))
+                row[f"{label}_us_per_op"] = round(t / count * 1e6, 2)
+            row["fused_speedup"] = round(
+                row["unfused_us_per_op"] / row["fused_us_per_op"], 2
+            )
+            rows.append(row)
+    finally:
+        if saved is None:
+            os.environ.pop("MPI4JAX_TPU_FUSION", None)
+        else:
+            os.environ["MPI4JAX_TPU_FUSION"] = saved
+    return rows
+
+
+def bench_overlap(comm, sizes_mb=(1, 4), iters=10, compute_dim=128):
+    """The async-overlap sweep (``--overlap-sweep``): chunked
+    ``allreduce_start``/``_wait`` with independent synthetic compute
+    issued in the gap, vs the monolithic allreduce followed by the same
+    compute.  Measures how much of the collective the scheduler hides
+    behind the matmul chain (``MPI4JAX_TPU_OVERLAP_CHUNKS`` chunks;
+    docs/overlap.md)."""
+    n = comm.Get_size()
+    rows = []
+    for mb in sizes_mb:
+        nelem = max(1, int(mb * 1e6 / 4))
+
+        @mpx.spmd(comm=comm)
+        def mono(x, w):
+            def body(_, carry):
+                v, m = carry
+                s, _tok = mpx.allreduce(v, op=mpx.SUM)
+                m = jnp.tanh(m @ m)
+                return (mpx.varying(s * (1.0 / n)), m)
+
+            return jax.lax.fori_loop(0, iters, body, (x, w))
+
+        @mpx.spmd(comm=comm)
+        def ovl(x, w):
+            def body(_, carry):
+                v, m = carry
+                h, _tok = mpx.allreduce_start(v, op=mpx.SUM)
+                m = jnp.tanh(m @ m)  # independent: overlaps the phases
+                s, _tok = mpx.allreduce_wait(h)
+                return (mpx.varying(s * (1.0 / n)), m)
+
+            return jax.lax.fori_loop(0, iters, body, (x, w))
+
+        x = jnp.ones((n, nelem), jnp.float32)
+        w = jnp.full((n, compute_dim, compute_dim), 0.01, jnp.float32)
+        from mpi4jax_tpu.utils.config import overlap_chunks
+
+        t_mono = _time_program(mono, (x, w)) / iters
+        t_ovl = _time_program(ovl, (x, w)) / iters
+        rows.append({
+            "size_mb": round(nelem * 4 / 1e6, 3),
+            "chunks": overlap_chunks(),
+            "monolithic_us": round(t_mono * 1e6, 1),
+            "overlap_us": round(t_ovl * 1e6, 1),
+            "overlap_speedup": round(t_mono / t_ovl, 2),
+        })
+    return rows
+
+
 def save_results(payload, outdir=None):
     """Write one sweep payload to ``benchmarks/results/`` (the ``--save``
     flag): ``micro_{platform}_{n}dev_{YYYYMMDD}.json``, returning the path
@@ -226,6 +318,21 @@ def main():
                    default=[0.004, 0.25, 1, 4, 16, 64])
     p.add_argument("--sizes-kb", type=float, nargs="+",
                    default=[0.004, 4, 64, 1024])
+    p.add_argument("--fusion-sweep", action="store_true",
+                   help="also run the collective-fusion sweep (N small "
+                        "allreduces fused vs unfused, per-op dispatch µs; "
+                        "docs/overlap.md)")
+    p.add_argument("--fusion-counts", type=int, nargs="+", default=[8, 32],
+                   help="allreduce counts for --fusion-sweep")
+    p.add_argument("--fusion-size-kb", type=float, default=64,
+                   help="per-allreduce payload for --fusion-sweep (KiB)")
+    p.add_argument("--overlap-sweep", action="store_true",
+                   help="also run the async-overlap sweep (chunked "
+                        "start/wait vs monolithic allreduce with "
+                        "synthetic compute in the gap)")
+    p.add_argument("--overlap-sizes-mb", type=float, nargs="+",
+                   default=[1, 4],
+                   help="payload sizes for --overlap-sweep (MB)")
     args = p.parse_args()
 
     devices = jax.devices()
@@ -269,6 +376,12 @@ def main():
                   args.sizes_mb[:4])
     al = _section("allreduce_algos", bench_allreduce_algos, comm,
                   args.sizes_mb)
+    fu = (_section("fusion", bench_fusion, comm, tuple(args.fusion_counts),
+                   args.fusion_size_kb)
+          if args.fusion_sweep else None)
+    ov = (_section("overlap", bench_overlap, comm,
+                   tuple(args.overlap_sizes_mb))
+          if args.overlap_sweep else None)
 
     payload = {
         "platform": devices[0].platform,
@@ -288,6 +401,10 @@ def main():
         "prod_butterfly": pr,
         "allreduce_algos": al,
     }
+    if fu is not None:
+        payload["fusion"] = fu
+    if ov is not None:
+        payload["overlap"] = ov
     if args.telemetry:
         payload["telemetry"] = telemetry_sections
         mpx.set_telemetry_mode(None)
@@ -319,6 +436,19 @@ def main():
               if r["ring_speedup"] is not None else "n/a (1 device)")
         print(f"  {r['size_mb']:>10.3f} MB   {r['butterfly_us']:>10.1f} us"
               f"   {r['ring_us']:>10.1f} us   {sp}")
+    if fu is not None:
+        print("\nfusion sweep (SUM, f32)       unfused      fused        speedup")
+        for r in fu:
+            print(f"  {r['count']:>4} x {r['size_kb']:>7.1f} KB"
+                  f"   {r['unfused_us_per_op']:>8.2f} us"
+                  f"   {r['fused_us_per_op']:>8.2f} us"
+                  f"   {r['fused_speedup']:>6.2f}x")
+    if ov is not None:
+        print("\noverlap sweep (SUM, f32)      monolithic   start/wait   speedup")
+        for r in ov:
+            print(f"  {r['size_mb']:>10.3f} MB   {r['monolithic_us']:>8.1f} us"
+                  f"   {r['overlap_us']:>8.1f} us"
+                  f"   {r['overlap_speedup']:>6.2f}x")
 
 
 if __name__ == "__main__":

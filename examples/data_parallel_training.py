@@ -60,11 +60,17 @@ def make_train_step(comm: mpx.Comm, lr: float):
     @mpx.spmd(comm=comm)
     def train_step(params, x, y):
         loss, grads = jax.value_and_grad(local_loss)(params, x, y)
-        grads = jax.tree.map(
-            lambda g: mpx.allreduce(g, op=mpx.SUM, comm=comm)[0] / size, grads
+        # the fusion-friendly idiom (docs/overlap.md): issue EVERY
+        # allreduce first, then consume — under MPI4JAX_TPU_FUSION=auto
+        # the whole batch (per-leaf gradients + the scalar loss, all f32)
+        # coalesces into ONE flat-buffer collective; with fusion off the
+        # calls run one by one, same math either way
+        red = jax.tree.map(
+            lambda g: mpx.allreduce(g, op=mpx.SUM, comm=comm)[0], grads
         )
         loss = mpx.allreduce(loss, op=mpx.SUM, comm=comm)[0] / size
-        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        new_params = jax.tree.map(lambda p, g: p - lr * (g / size),
+                                  params, red)
         return mpx.varying((new_params, loss))
 
     return train_step
@@ -92,12 +98,19 @@ def main(steps: int = 200, seed: int = 0):
     params = replicate(init_mlp(key, (16, 64, 1)), size)
     train_step = make_train_step(comm, lr=1e-2)
 
-    t0 = time.perf_counter()
-    for step in range(steps):
-        params, loss = train_step(params, x, y)
-        if step % 50 == 0 or step == steps - 1:
-            print(f"step {step:4d}  loss {float(np.asarray(loss)[0]):.5f}")
-    wall = time.perf_counter() - t0
+    # coalesce the per-leaf gradient allreduces into one flat-buffer
+    # collective per step (Horovod-style tensor fusion, docs/overlap.md);
+    # reset below so this demo leaves no global state behind
+    mpx.set_fusion_mode("auto")
+    try:
+        t0 = time.perf_counter()
+        for step in range(steps):
+            params, loss = train_step(params, x, y)
+            if step % 50 == 0 or step == steps - 1:
+                print(f"step {step:4d}  loss {float(np.asarray(loss)[0]):.5f}")
+        wall = time.perf_counter() - t0
+    finally:
+        mpx.set_fusion_mode(None)
 
     # weights must be identical on every rank (replicated DP invariant)
     for leaf in jax.tree.leaves(params):

@@ -1,0 +1,101 @@
+"""Collective fusion + async overlap — the throughput layer end to end
+(docs/overlap.md).
+
+Three stages on the same mesh, printing what each mechanism did:
+
+1. **fusion** (``MPI4JAX_TPU_FUSION=auto``): sixteen small per-leaf
+   allreduces issued batch-first coalesce into one flat-buffer
+   collective per dtype bucket — the telemetry meters show the buckets
+   formed and the member ops packed;
+2. **explicit start/wait**: an allreduce split into chunked
+   double-buffered ring phases with independent compute in the gap;
+3. **mpx.overlap() region**: the same split, implicit — the wait is
+   emitted at the result's first use.
+
+Verified clean by the trace-time verifier in CI
+(``python -m mpi4jax_tpu.analysis examples/fusion_overlap_demo.py``):
+with fusion ON there are no MPX111 advisories to fire, and every start
+is paired (MPX112).
+
+Run: python examples/fusion_overlap_demo.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+
+def main():
+    devices = jax.devices()
+    mesh = mpx.make_world_mesh(devices=devices)
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+
+    # --- 1. fusion: many small collectives -> one flat-buffer collective
+    mpx.set_fusion_mode("auto")
+    mpx.set_telemetry_mode("counters")
+    try:
+        leaves = [jnp.full((n, 64 * (i % 3 + 1)), float(i + 1), jnp.float32)
+                  for i in range(16)]
+
+        @mpx.spmd(comm=comm)
+        def fused_sum(xs):
+            # issue the whole batch, then consume: the first use flushes
+            # ONE fused allreduce (docs/overlap.md)
+            red = [mpx.allreduce(x, op=mpx.SUM)[0] for x in xs]
+            return [mpx.varying(r * (1.0 / n)) for r in red]
+
+        out = fused_sum(tuple(leaves))
+        np.testing.assert_allclose(np.asarray(out[2])[0, 0], 3.0, rtol=1e-6)
+        meters = mpx.telemetry.snapshot()["meters"]
+        buckets = sum(v for k, v in meters.items()
+                      if k.startswith("fusion.") and k.endswith(".buckets"))
+        members = sum(v for k, v in meters.items()
+                      if k.startswith("fusion.") and k.endswith(".members"))
+        print(f"fusion: {members} member allreduces -> {buckets} fused "
+              f"flat-buffer collective(s)")
+    finally:
+        mpx.set_fusion_mode(None)
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+    # --- 2. explicit start/wait: compute overlaps the wire phases
+    @mpx.spmd(comm=comm)
+    def split_step(g, m):
+        h, tok = mpx.allreduce_start(g, op=mpx.SUM)
+        m = jnp.tanh(m @ m)          # independent: overlaps both phases
+        s, tok = mpx.allreduce_wait(h, token=tok)
+        return mpx.varying(s * (1.0 / n)), m
+
+    g = jnp.ones((n, 4096), jnp.float32)
+    m = jnp.full((n, 32, 32), 0.01, jnp.float32)
+    avg, m2 = split_step(g, m)
+    np.testing.assert_allclose(np.asarray(avg)[0, :3], 1.0, rtol=1e-6)
+    print(f"start/wait: chunked ring allreduce of {g.shape[-1]} floats "
+          f"with a {m.shape[-1]}x{m.shape[-1]} matmul chain in the gap")
+
+    # --- 3. the implicit form: mpx.overlap()
+    @mpx.spmd(comm=comm)
+    def overlap_step(g, m):
+        with mpx.overlap():
+            s, _ = mpx.allreduce(g, op=mpx.SUM)   # start emitted here
+            m = jnp.tanh(m @ m)                   # overlaps
+            out = s * (1.0 / n)                   # first use -> wait
+        return mpx.varying(out), m
+
+    avg2, _ = overlap_step(g, m)
+    np.testing.assert_allclose(np.asarray(avg2), np.asarray(avg), rtol=1e-6)
+    print(f"overlap(): same result, wait emitted at first use "
+          f"({n} device(s))")
+
+
+if __name__ == "__main__":
+    main()

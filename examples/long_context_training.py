@@ -92,12 +92,17 @@ def make_train_step(world, sp, heads, lr=1e-2):
     @mpx.spmd(comm=world)
     def step(params, x, y):
         local, grads = jax.value_and_grad(local_loss)(params, x, y)
+        # the fusion-friendly idiom (docs/overlap.md): issue the loss +
+        # every per-leaf gradient allreduce first, consume after — under
+        # MPI4JAX_TPU_FUSION=auto the adjacent run coalesces into one
+        # flat-buffer collective; with fusion off it runs call by call,
+        # same math either way
         loss, tok = mpx.allreduce(local, op=mpx.SUM, comm=world)
-        out = {}
+        red = {}
         for name in sorted(grads):
-            g, tok = mpx.allreduce(grads[name], op=mpx.SUM, comm=world,
-                                   token=tok)
-            out[name] = params[name] - lr * g
+            red[name], tok = mpx.allreduce(grads[name], op=mpx.SUM,
+                                           comm=world, token=tok)
+        out = {name: params[name] - lr * red[name] for name in red}
         return out, mpx.varying(loss, comm=world)
 
     return step
@@ -122,9 +127,16 @@ def main():
 
     step = make_train_step(world, sp, heads, lr=0.1)
     losses = []
-    for i in range(5):
-        params_g, loss = step(params_g, x, y)
-        losses.append(float(jnp.asarray(loss)[0]))
+    # fuse the adjacent gradient allreduces into one flat-buffer
+    # collective per step (docs/overlap.md); reset below so the demo
+    # leaves no global state behind
+    mpx.set_fusion_mode("auto")
+    try:
+        for i in range(5):
+            params_g, loss = step(params_g, x, y)
+            losses.append(float(jnp.asarray(loss)[0]))
+    finally:
+        mpx.set_fusion_mode(None)
     print(f"dp={n_dp} x sp={n_sp}: loss {losses[0]:.4f} -> {losses[-1]:.4f} "
           f"over {len(losses)} steps")
     assert losses[-1] < losses[0], "training did not reduce the loss"

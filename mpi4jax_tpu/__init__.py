@@ -30,11 +30,14 @@ from .ops import (  # noqa: F401
     MIN,
     PROD,
     SUM,
+    AsyncHandle,
     Op,
     Status,
     Token,
     allgather,
     allreduce,
+    allreduce_start,
+    allreduce_wait,
     alltoall,
     barrier,
     bcast,
@@ -42,13 +45,17 @@ from .ops import (  # noqa: F401
     clear_caches,
     create_token,
     gather,
+    overlap,
     recv,
     reduce,
     reduce_scatter,
+    reduce_scatter_start,
+    reduce_scatter_wait,
     scan,
     scatter,
     send,
     sendrecv,
+    set_fusion_mode,
     varying,
 )
 from .parallel import (  # noqa: F401
@@ -148,6 +155,14 @@ __all__ = [
     "cache_stats",
     "profile_ops",
     "ProfileSummary",
+    # throughput layer: fusion + async overlap (docs/overlap.md)
+    "allreduce_start",
+    "allreduce_wait",
+    "reduce_scatter_start",
+    "reduce_scatter_wait",
+    "AsyncHandle",
+    "overlap",
+    "set_fusion_mode",
     # runtime telemetry (docs/observability.md)
     "telemetry",
     "set_telemetry_mode",

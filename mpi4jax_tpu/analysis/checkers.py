@@ -35,6 +35,17 @@ ALGO_OPS = ("allreduce", "reduce", "bcast", "reduce_scatter")
 # checkers stay importable without jax; test_analysis_pure pins equality)
 RING_MIN_GROUP = 4
 
+# ops the fusion deferral layer accepts, mirrored from ops/_fusion.py
+# FUSABLE_OPS (same no-jax-import rationale; equality pinned by
+# tests/test_analysis_pure.py)
+FUSABLE_OPS = ("allreduce", "bcast")
+
+# enum reduction names (ops/_base.Op values, mirrored literally): only
+# enum reductions defer — a callable records its __name__ here and can
+# never fuse, so advising MPI4JAX_TPU_FUSION=auto for it would be wrong
+ENUM_REDUCTIONS = ("sum", "prod", "min", "max", "land", "lor", "lxor",
+                   "band", "bor", "bxor")
+
 CHECKERS: List[tuple] = []  # (codes, fn)
 
 
@@ -241,6 +252,101 @@ def check_token_chains(graph: CollectiveGraph) -> List[Finding]:
                                f"{stale.where()} (each op consumes the "
                                "previous op's token)"),
                 ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# fusion opportunity (MPX111) + async pairing (MPX112)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX111")
+def check_unfused_adjacent(graph: CollectiveGraph) -> List[Finding]:
+    """Adjacent fusable collectives that would bucket, with fusion off:
+    a run of >= 2 consecutive events sharing (op, comm, reduction, root),
+    each within the bucket byte cap — exactly what
+    ``MPI4JAX_TPU_FUSION=auto`` coalesces into one flat-buffer collective
+    (the packing is dtype-segregated, so mixed dtypes still bucket).
+
+    Gated on the config snapshot EXPLICITLY recording ``fusion: off``
+    (every real trace does, via ``hook.config_snapshot``): hand-built
+    graphs without fusion meta are testing other rules."""
+    if graph.meta.get("fusion") != "off":
+        return []
+    cap = graph.meta.get("fusion_bucket_bytes", 0)
+    findings: List[Finding] = []
+    run: List = []
+
+    def _key(e):
+        return (e.op, e.comm_uid, e.reduction, e.root)
+
+    def _close(run):
+        if len(run) >= 2:
+            first = run[0]
+            total = sum(e.payload_bytes for e in run)
+            findings.append(Finding(
+                code="MPX111", op=first.op, index=first.index,
+                message=(f"{len(run)} adjacent {first.op} collectives on "
+                         f"comm {first.comm_uid} "
+                         f"(events {first.index}..{run[-1].index}, "
+                         f"{total} B total) would coalesce into one "
+                         "flat-buffer collective, but "
+                         "MPI4JAX_TPU_FUSION is off"),
+                suggestion=("set MPI4JAX_TPU_FUSION=auto (or call "
+                            "mpx.set_fusion_mode('auto')) and consume "
+                            "results after issuing the whole batch — see "
+                            "docs/overlap.md"),
+            ))
+
+    for e in graph.events:
+        fusable = (e.op in FUSABLE_OPS and not e.eager
+                   and (e.reduction is None or e.reduction in ENUM_REDUCTIONS)
+                   and (not cap or e.payload_bytes <= cap))
+        if fusable and run and _key(run[-1]) == _key(e):
+            run.append(e)
+            continue
+        _close(run)
+        run = [e] if fusable else []
+    _close(run)
+    return findings
+
+
+@checker("MPX112")
+def check_start_wait(graph: CollectiveGraph) -> List[Finding]:
+    """Async pairing: every ``*_start`` needs exactly one later ``*_wait``
+    on the same span handle, and every wait needs a live start.  An
+    unwaited start's phases are silently dead-code-eliminated (and leave
+    the collective watchdog armed); a wait without a live start is a
+    double wait."""
+    findings: List[Finding] = []
+    open_starts: dict = {}  # span id -> start event
+    for e in graph.events:
+        if e.span is None:
+            continue
+        if e.op.endswith("_start"):
+            open_starts[e.span] = e
+        elif e.op.endswith("_wait"):
+            if open_starts.pop(e.span, None) is None:
+                findings.append(Finding(
+                    code="MPX112", op=e.op, index=e.index,
+                    message=(f"{e.where()} has no live matching "
+                             f"{e.op.replace('_wait', '_start')} on this "
+                             "token chain (wait before start, or a "
+                             "second wait on the same handle)"),
+                    suggestion=("pair each *_start handle with exactly "
+                                "one *_wait, in program order"),
+                ))
+    for span, e in sorted(open_starts.items()):
+        findings.append(Finding(
+            code="MPX112", op=e.op, index=e.index,
+            message=(f"{e.where()} is never waited: its communication "
+                     "phases have no consumer and will be dead-code-"
+                     "eliminated (with the watchdog armed at start, the "
+                     "missing disarm is fatal at run time)"),
+            suggestion=(f"call {e.op.replace('_start', '_wait')} on the "
+                        "returned handle (mpx.overlap() pairs "
+                        "automatically at region exit)"),
+        ))
     return findings
 
 

@@ -46,6 +46,9 @@ class CollectiveEvent:
     token_in: Optional[int] = None
     token_out: Optional[int] = None
     eager: bool = False
+    span: Optional[int] = None          # async start/wait pairing handle id
+    fused_members: Optional[int] = None  # member ops packed into this op
+    fused_bytes: Optional[int] = None   # flat-buffer payload bytes
     extra: Dict = field(default_factory=dict)
 
     def where(self) -> str:

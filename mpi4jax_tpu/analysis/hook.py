@@ -40,12 +40,14 @@ def set_analyze_mode(mode: Optional[str]) -> None:
     global _mode_override
     if mode is None:
         _mode_override = _UNSET
+        config.bump_config_epoch()
         return
     if mode not in config.ANALYZE_MODES:
         raise ValueError(
             f"analyze mode must be one of {config.ANALYZE_MODES}, got {mode!r}"
         )
     _mode_override = mode
+    config.bump_config_epoch()
 
 
 def effective_mode() -> str:
@@ -77,9 +79,13 @@ class Recorder:
 
 
 def config_snapshot() -> dict:
+    from ..ops._fusion import effective_mode as fusion_mode
+
     return {
         "collective_algo": config.collective_algo(),
         "ring_crossover_bytes": config.ring_crossover_bytes(),
+        "fusion": fusion_mode(),
+        "fusion_bucket_bytes": config.fusion_bucket_bytes(),
     }
 
 

@@ -100,6 +100,23 @@ CODES = {
             "(comm, tag).  FIFO picks the oldest; if the sends are not "
             "interchangeable, use distinct tags or a Clone()d comm.",
         ),
+        CodeInfo(
+            "MPX111", "adjacent fusable collectives not fused", ADVISORY,
+            "With MPI4JAX_TPU_FUSION=off, two or more adjacent "
+            "collectives share (op, comm, reduction, root) and each fits "
+            "the fusion bucket cap: enabling MPI4JAX_TPU_FUSION=auto "
+            "would coalesce them into one flat-buffer collective and cut "
+            "per-call dispatch + per-collective latency "
+            "(docs/overlap.md).",
+        ),
+        CodeInfo(
+            "MPX112", "unpaired async start/wait", ERROR,
+            "An async collective's *_start has no matching *_wait on the "
+            "token chain (its phases would be dead-code-eliminated "
+            "silently — with the watchdog armed, fatally), or a *_wait "
+            "ran without a live start (double wait).  Each start pairs "
+            "with exactly one wait on the same handle.",
+        ),
     )
 }
 

@@ -24,6 +24,15 @@ from ._base import (  # noqa: F401
     clear_caches,
     varying,
 )
+from ._async import (  # noqa: F401
+    AsyncHandle,
+    allreduce_start,
+    allreduce_wait,
+    overlap,
+    reduce_scatter_start,
+    reduce_scatter_wait,
+)
+from ._fusion import set_fusion_mode  # noqa: F401
 from .allgather import allgather  # noqa: F401
 from .allreduce import allreduce  # noqa: F401
 from .alltoall import alltoall  # noqa: F401

@@ -1,0 +1,513 @@
+"""Async collectives: ``*_start``/``*_wait`` pairs + the ``mpx.overlap()``
+region — communication/compute overlap for ``allreduce`` and
+``reduce_scatter``.
+
+A monolithic collective is one HLO op: XLA schedules everything after it
+behind it, so independent compute waits on the wire.  Splitting the
+collective into explicit phases turns it into multiple smaller ops with a
+data-dependency gap the scheduler can fill (the trace-time analog of
+PyTorch DDP's overlap-scheduled bucket allreduce, Li et al., VLDB 2020):
+
+- ``allreduce_start`` flattens the payload, splits it into
+  ``MPI4JAX_TPU_OVERLAP_CHUNKS`` independent chunks (default 2 — classic
+  double buffering), and emits each chunk's **ring reduce-scatter** phase;
+- ``allreduce_wait`` emits each chunk's **ring allgather** phase and
+  reassembles the exact original shape.
+
+Between start and wait the program is free: independent compute issued
+there has no data dependency on either phase, and chunk ``i``'s allgather
+can run while chunk ``i+1``'s reduce-scatter is still on the wire.
+``reduce_scatter_start/wait`` splits the same way (its blocks chunk over
+the payload axis; the wait phase is pure reassembly).
+
+Where the ring is not expressible (unequal color-split groups, callable
+reductions, a forced butterfly, k <= 1) the start emits the whole
+collective and the wait is reassembly only — always correct, no overlap.
+
+Instrumentation spans the pair: the resilience plan's fault probe and
+**watchdog arm** tie to the start's inputs and the **disarm** to the
+wait's output (an unwaited collective is "in flight" and will trip the
+watchdog); the telemetry events bracket opens at the start's input
+readiness (arrival) and closes at the wait's output, so cross-rank skew
+attributes stragglers exactly like the synchronous ops.  The analysis
+layer records both ops with a shared span id — MPX112 flags a start whose
+wait never appears (its phases would be dead-code-eliminated silently)
+and a wait without a live start.
+
+``mpx.overlap()`` is the implicit form: inside the region, plain
+``allreduce``/``reduce_scatter`` calls auto-split — the start is emitted
+at the call site and the wait is deferred until the result is first used
+(or the region exits), so everything between the call and the use
+overlaps with the wire phases.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional
+
+from ..utils import config
+from ..utils.validation import enforce_types
+from ..parallel.comm import Comm
+from . import _fusion
+from .token import Token, consume, produce
+
+__all__ = [
+    "AsyncHandle",
+    "allreduce_start",
+    "allreduce_wait",
+    "reduce_scatter_start",
+    "reduce_scatter_wait",
+    "overlap",
+    "overlap_cache_token",
+    "overlap_chunk_split",
+]
+
+_span_counter = itertools.count()
+
+
+def overlap_cache_token() -> tuple:
+    """Folded into both compiled-program cache keys: the chunk count
+    shapes every start/wait trace."""
+    return (config.overlap_chunks(),)
+
+
+def overlap_chunk_split(n: int, chunks: int) -> List[int]:
+    """Chunk element counts for an ``n``-element payload (pure — shared
+    with tests/test_overlap.py's plan checks): at most ``chunks`` pieces,
+    balanced to within one ``ceil(n/chunks)`` stride, none empty, summing
+    to ``n``."""
+    if n <= 0:
+        return [n]
+    c = max(1, min(int(chunks), n))
+    stride = -(-n // c)
+    sizes = []
+    left = n
+    while left > 0:
+        take = min(stride, left)
+        sizes.append(take)
+        left -= take
+    return sizes
+
+
+class AsyncHandle:
+    """In-flight state of one started collective: the phase-1 outputs plus
+    the instrumentation stash the wait must close (watchdog disarm,
+    telemetry bracket end, native op_end)."""
+
+    __slots__ = ("kind", "comm", "reduction", "shape", "dtype", "sizes",
+                 "k", "mode", "pieces", "span", "uid", "waited", "algo")
+
+    def __init__(self, kind, comm, reduction):
+        self.kind = kind
+        self.comm = comm
+        self.reduction = reduction
+        self.shape = None
+        self.dtype = None
+        self.sizes = None       # chunk element counts (ring mode)
+        self.k = None
+        self.mode = None        # "ring" | "full"
+        self.pieces = None
+        self.span = None
+        self.uid = next(_span_counter)
+        self.waited = False
+        self.algo = None
+
+    def __repr__(self):
+        state = "waited" if self.waited else "in-flight"
+        return (f"AsyncHandle({self.kind}#{self.uid}, mode={self.mode}, "
+                f"{state})")
+
+
+# ---------------------------------------------------------------------------
+# the instrumentation span (start -> wait)
+# ---------------------------------------------------------------------------
+
+
+def _span_open(base_op: str, comm, arrays, token, handle: AsyncHandle):
+    """Open the pair-spanning instrumentation at the start op: resilience
+    probe + watchdog arm and the events-tier journal begin tie to the
+    start's inputs; the closers are stashed on the handle for the wait."""
+    from .. import native
+    from ..resilience import runtime as _resilience
+    from ..telemetry import bracket as _tbracket
+    from ..telemetry import core as _tcore
+    from ..utils.debug import get_runtime_tracing
+    from ._base import _mpi_opname, _next_call_id
+
+    plan = _resilience.plan_for(base_op)
+    tracing = get_runtime_tracing() and native.runtime_tracing_supported()
+    rec = _tcore.current_open()  # the open start-op counter record
+    ebr = _tbracket.bracket_for(rec)
+    if plan is None and not tracing and ebr is None:
+        handle.span = None
+        return arrays, token
+    call_id = _next_call_id()
+    name = _mpi_opname(base_op)
+    rank = None
+    if plan is not None:
+        arrays, token = plan.before(name, call_id, comm, arrays, token)
+    if ebr is not None:
+        arrays, token = ebr.begin(call_id, comm, arrays, token)
+    if tracing:
+        rank = comm.Get_rank()
+        begin = native.op_begin(name, call_id, rank, "")
+        arrays = tuple(native._tie(a, begin) for a in arrays)
+    handle.span = (plan, call_id, name, ebr, tracing, rank)
+    return arrays, token
+
+
+def _span_close(handle: AsyncHandle, comm, dep, results) -> None:
+    """Close the span at the wait op: native op_end, journal end, watchdog
+    disarm + output guards — each tied to the wait's first output."""
+    if handle.span is None:
+        return
+    from .. import native
+
+    plan, call_id, name, ebr, tracing, rank = handle.span
+    handle.span = None
+    if tracing:
+        native.op_end(name, call_id, rank, dep)
+    if ebr is not None:
+        ebr.end(call_id, comm, dep)
+    if plan is not None:
+        plan.after(name, call_id, comm, dep, results)
+
+
+def _meter_chunks(opname: str, comm, dtype, n_chunks: int) -> None:
+    from ..telemetry import core as _telemetry
+
+    if _telemetry.effective_mode() == "off":
+        return
+    _telemetry.meter(f"overlap.{opname}.c{comm.uid}.{dtype}.chunks", n_chunks)
+
+
+def _require_region(opname: str, comm):
+    from ..parallel.region import in_parallel_region, resolve_comm
+
+    comm = resolve_comm(comm)
+    if not in_parallel_region(comm):
+        raise RuntimeError(
+            f"{opname}: the async start/wait collectives work inside a "
+            "parallel region only (mpx.spmd / mpx.run / jax.shard_map); "
+            "eager global-array calls have one compiled program per op, "
+            "so there is no schedule to overlap into."
+        )
+    return comm
+
+
+def _annotate_algo(algo: str) -> None:
+    from ..analysis.hook import annotate
+    from ..telemetry.core import annotate as t_annotate
+
+    annotate(algo=algo)
+    t_annotate(algo=algo)
+
+
+# ---------------------------------------------------------------------------
+# allreduce start / wait
+# ---------------------------------------------------------------------------
+
+
+@enforce_types(comm=(Comm, None), token=(Token, None))
+def allreduce_start(x, op=None, *, comm: Optional[Comm] = None,
+                    token: Optional[Token] = None):
+    """Begin an async allreduce: emits the chunked ring reduce-scatter
+    phase and returns ``(handle, token)``.  Issue independent compute,
+    then finish with :func:`allreduce_wait` (docs/overlap.md).
+    """
+    from . import _algos
+    from ._base import (SUM, Op, apply_allreduce, as_varying, dispatch,
+                        reduction_name)
+
+    if op is None:
+        op = SUM
+    comm = _require_region("allreduce_start", comm)
+    handle = AsyncHandle("allreduce", comm, op)
+
+    def body(comm, arrays, token):
+        arrays, token = _span_open("allreduce", comm, arrays, token, handle)
+        (xl,) = arrays
+        xl = consume(token, xl)
+        handle.shape = xl.shape
+        handle.dtype = xl.dtype
+        k = _algos.static_group_size(comm)
+        algo = config.collective_algo()
+        ring_ok = (k is not None and k > 1 and isinstance(op, Op)
+                   and algo != "butterfly")
+        if not ring_ok:
+            handle.mode = "full"
+            handle.algo = "butterfly"
+            full = apply_allreduce(xl, op, comm)
+            return full, produce(token, full)
+        handle.mode = "ring"
+        handle.algo = "ring"
+        handle.k = k
+        xl = as_varying(xl, comm.axes)
+        flat = xl.reshape(-1)
+        sizes = overlap_chunk_split(flat.shape[0], config.overlap_chunks())
+        handle.sizes = sizes
+        _annotate_algo("ring")
+        _meter_chunks("allreduce", comm, flat.dtype, len(sizes))
+        pieces = []
+        off = 0
+        for csz in sizes:
+            seg = flat[off:off + csz]
+            off += csz
+            chunk, padded = _algos.chunk_layout(csz, k)
+            blocks = _algos._pad_to(seg, padded).reshape(k, chunk)
+            pieces.append(_algos.apply_ring_reduce_scatter(blocks, op, comm, k))
+        return (*pieces, produce(token, pieces[0]))
+
+    out = dispatch("allreduce_start", comm, body, (x,), token,
+                   ana={"reduction": reduction_name(op), "span": handle.uid},
+                   bare=True)
+    *pieces, tok = out
+    handle.pieces = tuple(pieces)
+    return handle, tok
+
+
+@enforce_types(token=(Token, None))
+def allreduce_wait(handle, *, token: Optional[Token] = None):
+    """Finish an async allreduce: emits the chunked ring allgather phase,
+    reassembles the exact input shape, and closes the start's
+    instrumentation span.  Returns ``(result, token)``."""
+    _check_handle("allreduce_wait", handle, "allreduce")
+    from . import _algos
+    from ._base import dispatch
+
+    comm = handle.comm
+
+    def body(comm, arrays, token):
+        arrays = consume(token, *arrays)
+        if len(handle.pieces) == 1:
+            arrays = (arrays,)
+        if handle.mode == "full":
+            res = arrays[0]
+        else:
+            import jax.numpy as jnp
+
+            k, pos = handle.k, comm.Get_rank()
+            parts = []
+            for piece, csz in zip(arrays, handle.sizes):
+                full = _algos.apply_ring_allgather(piece, comm, k, pos)
+                parts.append(full.reshape(-1)[:csz])
+            flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            res = flat.reshape(handle.shape)
+        _annotate_algo(handle.algo)
+        _span_close(handle, comm, res, [res])
+        return res, produce(token, res)
+
+    res, tok = dispatch("allreduce_wait", comm, body, handle.pieces, token,
+                        ana={"span": handle.uid}, bare=True)
+    handle.waited = True
+    handle.pieces = None
+    return res, tok
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter start / wait
+# ---------------------------------------------------------------------------
+
+
+@enforce_types(comm=(Comm, None), token=(Token, None))
+def reduce_scatter_start(x, op=None, *, comm: Optional[Comm] = None,
+                         token: Optional[Token] = None):
+    """Begin an async reduce_scatter of ``x`` (shape ``(size, *s)``, block
+    ``i`` addressed to rank ``i``): emits the chunked ring reduce-scatter
+    phase and returns ``(handle, token)``; finish with
+    :func:`reduce_scatter_wait`."""
+    from . import _algos
+    from ._base import SUM, Op, as_varying, dispatch, reduction_name
+
+    if op is None:
+        op = SUM
+    comm = _require_region("reduce_scatter_start", comm)
+    handle = AsyncHandle("reduce_scatter", comm, op)
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        if xl.ndim == 0 or xl.shape[0] != size:
+            raise ValueError(
+                f"reduce_scatter_start input must have leading axis == "
+                f"comm size ({size}), got shape {xl.shape}"
+            )
+        arrays, token = _span_open("reduce_scatter", comm, (xl,), token,
+                                   handle)
+        xl = consume(token, arrays[0])
+        handle.shape = xl.shape[1:]
+        handle.dtype = xl.dtype
+        handle.k = size
+        xl = as_varying(xl, comm.axes)
+        if size == 1:
+            handle.mode = "full"
+            handle.algo = "butterfly"
+            res = xl[0]
+            return res, produce(token, res)
+        if not isinstance(op, Op) or config.collective_algo() == "butterfly":
+            handle.mode = "full"
+            handle.algo = "butterfly"
+            res = _algos.apply_reduce_scatter(xl, op, comm)
+            return res, produce(token, res)
+        handle.mode = "ring"
+        handle.algo = "ring"
+        blocks = xl.reshape(size, -1)
+        sizes = overlap_chunk_split(blocks.shape[1], config.overlap_chunks())
+        handle.sizes = sizes
+        _annotate_algo("ring")
+        _meter_chunks("reduce_scatter", comm, blocks.dtype, len(sizes))
+        pieces = []
+        off = 0
+        for csz in sizes:
+            sub = blocks[:, off:off + csz]
+            off += csz
+            pieces.append(_algos.apply_ring_reduce_scatter(sub, op, comm,
+                                                           size))
+        return (*pieces, produce(token, pieces[0]))
+
+    out = dispatch("reduce_scatter_start", comm, body, (x,), token,
+                   ana={"reduction": reduction_name(op), "span": handle.uid},
+                   bare=True)
+    *pieces, tok = out
+    handle.pieces = tuple(pieces)
+    return handle, tok
+
+
+@enforce_types(token=(Token, None))
+def reduce_scatter_wait(handle, *, token: Optional[Token] = None):
+    """Finish an async reduce_scatter: reassembles this rank's block
+    (shape ``s``) from the chunk pieces and closes the span.  Returns
+    ``(result, token)``."""
+    _check_handle("reduce_scatter_wait", handle, "reduce_scatter")
+    from ._base import dispatch
+
+    comm = handle.comm
+
+    def body(comm, arrays, token):
+        arrays = consume(token, *arrays)
+        if len(handle.pieces) == 1:
+            arrays = (arrays,)
+        if handle.mode == "full":
+            res = arrays[0]
+        else:
+            import jax.numpy as jnp
+
+            flat = (jnp.concatenate(arrays) if len(arrays) > 1
+                    else arrays[0])
+            res = flat.reshape(handle.shape)
+        _annotate_algo(handle.algo)
+        _span_close(handle, comm, res, [res])
+        return res, produce(token, res)
+
+    res, tok = dispatch("reduce_scatter_wait", comm, body, handle.pieces,
+                        token, ana={"span": handle.uid}, bare=True)
+    handle.waited = True
+    handle.pieces = None
+    return res, tok
+
+
+def _check_handle(opname: str, handle, kind: str) -> None:
+    from ..analysis.report import mpx_error
+
+    if not isinstance(handle, AsyncHandle) or handle.kind != kind:
+        raise TypeError(
+            f"{opname} expects the AsyncHandle returned by {kind}_start, "
+            f"got {handle!r}"
+        )
+    if handle.waited:
+        raise mpx_error(
+            RuntimeError, "MPX112",
+            f"{opname}: this handle was already waited — each "
+            f"{kind}_start pairs with exactly one {kind}_wait",
+        )
+
+
+# ---------------------------------------------------------------------------
+# the overlap() region: implicit start/wait
+# ---------------------------------------------------------------------------
+
+
+class _Scope:
+    __slots__ = ("lazies",)
+
+    def __init__(self):
+        self.lazies: List["_LazyWait"] = []
+
+
+_overlap_stack: List[_Scope] = []
+
+
+class overlap:
+    """``with mpx.overlap():`` — inside, ``allreduce`` and
+    ``reduce_scatter`` auto-split into start/wait: the start phase is
+    emitted at the call site and the wait is deferred until the result is
+    first used (or the region exits), so the compute issued in between
+    overlaps with the wire phases.  Requires a managed parallel region
+    (``mpx.spmd`` / ``mpx.run``); see docs/overlap.md."""
+
+    def __enter__(self):
+        from ..parallel.region import _region_stack
+
+        if not _region_stack:
+            raise RuntimeError(
+                "mpx.overlap() requires a managed parallel region "
+                "(mpx.spmd / mpx.run); use explicit allreduce_start/"
+                "allreduce_wait inside a raw jax.shard_map body"
+            )
+        self._scope = _Scope()
+        _overlap_stack.append(self._scope)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _overlap_stack.pop()
+        if exc_type is None:
+            for lw in self._scope.lazies:
+                lw._force()
+        return False
+
+
+class _LazyWait(_fusion.LazyResult):
+    """Deferred wait: forces ``*_wait`` on first use of the result."""
+
+    __slots__ = ("_handle",)
+
+    def __init__(self, handle, shape, dtype):
+        super().__init__(shape, dtype, None)
+        self._handle = handle
+
+    def _force(self):
+        if self._value is None:
+            if self._handle.kind == "allreduce":
+                res, _ = allreduce_wait(self._handle)
+            else:
+                res, _ = reduce_scatter_wait(self._handle)
+            self._value = res
+        return self._value
+
+
+def overlap_active() -> bool:
+    """True when ops should auto-split (inside ``mpx.overlap()``, not
+    mid-flush of the fusion layer)."""
+    return bool(_overlap_stack) and not _fusion._inhibit
+
+
+def maybe_lazy(opname: str, x, op, comm, token):
+    """Route one collective through start + deferred wait; ``None`` when
+    the overlap region is inactive for this call."""
+    if not overlap_active():
+        return None
+    from ..parallel.region import in_parallel_region, resolve_comm
+
+    comm = resolve_comm(comm)
+    if not in_parallel_region(comm):
+        return None
+    if opname == "allreduce":
+        handle, tok = allreduce_start(x, op, comm=comm, token=token)
+        shape = handle.shape
+    else:
+        handle, tok = reduce_scatter_start(x, op, comm=comm, token=token)
+        shape = handle.shape
+    lw = _LazyWait(handle, shape, handle.dtype)
+    _overlap_stack[-1].lazies.append(lw)
+    return lw, tok

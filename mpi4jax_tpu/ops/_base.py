@@ -39,9 +39,11 @@ from ..utils.dtypes import check_dtype
 # the trace-time collective verifier and the telemetry layer ride the same
 # single dispatch point as resilience and the algorithm selector (imported
 # last: analysis and telemetry.core only depend on utils.config, so the
-# package import order stays acyclic)
+# package import order stays acyclic); the fusion deferral layer hooks the
+# same point (flush-on-dispatch preserves program order)
 from ..analysis import hook as _analysis
 from ..telemetry import core as _telemetry
+from . import _fusion
 
 
 class Op(enum.Enum):
@@ -302,7 +304,10 @@ def varying(x, *, comm: Optional[Comm] = None):
     collective must be re-typed with this helper.  See docs/sharp_bits.md.
     """
     comm = resolve_comm(comm)
-    return jax.tree.map(lambda v: as_varying(v, comm.axes), x)
+    # deferred fusion/overlap results materialize here: re-typing is a use
+    return jax.tree.map(
+        lambda v: as_varying(_fusion.materialize_value(v), comm.axes), x
+    )
 
 
 def as_varying(x, axes: Tuple[str, ...]):
@@ -337,7 +342,7 @@ def _next_call_id() -> str:
     return f"{next(_call_id_counter) & 0xFFFFFFFF:08x}"
 
 
-def _run_body(opname: str, comm: Comm, body, arrays, token):
+def _run_body(opname: str, comm: Comm, body, arrays, token, bare=False):
     """Run an op body, bracketed by the instrumentation every op shares:
 
     - native runtime begin/end hooks when tracing is on (host-side log +
@@ -361,13 +366,20 @@ def _run_body(opname: str, comm: Comm, body, arrays, token):
     feature off, and telemetry off or counters-only (the default is off)
     the body's traced program is untouched — the lowered HLO is
     byte-identical to an uninstrumented build (pinned by
-    tests/test_resilience.py and tests/test_telemetry.py)."""
+    tests/test_resilience.py and tests/test_telemetry.py).
+
+    ``bare=True`` keeps only the telemetry counter record: the async
+    ``*_start``/``*_wait`` ops (ops/_async.py) carry their own
+    pair-SPANNING resilience/trace/journal instrumentation (watchdog armed
+    at start, disarmed at wait), which per-phase bracketing here would
+    double-instrument."""
     from .. import native
     from ..resilience import runtime as _resilience
     from ..telemetry import bracket as _tbracket
 
-    plan = _resilience.plan_for(opname)
-    tracing = get_runtime_tracing() and native.runtime_tracing_supported()
+    plan = None if bare else _resilience.plan_for(opname)
+    tracing = (not bare) and get_runtime_tracing() \
+        and native.runtime_tracing_supported()
     rec = _telemetry.open_op(opname, comm, arrays)
     if plan is None and not tracing and rec is None:
         return body(comm, arrays, token)
@@ -375,7 +387,7 @@ def _run_body(opname: str, comm: Comm, body, arrays, token):
     try:
         call_id = _next_call_id()
         name = _mpi_opname(opname)
-        ebr = _tbracket.bracket_for(rec)
+        ebr = None if bare else _tbracket.bracket_for(rec)
         if plan is not None:
             arrays, token = plan.before(name, call_id, comm, arrays, token)
         if ebr is not None:
@@ -425,6 +437,111 @@ _EAGER_CACHE_MAX = 128
 _eager_cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
 
 
+# ---------------------------------------------------------------------------
+# the dispatch fast path
+# ---------------------------------------------------------------------------
+#
+# BENCH_r05.json measured dispatch_overhead_s at ~14% of the shallow-water
+# wall: the cache-HIT path was re-parsing ~10 environment flags (float,
+# choice, and fault-spec grammars) and re-hashing the full key tuple on
+# every call.  Two memos remove that:
+#
+# - ``_dynamic_state()``: the flag-derived half of the cache key, parsed
+#   once per configuration *stamp* (utils/config.config_stamp: programmatic
+#   epoch + raw env fingerprint — one dict read per flag, no parsing);
+# - ``_eager_prefix()``: the per-(op, comm, statics) half, interned with a
+#   precomputed hash so a hit hashes two cached objects instead of
+#   re-hashing mesh + statics.
+#
+# Toggling any flag (env or ``set_*``) changes the stamp, rebuilds the
+# token, and misses the program cache — exactly the retrace-on-toggle
+# contract the flat keys gave, at O(1) parse cost per toggle instead of
+# per call.
+
+
+class _Interned:
+    """Hash-once wrapper for memoized cache-key halves.  Equality falls
+    back to the wrapped key so logically-equal rebuilt wrappers (e.g.
+    after ``clear_caches``) still match."""
+
+    __slots__ = ("key", "_hash")
+
+    def __init__(self, key):
+        self.key = key
+        self._hash = hash(key)
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return self is other or (
+            isinstance(other, _Interned) and self.key == other.key
+        )
+
+
+_dyn_cell: list = [None, None, True, True]
+
+
+def _dynamic_state():
+    """``(interned flag token, analysis_off, telemetry_off)`` for the
+    current configuration — every dynamically-read flag that shapes a
+    trace, parsed only when the config stamp moves."""
+    from ..utils import config as _config
+
+    stamp = _config.config_stamp()
+    if _dyn_cell[0] != stamp:
+        from ..resilience.runtime import cache_token as resilience_token
+        from ..utils.config import prefer_notoken
+        from . import _async
+        from ._algos import algo_cache_token
+
+        tok = (get_runtime_tracing(), get_logging(), prefer_notoken(),
+               resilience_token(), algo_cache_token(),
+               _analysis.analysis_cache_token(),
+               _telemetry.telemetry_cache_token(),
+               _fusion.fusion_cache_token(),
+               _async.overlap_cache_token())
+        # publish the stamp LAST: a concurrent reader must never see the
+        # new stamp paired with the previous token/gates
+        _dyn_cell[1] = _Interned(tok)
+        _dyn_cell[2] = _analysis.effective_mode() == "off"
+        _dyn_cell[3] = _telemetry.effective_mode() == "off"
+        _dyn_cell[0] = stamp
+    return _dyn_cell[1], _dyn_cell[2], _dyn_cell[3]
+
+
+def dynamic_cache_token() -> "_Interned":
+    """The flag half of every compiled-program cache key (shared with the
+    spmd program cache in parallel/region.py)."""
+    return _dynamic_state()[0]
+
+
+# LRU-bounded like the program cache it serves: callers may produce
+# unbounded distinct static keys (many routing patterns), and each memo
+# entry pins a mesh reference.  Sized above _EAGER_CACHE_MAX so every
+# live program's prefix stays memoized.
+_eager_prefix_memo: "OrderedDict" = OrderedDict()
+_PREFIX_MEMO_MAX = 256
+
+
+def _eager_prefix(opname: str, comm: Comm, static_key):
+    """Interned ``(opname, mesh, comm uid, statics)`` key half + the
+    comm's PartitionSpec, built once per (op, comm, statics).  The memo
+    entry pins the mesh it was built against: re-binding a comm to a new
+    mesh rebuilds (identity check, no hashing)."""
+    k = (opname, comm.uid, static_key)
+    ent = _eager_prefix_memo.get(k)
+    if ent is not None and ent[0] is comm.mesh:
+        _eager_prefix_memo.move_to_end(k)
+        return ent[1], ent[2]
+    axes_spec = P(comm.axes if len(comm.axes) > 1 else comm.axes[0])
+    prefix = _Interned((opname, comm.mesh, comm.uid, static_key))
+    _eager_prefix_memo[k] = (comm.mesh, prefix, axes_spec)
+    if len(_eager_prefix_memo) > _PREFIX_MEMO_MAX:
+        _eager_prefix_memo.popitem(last=False)
+    return prefix, axes_spec
+
+
 def cache_stats() -> dict:
     """Eager compiled-program cache accounting:
     ``{"hits", "misses", "evictions", "size"}``.
@@ -438,9 +555,10 @@ def cache_stats() -> dict:
     return dict(_eager_cache_stats, size=len(_eager_cache))
 
 
-def _bump_cache_stat(name: str) -> None:
+def _bump_cache_stat(name: str, telemetry_off: bool = False) -> None:
     _eager_cache_stats[name] += 1
-    _telemetry.meter(f"eager_cache.{name}")
+    if not telemetry_off:
+        _telemetry.meter(f"eager_cache.{name}")
 
 
 def clear_caches() -> None:
@@ -458,6 +576,8 @@ def clear_caches() -> None:
     with the function object.
     """
     _eager_cache.clear()
+    _eager_prefix_memo.clear()
+    _dyn_cell[0] = None
     for k in _eager_cache_stats:
         _eager_cache_stats[k] = 0
     _analysis.clear_analysis_caches()
@@ -487,7 +607,7 @@ def check_global_shape(opname: str, a, size: int) -> None:
 
 def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
              static_key: Optional[tuple] = None,
-             ana: Optional[dict] = None):
+             ana: Optional[dict] = None, bare: bool = False):
     """Run op ``body`` either inline (inside a parallel region) or eagerly.
 
     ``body(comm, arrays, token) -> (outputs..., token)`` operates on
@@ -506,8 +626,17 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     bookkeeping — the traced program (and thus the HLO) is untouched.
     """
     comm = resolve_comm(comm)
+    # a dispatch that reaches this point does not join the fusion queue:
+    # drain it first so the fused collectives keep their program position,
+    # and force any deferred results used as inputs
+    if _region_stack:
+        _fusion.flush_pending(_region_stack[-1])
+    arrays = tuple(_fusion.materialize_value(a) for a in arrays)
     for a in arrays:
         check_dtype(a, opname)
+    fused_ana = _fusion.take_pending_ana()
+    if fused_ana is not None:
+        ana = {**(ana or {}), **fused_ana}
     if in_parallel_region(comm):
         # a pending tokenless barrier (see RegionContext.pending_sync) is
         # folded into this op's token so the op is ordered after it
@@ -527,7 +656,7 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
         with op_scope(opname):
             evt = _analysis.begin_event(opname, comm, arrays, token, ana, ctx)
             try:
-                out = _run_body(opname, comm, body, arrays, token)
+                out = _run_body(opname, comm, body, arrays, token, bare=bare)
             except BaseException:
                 if evt is not None:
                     _analysis.abort_event(evt)
@@ -547,35 +676,29 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     for a in arrays:
         check_global_shape(opname, a, size)
 
-    axes_spec = P(comm.axes if len(comm.axes) > 1 else comm.axes[0])
-
     # ``static_key`` lists every closure value of ``body`` that shapes the
     # trace; ``None`` marks the call uncacheable (e.g. a Status out-param
     # that must be filled at trace time)
     cache_key = None
-    if (static_key is not None and not _analysis.recording()
-            and _analysis.effective_mode() == "off"):
+    dyn, analysis_off, telemetry_off = _dynamic_state()
+    if static_key is not None and analysis_off and not _analysis.recording():
         # an active mpx.analyze recorder — or the ambient warn/error mode —
         # bypasses the cache entirely: a cache hit would skip tracing,
         # tracing is when events are recorded, and queue-state-dependent
-        # findings (MPX110) can differ between calls that share a program
-        from ..utils.config import prefer_notoken
-
-        from ..resilience.runtime import cache_token as resilience_token
-        from ._algos import algo_cache_token
-
-        # every dynamically-read flag that shapes the trace must be in the
-        # key, or toggling it would silently keep serving the old program
-        cache_key = (opname, comm.mesh, comm.uid, static_key,
-                     get_runtime_tracing(), get_logging(), prefer_notoken(),
-                     resilience_token(), algo_cache_token(),
-                     _analysis.analysis_cache_token(),
-                     _telemetry.telemetry_cache_token())
+        # findings (MPX110) can differ between calls that share a program.
+        # Both key halves are memoized with precomputed hashes (see "the
+        # dispatch fast path" above): a hit re-parses no flags and
+        # re-hashes no mesh/statics.
+        prefix, axes_spec = _eager_prefix(opname, comm, static_key)
+        cache_key = (prefix, dyn)
         cached = _eager_cache.get(cache_key)
         if cached is not None:
             _eager_cache.move_to_end(cache_key)
-            _bump_cache_stat("hits")
+            _bump_cache_stat("hits", telemetry_off)
             sm_hit, tele_cell = cached
+            if telemetry_off:
+                results, tok_out = sm_hit(tuple(arrays), token)
+                return (*results, tok_out)
             # dispatch runs per call even on a hit, so the eager tier
             # counts per call — from the entry's stash for THIS call's
             # signature (jit retraces per signature; each retrace lands
@@ -585,8 +708,11 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
                 results, tok_out = sm_hit(tuple(arrays), token)
             _telemetry.count_eager_call(tele_cell, sig)
             return (*results, tok_out)
-        _bump_cache_stat("misses")
-        _telemetry.meter(f"recompiles.eager.{opname}")
+        _bump_cache_stat("misses", telemetry_off)
+        if not telemetry_off:
+            _telemetry.meter(f"recompiles.eager.{opname}")
+    else:
+        axes_spec = P(comm.axes if len(comm.axes) > 1 else comm.axes[0])
 
     def wrapped(arrs, tok):
         ctx = RegionContext(comm)
@@ -599,7 +725,8 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
                 evt = _analysis.begin_event(opname, comm, locals_, tok, ana,
                                             ctx, eager=True)
                 try:
-                    out = _run_body(opname, comm, body, locals_, tok)
+                    out = _run_body(opname, comm, body, locals_, tok,
+                                    bare=bare)
                 except BaseException:
                     if evt is not None:
                         _analysis.abort_event(evt)
@@ -628,10 +755,13 @@ def dispatch(opname: str, comm: Optional[Comm], body, arrays, token,
     # insert into the cache only after the first call succeeds — a
     # trace/compile failure must not leave a broken entry to be replayed
     tele_cell = _telemetry.EagerCell()
-    sig = _telemetry.call_signature(arrays)
-    with _telemetry.capture_eager(tele_cell, sig):
+    if telemetry_off:
         results, tok_out = sm(tuple(arrays), token)
-    _telemetry.count_eager_call(tele_cell, sig)
+    else:
+        sig = _telemetry.call_signature(arrays)
+        with _telemetry.capture_eager(tele_cell, sig):
+            results, tok_out = sm(tuple(arrays), token)
+        _telemetry.count_eager_call(tele_cell, sig)
     if cache_key is not None:
         _eager_cache[cache_key] = (sm, tele_cell)
         if len(_eager_cache) > _EAGER_CACHE_MAX:

@@ -1,0 +1,489 @@
+"""Collective fusion: Horovod-style bucketing of adjacent small collectives.
+
+PR 2's algorithm layer (``_algos.py``) optimizes ONE large payload; real
+training steps instead issue MANY small collectives (one per gradient
+leaf), each paying full dispatch + per-collective latency.  Tensor fusion
+(Sergeev & Del Balso, 2018; PyTorch DDP's bucketed allreduce, Li et al.,
+VLDB 2020) coalesces them: adjacent same-(op, comm, reduction, root)
+collectives pack into one flat-buffer collective per dtype bucket, cutting
+per-call dispatch overhead and letting the bandwidth-optimal ring run once
+over the packed payload instead of k times over slivers.
+
+The reference executes ops asynchronously at run time, so Horovod fuses in
+a background thread.  Here ops are *trace-time* — a collective is emitted
+the moment the Python call runs — so fusion works by **deferral**: with
+``MPI4JAX_TPU_FUSION=auto|force``, a fusable op inside a managed parallel
+region does not emit its collective; it queues the payload and returns a
+:class:`LazyResult`.  The queue drains ("flushes") into real fused
+collectives at the first of:
+
+- any use of a deferred result (``__jax_array__`` / operators / indexing),
+- a dispatch that cannot join the queue (different op/comm/reduction/root,
+  a non-fusable op, a barrier — program order is preserved),
+- the end of the parallel region (``parallel/region.py`` flushes and
+  materializes region outputs).
+
+so the fusion-friendly idiom is "issue all collectives, then consume"::
+
+    red = jax.tree.map(lambda g: mpx.allreduce(g, op=mpx.SUM)[0], grads)
+    new = jax.tree.map(lambda p, g: p - lr * g / n, params, red)  # flushes
+
+Packing is deterministic (queue = program order), dtype-segregated, and
+capped per bucket by ``MPI4JAX_TPU_FUSION_BUCKET_BYTES``; unflattening is
+exact (per-member offset slices + reshape), so fused and unfused results
+are bit-identical for every enum reduction (pinned by the lockstep
+simulator in tests/test_fusion.py).  Custom *callable* reductions never
+fuse: concatenating payloads changes what a whole-array callable sees.
+
+Ordering contract: a deferred op's token is a passthrough (the fused
+collective is ordered by program position at the flush point), exactly the
+``MPI4JAX_TPU_PREFER_NOTOKEN`` semantics.  ``off`` (the default) bypasses
+every hook on this path — the lowered HLO is byte-identical to a build
+without this module (pinned by tests/test_fusion.py).
+
+The bucketing plan (``bucket_plan`` / ``pack_offsets``) is pure Python,
+shared with the lockstep simulator so it runs under any JAX version.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..utils import config
+
+__all__ = [
+    "bucket_plan",
+    "pack_offsets",
+    "set_fusion_mode",
+    "effective_mode",
+    "fusion_cache_token",
+    "LazyResult",
+    "maybe_defer",
+    "flush_pending",
+    "materialize_value",
+    "materialize_tree",
+]
+
+# ops the deferral layer accepts (reduce_scatter is deliberately absent:
+# its blocks are positional per rank, so concatenation would reroute them;
+# the async start/wait pair in _async.py is its latency-hiding path)
+FUSABLE_OPS = ("allreduce", "bcast")
+
+_UNSET = object()
+_mode_override = _UNSET
+
+# non-zero while a flush is emitting its fused collectives: those inner
+# dispatches must not re-enter the deferral layer
+_inhibit = 0
+
+# annotation handoff: the flush sets this right before emitting a fused
+# collective; dispatch (ops/_base.py) merges it into that op's analysis
+# ``ana`` dict so the event stream records the member count
+_pending_ana: Optional[dict] = None
+
+
+def set_fusion_mode(mode: Optional[str]) -> None:
+    """Programmatic override of ``MPI4JAX_TPU_FUSION`` (``None`` returns
+    control to the environment), mirroring ``set_telemetry_mode`` and the
+    other ``set_*`` overrides."""
+    global _mode_override
+    if mode is None:
+        _mode_override = _UNSET
+        config.bump_config_epoch()
+        return
+    if mode not in config.FUSION_MODES:
+        raise ValueError(
+            f"fusion mode must be one of {config.FUSION_MODES}, got {mode!r}"
+        )
+    _mode_override = mode
+    config.bump_config_epoch()
+
+
+def effective_mode() -> str:
+    if _mode_override is not _UNSET:
+        return _mode_override
+    return config.fusion_mode()
+
+
+def fusion_cache_token() -> tuple:
+    """Folded into both compiled-program cache keys (ops/_base.py eager
+    cache, parallel/region.py spmd cache): flipping the fusion mode or the
+    bucket cap changes the traced program, so it must retrace."""
+    return (effective_mode(), config.fusion_bucket_bytes())
+
+
+# ---------------------------------------------------------------------------
+# the bucketing plan (pure — shared with the lockstep simulator)
+# ---------------------------------------------------------------------------
+
+
+def bucket_plan(entries, bucket_bytes: int, force: bool = False) -> List[list]:
+    """Partition queued members into fusion buckets.
+
+    ``entries`` is the queue in program order: one ``(dtype_str, nbytes)``
+    per member.  Buckets are dtype-segregated (a flat buffer has one
+    dtype), order-preserving within a dtype, and close when adding the
+    next member would exceed ``bucket_bytes`` (a single oversized member
+    still gets its own bucket; ``force`` ignores the cap).  Returned in
+    deterministic order: buckets sorted by their first member's queue
+    index, members ascending within each — so every rank packs
+    identically, which the SPMD contract requires.
+    """
+    open_buckets: dict = {}   # dtype -> (member indices, cumulative bytes)
+    buckets: List[list] = []
+    for i, (dtype, nbytes) in enumerate(entries):
+        cur = open_buckets.get(dtype)
+        if cur is not None and not force and cur[1] + nbytes > bucket_bytes:
+            buckets.append(cur[0])
+            cur = None
+        if cur is None:
+            open_buckets[dtype] = ([i], nbytes)
+        else:
+            cur[0].append(i)
+            open_buckets[dtype] = (cur[0], cur[1] + nbytes)
+    buckets.extend(cur[0] for cur in open_buckets.values())
+    buckets.sort(key=lambda members: members[0])
+    return buckets
+
+
+def pack_offsets(sizes) -> List[tuple]:
+    """Exact unflattening plan: ``[(start, end)]`` per member of one
+    bucket's flat buffer, in packing order."""
+    out = []
+    pos = 0
+    for n in sizes:
+        out.append((pos, pos + n))
+        pos += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# deferral
+# ---------------------------------------------------------------------------
+
+
+class LazyResult:
+    """A deferred collective result.
+
+    Behaves like the eventual array: ``shape``/``dtype``/``ndim``/``size``
+    are known immediately; any *use* (arithmetic, indexing, ``jnp.*`` via
+    ``__jax_array__``) forces the fusion queue to flush and returns the
+    slice of the fused collective this member packed into.  Identity
+    (``==`` on the wrapper, hashing) is NOT forwarded — force first if you
+    need elementwise comparison.
+    """
+
+    __slots__ = ("_shape", "_dtype", "_value", "_ctx")
+
+    def __init__(self, shape, dtype, ctx):
+        self._shape = tuple(shape)
+        self._dtype = dtype
+        self._value = None
+        self._ctx = ctx
+
+    # -- forcing ------------------------------------------------------------
+
+    def _force(self):
+        if self._value is None:
+            flush_pending(self._ctx)
+            if self._value is None:  # pragma: no cover - defensive
+                raise RuntimeError(
+                    "deferred collective result used after its parallel "
+                    "region ended without a flush; this is a bug in the "
+                    "fusion layer (the region exit must flush)"
+                )
+        self._ctx = None
+        return self._value
+
+    def __jax_array__(self):
+        return self._force()
+
+    # -- static metadata ----------------------------------------------------
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self._shape:
+            n *= d
+        return n
+
+    def __repr__(self):
+        state = "pending" if self._value is None else "flushed"
+        return (f"LazyResult(shape={self._shape}, dtype={self._dtype}, "
+                f"{state})")
+
+    # -- forwarding (every use forces) --------------------------------------
+
+    def __getattr__(self, name):
+        # array-method calls (.reshape, .sum, .astype, .at, ...) are uses:
+        # force and delegate, so fusion stays a drop-in flag flip.  Dunder
+        # probes (pickle/copy protocols, numpy interface sniffing) must
+        # NOT force a flush mid-protocol — the explicit dunders below
+        # cover the supported surface.
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        return getattr(self._force(), name)
+
+    def __array__(self, *args, **kwargs):
+        import numpy as np
+
+        return np.asarray(self._force(), *args, **kwargs)
+
+    # elementwise comparison semantics, like the array this stands for
+    # (and, like a traced array, therefore unhashable)
+    __hash__ = None
+
+    def __eq__(self, other):
+        return self._force() == other
+
+    def __ne__(self, other):
+        return self._force() != other
+
+    def __getitem__(self, idx):
+        return self._force()[idx]
+
+    def __add__(self, o):
+        return self._force() + o
+
+    def __radd__(self, o):
+        return o + self._force()
+
+    def __sub__(self, o):
+        return self._force() - o
+
+    def __rsub__(self, o):
+        return o - self._force()
+
+    def __mul__(self, o):
+        return self._force() * o
+
+    def __rmul__(self, o):
+        return o * self._force()
+
+    def __truediv__(self, o):
+        return self._force() / o
+
+    def __rtruediv__(self, o):
+        return o / self._force()
+
+    def __pow__(self, o):
+        return self._force() ** o
+
+    def __neg__(self):
+        return -self._force()
+
+    def __abs__(self):
+        return abs(self._force())
+
+    def __matmul__(self, o):
+        return self._force() @ o
+
+    def __rmatmul__(self, o):
+        return o @ self._force()
+
+    def __lt__(self, o):
+        return self._force() < o
+
+    def __le__(self, o):
+        return self._force() <= o
+
+    def __gt__(self, o):
+        return self._force() > o
+
+    def __ge__(self, o):
+        return self._force() >= o
+
+
+class _Entry:
+    __slots__ = ("array", "cell")
+
+    def __init__(self, array, cell):
+        self.array = array
+        self.cell = cell
+
+
+class _Queue:
+    """The pending adjacent run: members all share ``key`` =
+    (opname, comm uid, reduction, root)."""
+
+    __slots__ = ("key", "opname", "comm", "reduction", "root", "entries")
+
+    def __init__(self, key, opname, comm, reduction, root):
+        self.key = key
+        self.opname = opname
+        self.comm = comm
+        self.reduction = reduction
+        self.root = root
+        self.entries: List[_Entry] = []
+
+
+def _managed_ctx():
+    from ..parallel.region import _region_stack
+
+    return _region_stack[-1] if _region_stack else None
+
+
+def maybe_defer(opname: str, x, comm, token, reduction=None, root=None):
+    """Queue one fusable op; returns ``(LazyResult, Token)`` or ``None``
+    when the deferral layer is inactive (mode off, outside a managed
+    region, mid-flush, or a non-fusable argument)."""
+    if _inhibit or opname not in FUSABLE_OPS:
+        return None
+    mode = effective_mode()
+    if mode == "off":
+        return None
+    ctx = _managed_ctx()
+    if ctx is None:
+        return None
+    from ..parallel.region import in_parallel_region, resolve_comm
+
+    comm = resolve_comm(comm)
+    if not in_parallel_region(comm):
+        return None
+    x = materialize_value(x)  # a deferred input joins via its flush
+    key = (opname, comm.uid, reduction, root)
+    q = getattr(ctx, "fusion_queue", None)
+    if q is not None and q.key != key:
+        flush_pending(ctx)
+        q = None
+    if q is None:
+        q = _Queue(key, opname, comm, reduction, root)
+        ctx.fusion_queue = q
+    import jax
+
+    aval = jax.typeof(x)
+    cell = LazyResult(aval.shape, aval.dtype, ctx)
+    q.entries.append(_Entry(x, cell))
+    # passthrough token: the fused collective is ordered by program
+    # position at the flush point (PREFER_NOTOKEN semantics; see module
+    # docstring and docs/overlap.md)
+    if token is None:
+        from .token import create_token
+
+        token = create_token()
+    return cell, token
+
+
+def flush_pending(ctx) -> None:
+    """Drain ``ctx``'s fusion queue into real collectives (no-op when
+    empty).  Called by every dispatch that does not join the queue and by
+    the region exit, so program order is preserved."""
+    if ctx is None:
+        return
+    q = getattr(ctx, "fusion_queue", None)
+    if q is None:
+        return
+    ctx.fusion_queue = None
+    _flush_queue(q)
+
+
+def _flush_queue(q: _Queue) -> None:
+    global _inhibit, _pending_ana
+    import jax.numpy as jnp
+
+    entries = q.entries
+    mode = effective_mode()
+    _inhibit += 1
+    try:
+        if len(entries) == 1 and mode != "force":
+            # a lone member gains nothing from the flat-buffer round trip
+            (e,) = entries
+            e.cell._value = _run_member(q, e.array)
+            return
+        plan = bucket_plan(
+            [(str(e.array.dtype), e.array.size * e.array.dtype.itemsize)
+             for e in entries],
+            config.fusion_bucket_bytes(),
+            force=(mode == "force"),
+        )
+        for members in plan:
+            sizes = [entries[i].array.size for i in members]
+            flats = [entries[i].array.reshape(-1) for i in members]
+            flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            _meter_bucket(q, flat, len(members))
+            _pending_ana = {"fused_members": len(members),
+                            "fused_bytes": int(flat.size) * flat.dtype.itemsize}
+            try:
+                fused = _run_member(q, flat)
+            finally:
+                _pending_ana = None
+            for i, (start, end) in zip(members, pack_offsets(sizes)):
+                e = entries[i]
+                e.cell._value = fused[start:end].reshape(e.cell._shape)
+    finally:
+        _inhibit -= 1
+
+
+def _run_member(q: _Queue, array):
+    """Emit one real collective for a bucket (or a lone member) through
+    the normal dispatch point, so analysis, telemetry, and resilience see
+    it like any hand-written op."""
+    if q.opname == "allreduce":
+        from .allreduce import allreduce
+
+        res, _ = allreduce(array, op=q.reduction, comm=q.comm)
+    else:
+        from .bcast import bcast
+
+        res, _ = bcast(array, q.root, comm=q.comm)
+    return res
+
+
+def _meter_bucket(q: _Queue, flat, members: int) -> None:
+    from ..telemetry import core as _telemetry
+
+    if _telemetry.effective_mode() == "off":
+        return
+    from ._algos import chunk_layout, static_group_size
+
+    nbytes = int(flat.size) * flat.dtype.itemsize
+    k = static_group_size(q.comm)
+    waste = 0
+    if k and k > 1:
+        chunk, padded = chunk_layout(int(flat.size), k)
+        waste = (padded - int(flat.size)) * flat.dtype.itemsize
+    prefix = f"fusion.{q.opname}.c{q.comm.uid}.{flat.dtype}"
+    _telemetry.meter(f"{prefix}.buckets")
+    _telemetry.meter(f"{prefix}.members", members)
+    _telemetry.meter(f"{prefix}.bytes_packed", nbytes)
+    _telemetry.meter(f"{prefix}.padding_waste", waste)
+
+
+def take_pending_ana() -> Optional[dict]:
+    """The fused-collective annotation for the dispatch in flight (member
+    count + packed bytes), or ``None`` for every ordinary dispatch."""
+    global _pending_ana
+    ana, _pending_ana = _pending_ana, None
+    return ana
+
+
+# ---------------------------------------------------------------------------
+# materialization
+# ---------------------------------------------------------------------------
+
+
+def materialize_value(x):
+    """Force a deferred result to its array (no-op for everything else)."""
+    if isinstance(x, LazyResult):
+        return x._force()
+    return x
+
+
+def materialize_tree(tree):
+    """Force every deferred result in a pytree (region outputs must be
+    real arrays before they cross the shard_map boundary)."""
+    import jax
+
+    return jax.tree.map(materialize_value, tree)

@@ -23,6 +23,7 @@ from typing import Optional
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
+from . import _async, _fusion
 from ._base import SUM, Op, OpLike, apply_allreduce, dispatch, reduction_name
 from .token import Token, consume, produce
 
@@ -34,7 +35,24 @@ def allreduce(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
     receives the result.
 
     Returns ``(result, token)`` (ref API: allreduce.py:41-79).
+
+    Throughput layers (docs/overlap.md): inside ``mpx.overlap()`` the call
+    auto-splits into the async start/wait pair (ops/_async.py) and the
+    returned result is lazy until first use; under
+    ``MPI4JAX_TPU_FUSION=auto|force`` adjacent small allreduces coalesce
+    into one flat-buffer collective (ops/_fusion.py) — both return a
+    result that materializes on use, with passthrough token ordering.
     """
+    # overlap takes precedence over fusion: a split collective already
+    # hides latency, and re-bucketing its phases would serialize them
+    lazy = _async.maybe_lazy("allreduce", x, op, comm, token)
+    if lazy is not None:
+        return lazy
+    if isinstance(op, Op):  # callables never fuse (see _fusion docstring)
+        deferred = _fusion.maybe_defer("allreduce", x, comm, token,
+                                       reduction=op)
+        if deferred is not None:
+            return deferred
 
     def body(comm, arrays, token):
         (xl,) = arrays

@@ -32,6 +32,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
+from . import _fusion
 from ._base import apply_doubling_bcast, dispatch
 from .token import Token, consume, produce
 
@@ -43,7 +44,14 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
 
     Returns ``(result, token)`` (ref API: bcast.py:40-84).  ``root`` must be
     a static Python int (SPMD traces one program for all ranks).
+
+    Under ``MPI4JAX_TPU_FUSION=auto|force`` adjacent same-root broadcasts
+    coalesce into one flat-buffer bcast per dtype bucket (ops/_fusion.py,
+    docs/overlap.md); the result materializes on first use.
     """
+    deferred = _fusion.maybe_defer("bcast", x, comm, token, root=root)
+    if deferred is not None:
+        return deferred
 
     def body(comm, arrays, token):
         from . import _algos

@@ -31,6 +31,7 @@ from typing import Optional
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
+from . import _async
 from ._algos import apply_reduce_scatter
 from ._base import SUM, Op, OpLike, dispatch, reduction_name
 from .token import Token, consume, produce
@@ -46,7 +47,14 @@ def reduce_scatter(x, op: OpLike = SUM, *, comm: Optional[Comm] = None,
     Returns ``(result, token)`` (MPI_Reduce_scatter_block semantics; on a
     color-split comm ``size`` is the uniform group size and blocks index
     group-local positions).
+
+    Inside ``mpx.overlap()`` the call auto-splits into the async
+    ``reduce_scatter_start``/``_wait`` pair (ops/_async.py,
+    docs/overlap.md) and the result is lazy until first use.
     """
+    lazy = _async.maybe_lazy("reduce_scatter", x, op, comm, token)
+    if lazy is not None:
+        return lazy
 
     def body(comm, arrays, token):
         (xl,) = arrays

@@ -49,6 +49,10 @@ class RegionContext:
         # env-mode collective verifier sink, armed by analysis.hook when
         # MPI4JAX_TPU_ANALYZE != off (None otherwise — zero overhead)
         self.analysis_recorder = None
+        # pending adjacent-collective fusion queue (ops/_fusion.py), only
+        # ever non-None while MPI4JAX_TPU_FUSION is auto/force; drained by
+        # any non-joining dispatch and at region exit
+        self.fusion_queue = None
 
     def queue(self, comm_uid: int, tag: int) -> deque:
         return self.send_queues.setdefault((comm_uid, tag), deque())
@@ -191,19 +195,15 @@ def spmd(
             # every dynamically-read flag that shapes the trace must be in
             # the key (mirrors _eager_cache in ops/_base.py), or toggling
             # tracing/logging/prefer_notoken after the first call would
-            # silently keep serving the stale compiled program
-            from ..analysis.hook import analysis_cache_token
-            from ..ops._algos import algo_cache_token
-            from ..resilience.runtime import cache_token as resilience_token
+            # silently keep serving the stale compiled program.  The flag
+            # half comes pre-parsed and hash-cached from the dispatch fast
+            # path (ops/_base.dynamic_cache_token): a warm call re-parses
+            # no environment flags.
+            from ..ops._base import dynamic_cache_token
             from ..telemetry import core as _telemetry
-            from ..utils.config import prefer_notoken
-            from ..utils.debug import get_logging, get_runtime_tracing
 
             key = (c.mesh, c.uid, statics, static_vals, kw_names, n_dyn,
-                   get_runtime_tracing(), get_logging(), prefer_notoken(),
-                   resilience_token(), algo_cache_token(),
-                   analysis_cache_token(),
-                   _telemetry.telemetry_cache_token())
+                   dynamic_cache_token())
             sm = program_cache.get(key)
             if sm is not None:
                 _telemetry.meter("spmd_cache.hits")
@@ -243,6 +243,13 @@ def spmd(
                         for i, v in zip(statics, static_vals):
                             full.insert(i, v)
                         out = f(*full, **kw)
+                        # drain the fusion queue and force any deferred
+                        # results: region outputs must be real arrays
+                        # before they cross the shard_map boundary
+                        from ..ops import _fusion
+
+                        _fusion.flush_pending(ctx)
+                        out = _fusion.materialize_tree(out)
                         if ctx.pending_sync is not None:
                             # a trailing tokenless barrier: tie it into the
                             # region outputs so it is not dead-code-eliminated

@@ -57,6 +57,7 @@ def set_watchdog_timeout(seconds) -> None:
     global _watchdog_override
     if not seconds:
         _watchdog_override = None
+        config.bump_config_epoch()
         return
     val = float(seconds)
     # mirror the env path's validation (config.parse_env_float): a negative
@@ -66,6 +67,7 @@ def set_watchdog_timeout(seconds) -> None:
     if not (val > 0):
         raise ValueError(f"watchdog timeout must be > 0 seconds, got {seconds!r}")
     _watchdog_override = val
+    config.bump_config_epoch()
 
 
 def set_fault_spec(spec: Optional[str]) -> None:
@@ -74,18 +76,21 @@ def set_fault_spec(spec: Optional[str]) -> None:
     global _fault_override
     parse_fault_spec(spec or "")
     _fault_override = (spec or "").strip()
+    config.bump_config_epoch()
 
 
 def set_check_numerics(enabled) -> None:
     """Override ``MPI4JAX_TPU_CHECK_NUMERICS``."""
     global _numerics_override
     _numerics_override = bool(enabled)
+    config.bump_config_epoch()
 
 
 def reset_overrides() -> None:
     """Drop every programmatic override (environment variables rule again)."""
     global _watchdog_override, _fault_override, _numerics_override
     _watchdog_override = _fault_override = _numerics_override = _UNSET
+    config.bump_config_epoch()
 
 
 def effective_watchdog_timeout() -> Optional[float]:
@@ -207,9 +212,25 @@ class Plan:
             guard_values(mpi_name, call_id, rank, values, "output")
 
 
+# plan_for memo: Plans are stateless across dispatches (before/after close
+# over nothing mutable), so one Plan per (config stamp, opname) serves
+# every dispatch until the configuration changes — the per-traced-op
+# watchdog-float/fault-spec/numerics re-parsing leaves the hot path.
+_plan_memo: list = [None, {}]
+
+
 def plan_for(opname: str) -> Optional[Plan]:
     """The resilience plan for one op dispatch, or ``None`` when every
     feature is off (the zero-cost default — no graph change at all)."""
+    stamp = config.config_stamp()
+    if _plan_memo[0] != stamp:
+        # publish the stamp LAST (a concurrent reader must never pair the
+        # new stamp with the previous memo dict)
+        _plan_memo[1] = {}
+        _plan_memo[0] = stamp
+    memo = _plan_memo[1]
+    if opname in memo:
+        return memo[opname]
     timeout = effective_watchdog_timeout()
     numerics = effective_check_numerics()
     clauses = tuple(
@@ -217,6 +238,7 @@ def plan_for(opname: str) -> Optional[Plan]:
         for bit, c in enumerate(effective_fault_clauses())
         if c.matches_op(opname)
     )
-    if timeout is None and not numerics and not clauses:
-        return None
-    return Plan(clauses, timeout, numerics)
+    plan = (None if timeout is None and not numerics and not clauses
+            else Plan(clauses, timeout, numerics))
+    memo[opname] = plan
+    return plan

@@ -59,6 +59,7 @@ def set_telemetry_mode(mode: Optional[str]) -> None:
     global _mode_override
     if mode is None:
         _mode_override = _UNSET
+        config.bump_config_epoch()
         return
     if mode not in config.TELEMETRY_MODES:
         raise ValueError(
@@ -66,6 +67,7 @@ def set_telemetry_mode(mode: Optional[str]) -> None:
             f"got {mode!r}"
         )
     _mode_override = mode
+    config.bump_config_epoch()
 
 
 def effective_mode() -> str:

@@ -33,6 +33,20 @@ class Flag(NamedTuple):
 ANALYZE_MODES = ("off", "warn", "error")
 COLLECTIVE_ALGOS = ("auto", "butterfly", "ring")
 TELEMETRY_MODES = ("off", "counters", "events")
+FUSION_MODES = ("off", "auto", "force")
+
+# default fusion bucket: 4 MiB — large enough that a typical optimizer
+# step's small gradient leaves coalesce into a handful of collectives,
+# small enough that packing latency (concat + slice traffic) stays below
+# the per-collective dispatch cost it removes (Horovod ships 64 MiB,
+# PyTorch DDP 25 MiB; our collectives are in-graph, so the sweet spot is
+# smaller — measured by ``benchmarks/micro.py --fusion-sweep``).
+DEFAULT_FUSION_BUCKET_BYTES = 4 << 20
+
+# default overlap chunk count: 2 = classic double buffering (while chunk
+# i's allgather phase is on the wire, chunk i+1's reduce-scatter can run,
+# and independent compute interleaves with both).
+DEFAULT_OVERLAP_CHUNKS = 2
 
 # default ring/butterfly crossover: 1 MiB — below it the butterfly's
 # ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
@@ -101,8 +115,77 @@ FLAGS = {
              "journals (telemetry/journal.py); merged across ranks by "
              "``python -m mpi4jax_tpu.telemetry merge``.  Empty "
              "(default) keeps the journal in memory only."),
+        Flag("MPI4JAX_TPU_FUSION", "choice", "off",
+             "Collective fusion (ops/_fusion.py): ``auto`` coalesces "
+             "adjacent same-(op, comm, reduction, root) small "
+             "collectives inside a managed parallel region into one "
+             "flat-buffer collective per dtype bucket (Horovod-style "
+             "tensor fusion); ``force`` additionally ignores the bucket "
+             "byte cap and packs single-member buckets through the "
+             "flat-buffer path.  ``off`` (default) keeps the lowered "
+             "HLO byte-identical to a build without the fusion layer.",
+             choices=FUSION_MODES),
+        Flag("MPI4JAX_TPU_FUSION_BUCKET_BYTES", "int",
+             DEFAULT_FUSION_BUCKET_BYTES,
+             "Byte cap per fusion bucket (per dtype): a bucket closes "
+             "when adding the next member would exceed it.  Default "
+             "4 MiB."),
+        Flag("MPI4JAX_TPU_OVERLAP_CHUNKS", "int",
+             DEFAULT_OVERLAP_CHUNKS,
+             "Chunk count for the async start/wait collectives "
+             "(ops/_async.py): the payload splits into this many "
+             "independent double-buffered ring pipelines so the XLA "
+             "scheduler can interleave independent compute between "
+             "chunk phases.  Default 2."),
     )
 }
+
+# ---------------------------------------------------------------------------
+# configuration epoch + environment fingerprint (the dispatch fast path)
+# ---------------------------------------------------------------------------
+#
+# Every compiled-program cache key folds in ~10 dynamically-read flags so
+# that toggling one retraces.  Re-parsing them on EVERY dispatch made the
+# cache-hit path pay float/choice/fault-spec parsing per call
+# (BENCH_r05.json: dispatch_overhead_s ~14% of wall).  Instead, the parsed
+# token is memoized against a cheap *stamp*:
+#
+# - ``env_fingerprint()`` — the raw (unparsed) values of every declared
+#   flag, one dict read each: catches environment mutation;
+# - ``config_epoch()`` — a counter bumped by every programmatic override
+#   (``set_watchdog_timeout``, ``set_analyze_mode``, ``set_logging``, ...):
+#   catches non-environment configuration.
+#
+# The memoized consumer (ops/_base._dynamic_state, resilience plan_for)
+# recomputes only when the stamp changes.
+
+FLAG_NAMES = tuple(FLAGS)
+
+_config_epoch = 0
+
+
+def config_epoch() -> int:
+    return _config_epoch
+
+
+def bump_config_epoch() -> None:
+    """Invalidate every stamp-memoized configuration consumer.  Called by
+    each programmatic ``set_*`` override; environment mutation needs no
+    bump (the fingerprint sees it)."""
+    global _config_epoch
+    _config_epoch += 1
+
+
+def env_fingerprint() -> tuple:
+    """Raw values of every declared flag — no parsing, one read each."""
+    return tuple(map(os.environ.get, FLAG_NAMES))
+
+
+def config_stamp() -> tuple:
+    """Cheap change detector for the whole flag surface: memoize parsed
+    configuration against this and the parsing cost leaves the per-call
+    dispatch path."""
+    return (_config_epoch, env_fingerprint())
 
 TRUTHY = ("true", "1", "on", "yes")
 FALSY = ("false", "0", "off", "no", "")
@@ -260,6 +343,48 @@ def telemetry_dir() -> str:
     """Directory for the events-tier JSONL journals
     (``MPI4JAX_TPU_TELEMETRY_DIR``; '' = in-memory journal only)."""
     return (_getenv("MPI4JAX_TPU_TELEMETRY_DIR") or "").strip()
+
+
+def _parse_env_positive_int(name: str, default: int, minimum: int = 0) -> int:
+    """Parse an integer flag with a lower bound (empty/unset -> default)."""
+    raw = _getenv(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"Environment variable {name}={raw!r} could not be parsed as "
+            "an integer"
+        ) from e
+    if val < minimum:
+        raise ValueError(
+            f"Environment variable {name}={raw!r} must be >= {minimum}"
+        )
+    return val
+
+
+def fusion_mode() -> str:
+    """Collective-fusion mode (``MPI4JAX_TPU_FUSION``): ``off`` (default)
+    / ``auto`` / ``force`` — see mpi4jax_tpu/ops/_fusion.py and
+    docs/overlap.md."""
+    return _parse_env_choice("MPI4JAX_TPU_FUSION")
+
+
+def fusion_bucket_bytes() -> int:
+    """Byte cap per (dtype-segregated) fusion bucket
+    (``MPI4JAX_TPU_FUSION_BUCKET_BYTES``; default 4 MiB)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_FUSION_BUCKET_BYTES", DEFAULT_FUSION_BUCKET_BYTES
+    )
+
+
+def overlap_chunks() -> int:
+    """Chunk count for the async start/wait collectives
+    (``MPI4JAX_TPU_OVERLAP_CHUNKS``; default 2, minimum 1)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_OVERLAP_CHUNKS", DEFAULT_OVERLAP_CHUNKS, minimum=1
+    )
 
 
 def prefer_notoken() -> bool:

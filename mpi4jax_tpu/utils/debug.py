@@ -21,7 +21,7 @@ from contextlib import contextmanager
 
 import jax
 
-from .config import debug_enabled, trace_enabled
+from .config import bump_config_epoch, debug_enabled, trace_enabled
 
 _logging_enabled = debug_enabled()
 _tracing_enabled = trace_enabled()
@@ -31,6 +31,7 @@ def set_logging(enabled: bool) -> None:
     """Analog of ref mpi_xla_bridge.pyx:38-40 ``set_logging``."""
     global _logging_enabled
     _logging_enabled = bool(enabled)
+    bump_config_epoch()
 
 
 def get_logging() -> bool:
@@ -43,6 +44,7 @@ def set_runtime_tracing(enabled: bool) -> None:
     the C++ hooks library; see mpi4jax_tpu/native.py)."""
     global _tracing_enabled
     _tracing_enabled = bool(enabled)
+    bump_config_epoch()
 
 
 def get_runtime_tracing() -> bool:

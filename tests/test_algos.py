@@ -199,7 +199,13 @@ def test_ring_rs_preserves_ascending_fold_order(k):
 ])
 @pytest.mark.parametrize("k", [2, 4, 8])
 def test_ring_rs_all_ops(opname, npfn, k):
-    rng = np.random.default_rng(hash((opname, k)) % 2**32)
+    # zlib.crc32, not hash(): string hashing is randomized per process
+    # (PYTHONHASHSEED), so hash-seeded data made the float-association
+    # slack of the rotated ring fold vs the ascending reference vary run
+    # to run and occasionally exceed rtol (observed on SUM/k=8)
+    import zlib
+
+    rng = np.random.default_rng(zlib.crc32(f"{opname}/{k}".encode()))
     if opname in ("LAND", "LOR", "LXOR"):
         blocks = rng.integers(0, 2, size=(k, k, 3)).astype(bool)
     elif opname in ("BAND", "BOR", "BXOR"):

@@ -156,6 +156,93 @@ def test_warn_mode_warns_instead_of_raising():
 
 
 # ---------------------------------------------------------------------------
+# MPX111: adjacent fusable collectives not fused (fusion advisory)
+# ---------------------------------------------------------------------------
+
+
+def _adjacent_allreduces(x):
+    a, _ = mpx.allreduce(x, op=mpx.SUM)
+    b, _ = mpx.allreduce(x * 2, op=mpx.SUM)
+    return mpx.varying(a * 1.0), mpx.varying(b * 1.0)
+
+
+def test_mpx111_adjacent_unfused_advisory():
+    report = mpx.analyze(_adjacent_allreduces, ranks_arange((4,)))
+    assert codes(report) == ["MPX111"], report.render()
+    (f,) = report.findings
+    assert f.severity == "advisory"
+    assert "MPI4JAX_TPU_FUSION=auto" in f.suggestion
+
+    mpx.set_analyze_mode("error")
+    with pytest.raises(mpx.AnalysisError, match="MPX111"):
+        mpx.run(_adjacent_allreduces, ranks_arange((4,)))
+
+
+def test_mpx111_silent_when_fusion_on():
+    mpx.set_fusion_mode("auto")
+    try:
+        report = mpx.analyze(_adjacent_allreduces, ranks_arange((4,)))
+        assert report.ok, report.render()
+        # the stream records ONE fused collective carrying both members
+        fused = [e for e in report.events if e.op == "allreduce"]
+        assert len(fused) == 1
+        assert fused[0].fused_members == 2
+    finally:
+        mpx.set_fusion_mode(None)
+
+
+def test_mpx111_silent_for_different_reductions():
+    def f(x):
+        a, _ = mpx.allreduce(x, op=mpx.SUM)
+        b, _ = mpx.allreduce(x, op=mpx.MAX)
+        return mpx.varying(a * 1.0), mpx.varying(b * 1.0)
+
+    report = mpx.analyze(f, ranks_arange((4,)))
+    assert report.ok, report.render()
+
+
+# ---------------------------------------------------------------------------
+# MPX112: async start/wait pairing
+# ---------------------------------------------------------------------------
+
+
+def _start_without_wait(x):
+    h, _ = mpx.allreduce_start(x, op=mpx.SUM)
+    return mpx.varying(x * 1.0)
+
+
+def _paired_start_wait(x):
+    h, _ = mpx.allreduce_start(x, op=mpx.SUM)
+    y = x * 3.0  # independent compute in the gap
+    s, _ = mpx.allreduce_wait(h)
+    return mpx.varying(s + 0 * y)
+
+
+def test_mpx112_unwaited_start_flagged():
+    report = mpx.analyze(_start_without_wait, ranks_arange((4,)))
+    assert "MPX112" in codes(report), report.render()
+    f = next(f for f in report.findings if f.code == "MPX112")
+    assert "never waited" in f.message
+
+    mpx.set_analyze_mode("error")
+    with pytest.raises(mpx.AnalysisError, match="MPX112"):
+        mpx.run(_start_without_wait, ranks_arange((4,)))
+
+
+def test_mpx112_paired_start_wait_clean():
+    report = mpx.analyze(_paired_start_wait, ranks_arange((4,)))
+    assert report.ok, report.render()
+    ops = [e.op for e in report.events]
+    assert ops == ["allreduce_start", "allreduce_wait"]
+    start, wait = report.events
+    assert start.span == wait.span is not None
+
+    mpx.set_analyze_mode("error")
+    out = np.asarray(mpx.run(_paired_start_wait, ranks_arange((4,))))
+    assert out.shape == (world()[1], 4)
+
+
+# ---------------------------------------------------------------------------
 # MPX108: cond divergence (jaxpr walker, analyze-only)
 # ---------------------------------------------------------------------------
 

@@ -36,14 +36,14 @@ def _load_isolated():
     root = types.ModuleType(_ISO_NAME)
     root.__path__ = [str(PKG)]
     sys.modules[_ISO_NAME] = root
-    for sub in ("utils", "analysis", "parallel"):
+    for sub in ("utils", "analysis", "parallel", "ops"):
         m = types.ModuleType(f"{_ISO_NAME}.{sub}")
         m.__path__ = [str(PKG / sub)]
         sys.modules[f"{_ISO_NAME}.{sub}"] = m
         setattr(root, sub, m)
-    for mod in ("utils.config", "analysis.report", "analysis.graph",
-                "analysis.checkers", "analysis.walker", "analysis.hook",
-                "parallel.rankspec"):
+    for mod in ("utils.config", "ops._fusion", "analysis.report",
+                "analysis.graph", "analysis.checkers", "analysis.walker",
+                "analysis.hook", "parallel.rankspec"):
         importlib.import_module(f"{_ISO_NAME}.{mod}")
     return root
 
@@ -276,6 +276,133 @@ def test_mpx109_negative_cases():
     assert codes_of(_algo_graph(1 << 20, algo="native")) == []  # native HLO
     assert codes_of(_algo_graph(1 << 20, k=2)) == []     # below ring min
     assert checkers.RING_MIN_GROUP == 4  # mirrored from ops/_algos.py
+
+
+# ---------------------------------------------------------------------------
+# MPX111 — adjacent fusable collectives not fused
+# ---------------------------------------------------------------------------
+
+_FUSION_META = {"fusion": "off", "fusion_bucket_bytes": 1 << 20,
+                "collective_algo": "auto", "ring_crossover_bytes": 1 << 20}
+
+
+def _adjacent(op="allreduce", n=2, reduction="sum", payload=64, **kw):
+    return [E(i, op, comm_uid=1, reduction=reduction,
+              payload_bytes=payload, **kw) for i in range(n)]
+
+
+def test_mpx111_adjacent_unfused_fires():
+    g = G(events=_adjacent(n=3), meta=dict(_FUSION_META))
+    (f,) = [x for x in checkers.run_checkers(g) if x.code == "MPX111"]
+    assert f.severity == "advisory"
+    assert "3 adjacent allreduce" in f.message
+    assert "MPI4JAX_TPU_FUSION=auto" in f.suggestion
+    assert f.index == 0  # anchored at the run's first event
+
+
+def test_mpx111_mixed_dtypes_still_bucket():
+    # dtype segregation happens inside the flush, so a mixed-dtype run is
+    # still one fusion opportunity
+    evs = [E(0, "allreduce", comm_uid=1, reduction="sum", payload_bytes=64,
+             dtype="float32"),
+           E(1, "allreduce", comm_uid=1, reduction="sum", payload_bytes=64,
+             dtype="int32")]
+    g = G(events=evs, meta=dict(_FUSION_META))
+    assert [x.code for x in checkers.run_checkers(g)] == ["MPX111"]
+
+
+def test_mpx111_negative_cases():
+    # fusion already on
+    g = G(events=_adjacent(), meta={**_FUSION_META, "fusion": "auto"})
+    assert codes_of(g) == []
+    # no fusion meta at all (hand-built graph testing another rule)
+    assert codes_of(G(events=_adjacent())) == []
+    # different reductions never bucket
+    evs = _adjacent() + [E(2, "allreduce", comm_uid=1, reduction="max",
+                           payload_bytes=64)]
+    evs[2].index = 2
+    g = G(events=[evs[0], evs[2]], meta=dict(_FUSION_META))
+    assert codes_of(g) == []
+    # an intervening op breaks adjacency
+    evs = [E(0, "allreduce", comm_uid=1, reduction="sum", payload_bytes=64),
+           E(1, "barrier", comm_uid=1),
+           E(2, "allreduce", comm_uid=1, reduction="sum", payload_bytes=64)]
+    assert codes_of(G(events=evs, meta=dict(_FUSION_META))) == []
+    # members above the bucket cap don't bucket
+    g = G(events=_adjacent(payload=(1 << 20) + 1), meta=dict(_FUSION_META))
+    assert codes_of(g) == []
+    # eager dispatches compile one program per op: nothing to fuse
+    g = G(events=_adjacent(eager=True), meta=dict(_FUSION_META))
+    assert codes_of(g) == []
+    # different roots never bucket (bcast)
+    evs = [E(0, "bcast", comm_uid=1, root=0, payload_bytes=64),
+           E(1, "bcast", comm_uid=1, root=1, payload_bytes=64)]
+    assert codes_of(G(events=evs, meta=dict(_FUSION_META))) == []
+    # same-root bcast run fires
+    evs = [E(0, "bcast", comm_uid=1, root=0, payload_bytes=64),
+           E(1, "bcast", comm_uid=1, root=0, payload_bytes=64)]
+    assert codes_of(G(events=evs, meta=dict(_FUSION_META))) == ["MPX111"]
+    # callable reductions never defer (ops/allreduce.py gates on enum
+    # Ops), so advising fusion for them would be wrong
+    g = G(events=_adjacent(reduction="my_combiner"),
+          meta=dict(_FUSION_META))
+    assert codes_of(g) == []
+    assert checkers.ENUM_REDUCTIONS == tuple(
+        o for o in ("sum", "prod", "min", "max", "land", "lor", "lxor",
+                    "band", "bor", "bxor"))
+
+
+def test_fusable_ops_mirror():
+    # the checker's literal mirror must match the deferral layer's list
+    fusion = sys.modules[f"{_ISO_NAME}.ops._fusion"]
+    assert checkers.FUSABLE_OPS == fusion.FUSABLE_OPS
+
+
+def test_config_snapshot_records_fusion():
+    snap = hook.config_snapshot()
+    assert snap["fusion"] in config.FUSION_MODES
+    assert snap["fusion_bucket_bytes"] == config.fusion_bucket_bytes()
+
+
+# ---------------------------------------------------------------------------
+# MPX112 — async start/wait pairing
+# ---------------------------------------------------------------------------
+
+
+def test_mpx112_unwaited_start_fires():
+    g = G(events=[E(0, "allreduce_start", comm_uid=1, span=11)])
+    (f,) = checkers.run_checkers(g)
+    assert f.code == "MPX112" and f.severity == "error"
+    assert "never waited" in f.message
+    assert "allreduce_wait" in f.suggestion
+
+
+def test_mpx112_wait_without_start_fires():
+    g = G(events=[E(0, "allreduce_wait", comm_uid=1, span=11)])
+    (f,) = checkers.run_checkers(g)
+    assert f.code == "MPX112"
+    assert "no live matching" in f.message
+
+
+def test_mpx112_double_wait_fires_once():
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=11),
+        E(1, "allreduce_wait", comm_uid=1, span=11),
+        E(2, "allreduce_wait", comm_uid=1, span=11),
+    ])
+    codes = [f.code for f in checkers.run_checkers(g)]
+    assert codes == ["MPX112"]
+
+
+def test_mpx112_clean_pairs_interleaved():
+    # two in-flight handles waited out of order: still properly paired
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=1),
+        E(1, "reduce_scatter_start", comm_uid=1, span=2),
+        E(2, "reduce_scatter_wait", comm_uid=1, span=2),
+        E(3, "allreduce_wait", comm_uid=1, span=1),
+    ])
+    assert codes_of(g) == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,502 @@
+"""Collective fusion (ops/_fusion.py): packing plan + lockstep simulator
++ traced integration.
+
+The bucketing plan (dtype segregation, deterministic order, byte cap) and
+the exact-unflattening offsets are pure functions; this file drives them
+through a numpy lockstep simulator that pins fused == unfused for
+allreduce and bcast buckets — any packing-order or offset bug changes the
+result.  The pure half loads the module under a private package name
+(``_load_isolated``, mirroring tests/test_algos.py) so it runs even where
+the installed JAX is below the package's hard floor; the traced half
+(deferral, flush-on-use, HLO pins, cache-key retraces) is gated on a real
+``mpi4jax_tpu`` import (jax>=0.6).
+"""
+
+import importlib
+import os
+import pathlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_fusion_iso"
+
+
+def _load_isolated():
+    """Load ops/_fusion.py + utils/config.py under a private package name,
+    bypassing ``mpi4jax_tpu/__init__.py`` (whose JAX-floor check refuses
+    to import on old JAX) while preserving package context for the
+    relative imports."""
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "ops"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "ops._fusion"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+fu = sys.modules[f"{_ISO_NAME}.ops._fusion"]
+config = sys.modules[f"{_ISO_NAME}.utils.config"]
+
+try:
+    import mpi4jax_tpu  # noqa: F401
+
+    HAS_MPX = True
+except Exception:
+    HAS_MPX = False
+
+needs_mpx = pytest.mark.skipif(
+    not HAS_MPX, reason="mpi4jax_tpu import refused (JAX below hard floor)"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fusion_env():
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in ("MPI4JAX_TPU_FUSION", "MPI4JAX_TPU_FUSION_BUCKET_BYTES")
+    }
+    fu.set_fusion_mode(None)
+    yield
+    fu.set_fusion_mode(None)
+    if HAS_MPX:
+        import mpi4jax_tpu as mpx
+
+        mpx.set_fusion_mode(None)
+        mpx.clear_caches()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# the bucketing plan (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_plan_dtype_segregation_and_order():
+    plan = fu.bucket_plan(
+        [("f32", 100), ("f32", 100), ("i32", 50), ("f32", 100), ("i32", 50)],
+        bucket_bytes=1000,
+    )
+    # dtype-segregated, order-preserving within dtype, deterministic
+    # bucket order (by first member index)
+    assert plan == [[0, 1, 3], [2, 4]]
+
+
+def test_bucket_plan_byte_cap_closes_buckets():
+    # greedy: a bucket closes when the NEXT member would exceed the cap
+    assert fu.bucket_plan([("f", 600), ("f", 600), ("f", 600)], 1300) == \
+        [[0, 1], [2]]
+    assert fu.bucket_plan([("f", 600), ("f", 600), ("f", 600)], 1000) == \
+        [[0], [1], [2]]
+    # a single oversized member still gets its own bucket
+    assert fu.bucket_plan([("f", 9000)], 1000) == [[0]]
+
+
+def test_bucket_plan_force_ignores_cap():
+    assert fu.bucket_plan([("f", 600), ("f", 600), ("f", 600)], 1000,
+                          force=True) == [[0, 1, 2]]
+
+
+def test_bucket_plan_empty():
+    assert fu.bucket_plan([], 1000) == []
+
+
+def test_pack_offsets_are_exact():
+    assert fu.pack_offsets([3, 4, 5]) == [(0, 3), (3, 7), (7, 12)]
+    assert fu.pack_offsets([]) == []
+
+
+# ---------------------------------------------------------------------------
+# lockstep simulator: fused == unfused, member for member
+# ---------------------------------------------------------------------------
+
+
+def _sim_fused(per_rank_arrays, reduce_fn, bucket_bytes, force=False):
+    """Simulate the flush: pack each rank's members with the REAL plan and
+    offsets, reduce the flat buffers across ranks, unflatten — returns
+    the per-member results in member order."""
+    k = len(per_rank_arrays)
+    members = per_rank_arrays[0]
+    entries = [(str(a.dtype), a.size * a.dtype.itemsize) for a in members]
+    plan = fu.bucket_plan(entries, bucket_bytes, force=force)
+    out = [None] * len(members)
+    for bucket in plan:
+        sizes = [members[i].size for i in bucket]
+        flats = [
+            np.concatenate([per_rank_arrays[r][i].ravel() for i in bucket])
+            for r in range(k)
+        ]
+        fused = reduce_fn(flats)
+        for i, (start, end) in zip(bucket, fu.pack_offsets(sizes)):
+            out[i] = fused[start:end].reshape(members[i].shape)
+    assert all(o is not None for o in out), "plan dropped a member"
+    return out
+
+
+@pytest.mark.parametrize("force", [False, True])
+def test_lockstep_fused_allreduce_matches_unfused(force):
+    rng = np.random.RandomState(0)
+    k = 4
+    shapes = [(3,), (2, 2), (5,), (1,), (4,)]
+    per_rank = [
+        [rng.randint(1, 10, s).astype(np.int64) for s in shapes]
+        for _ in range(k)
+    ]
+    unfused = [
+        sum(per_rank[r][i] for r in range(k)) for i in range(len(shapes))
+    ]
+    fused = _sim_fused(per_rank, lambda flats: sum(flats),
+                       bucket_bytes=1 << 20, force=force)
+    for a, b in zip(unfused, fused):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_lockstep_fused_mixed_dtypes_and_tiny_buckets():
+    k = 3
+    shapes = [(4,), (2,), (3,)]
+    per_rank = [
+        [np.full(shapes[0], r + 1, np.float64),
+         np.full(shapes[1], 10 * (r + 1), np.int32),
+         np.full(shapes[2], r + 0.5, np.float64)]
+        for r in range(k)
+    ]
+    unfused = [sum(per_rank[r][i] for r in range(k)) for i in range(3)]
+    # bucket cap of one f64 element forces every member into its own
+    # bucket — the degenerate plan must still reassemble exactly
+    fused = _sim_fused(per_rank, lambda flats: sum(flats), bucket_bytes=8)
+    for a, b in zip(unfused, fused):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_lockstep_fused_bcast_matches_unfused():
+    k, root = 4, 2
+    shapes = [(3,), (2, 3)]
+    rng = np.random.RandomState(1)
+    per_rank = [
+        [rng.randn(*s).astype(np.float32) for s in shapes] for _ in range(k)
+    ]
+    unfused = [per_rank[root][i] for i in range(len(shapes))]
+    # bcast's "reduction" across ranks is selecting the root's flat buffer
+    fused = _sim_fused(per_rank, lambda flats: flats[root],
+                       bucket_bytes=1 << 20)
+    for a, b in zip(unfused, fused):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# mode plumbing + flag registry (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_mode_default_and_env():
+    assert fu.effective_mode() == "off"
+    os.environ["MPI4JAX_TPU_FUSION"] = "auto"
+    assert fu.effective_mode() == "auto"
+    os.environ["MPI4JAX_TPU_FUSION"] = "bogus"
+    with pytest.raises(ValueError):
+        fu.effective_mode()
+
+
+def test_set_fusion_mode_override_and_validation():
+    fu.set_fusion_mode("force")
+    os.environ["MPI4JAX_TPU_FUSION"] = "off"
+    assert fu.effective_mode() == "force"  # override shadows env
+    fu.set_fusion_mode(None)
+    assert fu.effective_mode() == "off"
+    with pytest.raises(ValueError):
+        fu.set_fusion_mode("loud")
+
+
+def test_fusion_cache_token_tracks_mode_and_cap():
+    t0 = fu.fusion_cache_token()
+    assert t0 == ("off", config.DEFAULT_FUSION_BUCKET_BYTES)
+    fu.set_fusion_mode("auto")
+    os.environ["MPI4JAX_TPU_FUSION_BUCKET_BYTES"] = "1024"
+    assert fu.fusion_cache_token() == ("auto", 1024)
+
+
+def test_flags_are_declared():
+    for name in ("MPI4JAX_TPU_FUSION", "MPI4JAX_TPU_FUSION_BUCKET_BYTES",
+                 "MPI4JAX_TPU_OVERLAP_CHUNKS"):
+        assert name in config.FLAGS
+    assert config.FLAGS["MPI4JAX_TPU_FUSION"].choices == config.FUSION_MODES
+
+
+def test_config_stamp_tracks_env_and_epoch():
+    s0 = config.config_stamp()
+    os.environ["MPI4JAX_TPU_FUSION"] = "auto"
+    s1 = config.config_stamp()
+    assert s1 != s0
+    config.bump_config_epoch()
+    assert config.config_stamp() != s1
+    # set_fusion_mode is a programmatic override: epoch must move
+    s2 = config.config_stamp()
+    fu.set_fusion_mode("force")
+    assert config.config_stamp() != s2
+
+
+def test_lazy_result_metadata_without_forcing():
+    cell = fu.LazyResult((2, 3), np.dtype(np.float32), ctx=None)
+    assert cell.shape == (2, 3)
+    assert cell.ndim == 2 and cell.size == 6
+    assert "pending" in repr(cell)
+
+
+def test_lazy_result_forwards_uses():
+    """Drop-in contract: array methods, indexing, operators, equality,
+    and np.asarray on a deferred result behave like the array itself
+    (each forces)."""
+    cell = fu.LazyResult((2, 3), np.dtype(np.float32), ctx=None)
+    cell._value = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(cell.reshape(6), np.arange(6))
+    assert cell.sum() == 15.0
+    np.testing.assert_array_equal(cell[1], [3.0, 4.0, 5.0])
+    np.testing.assert_array_equal(cell + 1, cell._value + 1)
+    eq = cell == cell._value
+    assert eq.all()  # elementwise, not Python identity
+    np.testing.assert_array_equal(np.asarray(cell), cell._value)
+    with pytest.raises(TypeError):
+        hash(cell)  # unhashable, like a traced array
+
+
+# ---------------------------------------------------------------------------
+# traced integration (jax>=0.6)
+# ---------------------------------------------------------------------------
+
+
+def _world():
+    import mpi4jax_tpu as mpx
+
+    comm = mpx.get_default_comm()
+    return mpx, comm, comm.Get_size()
+
+
+@needs_mpx
+@pytest.mark.parametrize("op_name", ["SUM", "PROD", "MAX"])
+def test_fused_allreduce_matches_unfused_traced(op_name):
+    import jax.numpy as jnp
+    import numpy as np
+
+    mpx, comm, size = _world()
+    op = getattr(mpx, op_name)
+    xs = [np.arange(1, size * n + 1, dtype=np.float32).reshape(size, n)
+          for n in (3, 5, 2)]
+
+    def prog(a, b, c):
+        red = [mpx.allreduce(x, op=op)[0] for x in (a, b, c)]
+        return tuple(mpx.varying(r * 1.0) for r in red)
+
+    mpx.set_fusion_mode(None)
+    want = mpx.run(prog, *map(jnp.asarray, xs))
+    mpx.set_fusion_mode("auto")
+    got = mpx.run(prog, *map(jnp.asarray, xs))
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g), rtol=1e-6)
+
+
+@needs_mpx
+def test_fused_bcast_matches_unfused_traced():
+    import jax.numpy as jnp
+    import numpy as np
+
+    mpx, comm, size = _world()
+    xs = [np.arange(size * n, dtype=np.float32).reshape(size, n)
+          for n in (4, 2)]
+
+    def prog(a, b):
+        r1, _ = mpx.bcast(a, 1)
+        r2, _ = mpx.bcast(b, 1)
+        return mpx.varying(r1 + 0), mpx.varying(r2 + 0)
+
+    mpx.set_fusion_mode(None)
+    want = mpx.run(prog, *map(jnp.asarray, xs))
+    mpx.set_fusion_mode("auto")
+    got = mpx.run(prog, *map(jnp.asarray, xs))
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+@needs_mpx
+def test_fused_mixed_dtypes_segregate():
+    import jax.numpy as jnp
+    import numpy as np
+
+    mpx, comm, size = _world()
+    a = np.ones((size, 3), np.float32)
+    b = np.ones((size, 2), np.int32)
+
+    def prog(a, b):
+        ra = mpx.allreduce(a, op=mpx.SUM)[0]
+        rb = mpx.allreduce(b, op=mpx.SUM)[0]
+        return mpx.varying(ra * 1.0), mpx.varying(rb + 0)
+
+    mpx.set_fusion_mode("auto")
+    ga, gb = mpx.run(prog, jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_array_equal(np.asarray(ga), np.full((size, 3), size,
+                                                          np.float32))
+    np.testing.assert_array_equal(np.asarray(gb), np.full((size, 2), size,
+                                                          np.int32))
+
+
+@needs_mpx
+def test_fusion_grad_parity():
+    """JVP/transpose parity: grad through fused == grad through unfused."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    mpx, comm, size = _world()
+
+    def loss(a, b):
+        ra = mpx.allreduce(a, op=mpx.SUM)[0]
+        rb = mpx.allreduce(b, op=mpx.SUM)[0]
+        return jnp.sum(ra * ra) + jnp.sum(rb * 3.0)
+
+    def run_grad():
+        @mpx.spmd
+        def g(a, b):
+            ga, gb = jax.grad(loss, argnums=(0, 1))(a, b)
+            return mpx.varying(ga), mpx.varying(gb)
+
+        a = jnp.ones((size, 3), jnp.float32)
+        b = jnp.ones((size, 2), jnp.float32)
+        return g(a, b)
+
+    mpx.set_fusion_mode(None)
+    w0, w1 = run_grad()
+    mpx.set_fusion_mode("auto")
+    g0, g1 = run_grad()
+    np.testing.assert_allclose(np.asarray(w0), np.asarray(g0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(g1), rtol=1e-6)
+
+
+@needs_mpx
+def test_adjacency_breaks_on_intervening_op():
+    """A non-joining op flushes the queue first: program order holds and
+    results stay exact."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    mpx, comm, size = _world()
+
+    def prog(a, b):
+        ra = mpx.allreduce(a, op=mpx.SUM)[0]
+        m, _ = mpx.allreduce(b, op=mpx.MAX)  # different reduction: flush
+        rb = mpx.allreduce(b, op=mpx.SUM)[0]
+        return (mpx.varying(ra * 1.0), mpx.varying(m * 1.0),
+                mpx.varying(rb * 1.0))
+
+    a = jnp.asarray(np.arange(size * 2, dtype=np.float32).reshape(size, 2))
+    b = jnp.asarray(np.arange(size * 3, dtype=np.float32).reshape(size, 3))
+    mpx.set_fusion_mode(None)
+    want = mpx.run(prog, a, b)
+    mpx.set_fusion_mode("auto")
+    got = mpx.run(prog, a, b)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g))
+
+
+@needs_mpx
+def test_hlo_byte_identical_when_off():
+    """Acceptance pin: the default (fusion off, overlap unused) HLO is
+    byte-identical to a build where the deferral layer does not exist,
+    and ``auto`` is NOT (fewer collectives — so the pin cannot pass
+    vacuously)."""
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+    from mpi4jax_tpu.ops import _fusion as real_fusion
+
+    @mpx.spmd
+    def f(a, b):
+        ra = mpx.allreduce(a, op=mpx.SUM)[0]
+        rb = mpx.allreduce(b, op=mpx.SUM)[0]
+        return mpx.varying(ra * 1.0), mpx.varying(rb * 1.0)
+
+    a = jnp.ones((8, 4))
+    b = jnp.ones((8, 3))
+    default_off = jax.jit(f).lower(a, b).as_text()
+
+    import unittest.mock as mock
+
+    with mock.patch.object(real_fusion, "maybe_defer",
+                           lambda *args, **kw: None):
+        uninstrumented = jax.jit(f).lower(a, b).as_text()
+    assert default_off == uninstrumented
+
+    mpx.set_fusion_mode("off")
+    explicit_off = jax.jit(f).lower(a, b).as_text()
+    assert explicit_off == default_off
+
+    mpx.set_fusion_mode("auto")
+    fused = jax.jit(f).lower(a, b).as_text()
+    mpx.set_fusion_mode(None)
+    assert fused != default_off
+    # the fused program carries ONE all-reduce where the unfused has two
+    assert fused.count("all-reduce") < default_off.count("all-reduce")
+
+
+@needs_mpx
+def test_fusion_flip_retraces_eager_program():
+    """The fusion mode is folded into the eager cache key: flipping it
+    must retrace (mirrors the telemetry-mode retrace pin)."""
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    mpx.clear_caches()
+    x = jnp.ones((8, 4))
+    mpx.allreduce(x, op=mpx.SUM)
+    mpx.set_fusion_mode("auto")
+    mpx.allreduce(x, op=mpx.SUM)  # eager never defers, but must retrace
+    mpx.set_fusion_mode(None)
+    mpx.allreduce(x, op=mpx.SUM)  # back to the first program
+    s = mpx.cache_stats()
+    assert s["misses"] == 2 and s["hits"] == 1
+
+
+@needs_mpx
+def test_fusion_telemetry_meters():
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode("counters")
+    mpx.set_fusion_mode("auto")
+    try:
+        def prog(a, b):
+            ra = mpx.allreduce(a, op=mpx.SUM)[0]
+            rb = mpx.allreduce(b, op=mpx.SUM)[0]
+            return mpx.varying(ra * 1.0), mpx.varying(rb * 1.0)
+
+        mpx.run(prog, jnp.ones((8, 3)), jnp.ones((8, 2)))
+        meters = mpx.telemetry.snapshot()["meters"]
+        bucket_meters = {k: v for k, v in meters.items()
+                         if ".buckets" in k and k.startswith("fusion.")}
+        member_meters = {k: v for k, v in meters.items()
+                         if ".members" in k and k.startswith("fusion.")}
+        assert sum(bucket_meters.values()) == 1
+        assert sum(member_meters.values()) == 2
+    finally:
+        mpx.set_fusion_mode(None)
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()

@@ -67,6 +67,30 @@ def test_bench_allreduce_algos_schema():
     assert (r["ring_speedup"] is None) == (comm.Get_size() == 1)
 
 
+def test_bench_fusion_schema():
+    # compiles the fused AND unfused programs at a tiny size: a deferral
+    # or packing regression in the fusion layer fails here, fast
+    comm = _world_comm()
+    saved = os.environ.get("MPI4JAX_TPU_FUSION")
+    rows = micro.bench_fusion(comm, counts=(4,), size_kb=0.02, iters=1)
+    assert os.environ.get("MPI4JAX_TPU_FUSION") == saved  # restored
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["count"] == 4
+    assert r["unfused_us_per_op"] > 0 and r["fused_us_per_op"] > 0
+    assert r["fused_speedup"] > 0
+
+
+def test_bench_overlap_schema():
+    comm = _world_comm()
+    rows = micro.bench_overlap(comm, sizes_mb=[0.0001], iters=2,
+                               compute_dim=8)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["monolithic_us"] > 0 and r["overlap_us"] > 0
+    assert r["chunks"] >= 1 and r["overlap_speedup"] > 0
+
+
 def test_save_results_roundtrip(tmp_path):
     import json
 

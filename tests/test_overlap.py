@@ -1,0 +1,306 @@
+"""Async comm/compute overlap (ops/_async.py): chunk plan + traced
+start/wait + the mpx.overlap() region.
+
+The chunk-split plan is pure and loads under any JAX version (isolated
+loader, mirroring tests/test_fusion.py).  The traced half — start/wait
+equivalence with the synchronous ops on the 8-device mesh, lazy routing
+inside ``mpx.overlap()``, double-wait rejection, cache-key retraces —
+needs a real ``mpi4jax_tpu`` import (jax>=0.6).
+"""
+
+import importlib
+import os
+import pathlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_overlap_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "ops", "parallel", "analysis"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    importlib.import_module(f"{_ISO_NAME}.ops._async")
+    return root
+
+
+ISO = _load_isolated()
+asy = sys.modules[f"{_ISO_NAME}.ops._async"]
+config = sys.modules[f"{_ISO_NAME}.utils.config"]
+
+try:
+    import mpi4jax_tpu  # noqa: F401
+
+    HAS_MPX = True
+except Exception:
+    HAS_MPX = False
+
+needs_mpx = pytest.mark.skipif(
+    not HAS_MPX, reason="mpi4jax_tpu import refused (JAX below hard floor)"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_overlap_env():
+    saved = os.environ.pop("MPI4JAX_TPU_OVERLAP_CHUNKS", None)
+    yield
+    if HAS_MPX:
+        import mpi4jax_tpu as mpx
+
+        mpx.clear_caches()
+    if saved is None:
+        os.environ.pop("MPI4JAX_TPU_OVERLAP_CHUNKS", None)
+    else:
+        os.environ["MPI4JAX_TPU_OVERLAP_CHUNKS"] = saved
+
+
+# ---------------------------------------------------------------------------
+# the chunk plan (pure)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,chunks", [
+    (1, 1), (1, 4), (7, 2), (8, 2), (9, 2), (10, 3), (5, 8), (1024, 4),
+])
+def test_chunk_split_properties(n, chunks):
+    sizes = asy.overlap_chunk_split(n, chunks)
+    assert sum(sizes) == n
+    assert len(sizes) <= max(1, chunks)
+    assert all(s > 0 for s in sizes)
+    # balanced: no chunk exceeds the ceil stride
+    assert max(sizes) == -(-n // min(max(1, min(chunks, n)), chunks))
+
+
+def test_chunk_split_exact_values():
+    assert asy.overlap_chunk_split(10, 3) == [4, 4, 2]
+    assert asy.overlap_chunk_split(8, 2) == [4, 4]
+    assert asy.overlap_chunk_split(1, 4) == [1]
+
+
+def test_overlap_cache_token_tracks_chunks():
+    assert asy.overlap_cache_token() == (config.DEFAULT_OVERLAP_CHUNKS,)
+    os.environ["MPI4JAX_TPU_OVERLAP_CHUNKS"] = "5"
+    assert asy.overlap_cache_token() == (5,)
+    os.environ["MPI4JAX_TPU_OVERLAP_CHUNKS"] = "0"
+    with pytest.raises(ValueError):
+        asy.overlap_cache_token()
+
+
+# ---------------------------------------------------------------------------
+# traced start/wait (jax>=0.6, 8-device mesh)
+# ---------------------------------------------------------------------------
+
+
+def _world():
+    import mpi4jax_tpu as mpx
+
+    comm = mpx.get_default_comm()
+    return mpx, comm, comm.Get_size()
+
+
+@needs_mpx
+@pytest.mark.parametrize("op_name", ["SUM", "PROD", "MAX"])
+@pytest.mark.parametrize("chunks", [1, 2, 3])
+def test_start_wait_matches_allreduce(op_name, chunks, monkeypatch):
+    """8-device pin: the chunked ring start/wait pair reproduces the
+    synchronous allreduce bit for bit, for every chunk count."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", str(chunks))
+    mpx, comm, size = _world()
+    op = getattr(mpx, op_name)
+    x = np.arange(1, size * 7 + 1, dtype=np.float32).reshape(size, 7) / 7.0
+
+    def sync(v):
+        s, _ = mpx.allreduce(v, op=op)
+        return mpx.varying(s * 1.0)
+
+    def split(v):
+        h, _ = mpx.allreduce_start(v, op=op)
+        v2 = v * 2.0  # independent compute in the gap
+        s, _ = mpx.allreduce_wait(h)
+        return mpx.varying(s + 0 * v2)
+
+    want = np.asarray(mpx.run(sync, jnp.asarray(x)))
+    got = np.asarray(mpx.run(split, jnp.asarray(x)))
+    np.testing.assert_allclose(want, got, rtol=1e-6)
+
+
+@needs_mpx
+def test_start_wait_callable_op_falls_back():
+    """Callable reductions cannot ring-chunk: the start emits the whole
+    butterfly and the pair stays correct."""
+    import jax.numpy as jnp
+
+    mpx, comm, size = _world()
+
+    def f(a, b):
+        return a + b
+
+    x = np.arange(size * 3, dtype=np.float32).reshape(size, 3)
+
+    def split(v):
+        h, _ = mpx.allreduce_start(v, op=f)
+        s, _ = mpx.allreduce_wait(h)
+        return mpx.varying(s * 1.0)
+
+    got = np.asarray(mpx.run(split, jnp.asarray(x)))
+    want = np.broadcast_to(x.sum(axis=0), (size, 3))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@needs_mpx
+@pytest.mark.parametrize("chunks", [1, 2, 4])
+def test_reduce_scatter_start_wait_matches(chunks, monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", str(chunks))
+    mpx, comm, size = _world()
+    x = np.arange(size * size * 3, dtype=np.float32).reshape(size, size, 3)
+
+    def sync(v):
+        s, _ = mpx.reduce_scatter(v, op=mpx.SUM)
+        return mpx.varying(s * 1.0)
+
+    def split(v):
+        h, _ = mpx.reduce_scatter_start(v, op=mpx.SUM)
+        s, _ = mpx.reduce_scatter_wait(h)
+        return mpx.varying(s * 1.0)
+
+    want = np.asarray(mpx.run(sync, jnp.asarray(x)))
+    got = np.asarray(mpx.run(split, jnp.asarray(x)))
+    np.testing.assert_allclose(want, got, rtol=1e-6)
+
+
+@needs_mpx
+def test_overlap_region_lazy_routing():
+    """Inside mpx.overlap(), plain allreduce auto-splits and the result
+    materializes on first use; unforced handles are waited at region
+    exit."""
+    import jax.numpy as jnp
+
+    mpx, comm, size = _world()
+    x = np.arange(size * 4, dtype=np.float32).reshape(size, 4)
+
+    def prog(v):
+        with mpx.overlap():
+            s, _ = mpx.allreduce(v, op=mpx.SUM)
+            w = v * 3.0  # overlaps the wire phases
+            out = s + w * 0
+        return mpx.varying(out)
+
+    got = np.asarray(mpx.run(prog, jnp.asarray(x)))
+    want = np.broadcast_to(x.sum(axis=0), (size, 4))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@needs_mpx
+def test_overlap_region_auto_waits_unused_results():
+    """A result never used inside the region is still waited at exit, so
+    its collective is not dead-code-eliminated out of the analysis/token
+    stream (MPX112 stays clean)."""
+    import jax.numpy as jnp
+
+    mpx, comm, size = _world()
+    x = np.ones((size, 3), np.float32)
+
+    def prog(v):
+        with mpx.overlap():
+            s, _ = mpx.allreduce(v, op=mpx.SUM)
+        return mpx.varying(s * 1.0)  # first use AFTER the region
+
+    got = np.asarray(mpx.run(prog, jnp.asarray(x)))
+    np.testing.assert_allclose(got, np.full((size, 3), size), rtol=1e-6)
+
+
+@needs_mpx
+def test_double_wait_raises():
+    import jax.numpy as jnp
+
+    mpx, comm, size = _world()
+
+    def prog(v):
+        h, _ = mpx.allreduce_start(v, op=mpx.SUM)
+        s, _ = mpx.allreduce_wait(h)
+        with pytest.raises(RuntimeError, match="MPX112"):
+            mpx.allreduce_wait(h)
+        return mpx.varying(s * 1.0)
+
+    np.asarray(mpx.run(prog, jnp.ones((size, 2), jnp.float32)))
+
+
+@needs_mpx
+def test_start_wait_requires_parallel_region():
+    import jax.numpy as jnp
+
+    mpx, comm, size = _world()
+    with pytest.raises(RuntimeError, match="parallel region"):
+        mpx.allreduce_start(jnp.ones((size, 2)), op=mpx.SUM)
+
+
+@needs_mpx
+def test_overlap_requires_managed_region():
+    import mpi4jax_tpu as mpx
+
+    with pytest.raises(RuntimeError, match="managed parallel region"):
+        with mpx.overlap():
+            pass
+
+
+@needs_mpx
+def test_chunks_flip_retraces_eager_program(monkeypatch):
+    """MPI4JAX_TPU_OVERLAP_CHUNKS is folded into the eager cache key:
+    flipping it must retrace (mirrors the fusion/telemetry retrace
+    pins)."""
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    mpx.clear_caches()
+    x = jnp.ones((8, 4))
+    mpx.allreduce(x, op=mpx.SUM)
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", "3")
+    mpx.allreduce(x, op=mpx.SUM)
+    monkeypatch.delenv("MPI4JAX_TPU_OVERLAP_CHUNKS")
+    mpx.allreduce(x, op=mpx.SUM)  # back to the first program
+    s = mpx.cache_stats()
+    assert s["misses"] == 2 and s["hits"] == 1
+
+
+@needs_mpx
+def test_overlap_telemetry_chunk_meter(monkeypatch):
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", "2")
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode("counters")
+    try:
+        def prog(v):
+            h, _ = mpx.allreduce_start(v, op=mpx.SUM)
+            s, _ = mpx.allreduce_wait(h)
+            return mpx.varying(s * 1.0)
+
+        mpx.run(prog, jnp.ones((8, 16), jnp.float32))
+        meters = mpx.telemetry.snapshot()["meters"]
+        chunk_meters = {k: v for k, v in meters.items()
+                        if k.startswith("overlap.allreduce.")}
+        assert sum(chunk_meters.values()) == 2, meters
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
