@@ -190,6 +190,64 @@ def bench_allreduce_algos(comm, sizes_mb, iters=20):
     return rows
 
 
+def bench_hierarchy(comm, sizes_mb=(1, 4), topologies=("2x4", "4x2"),
+                    iters=10):
+    """The hierarchy sweep (``--hierarchy-sweep``): flat ring vs the
+    forced two-level lowering for the SAME PROD allreduce over a payload
+    x topology grid (docs/topology.md).  Each topology is faked via
+    ``MPI4JAX_TPU_TOPOLOGY`` (the same knob the CI topology lane uses on
+    the 8-device CPU mesh); the spec is stamped into every row so saved
+    captures say which host partition produced which number.  Both knobs
+    fold into the program cache keys, so every cell compiles its own
+    program."""
+    from mpi4jax_tpu.utils.config import parse_topology_spec
+
+    n = comm.Get_size()
+    rows = []
+    saved_algo = os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO")
+    saved_topo = os.environ.get("MPI4JAX_TPU_TOPOLOGY")
+    try:
+        for topo in topologies:
+            counts = parse_topology_spec(topo)
+            if sum(counts) != n:
+                print(f"hierarchy sweep: skipping topology {topo} "
+                      f"(covers {sum(counts)} ranks, mesh has {n})",
+                      file=sys.stderr)
+                continue
+            os.environ["MPI4JAX_TPU_TOPOLOGY"] = topo
+            for mb in sizes_mb:
+                nelem = max(1, int(mb * 1e6 / 4))
+                row = {"size_mb": round(nelem * 4 / 1e6, 3),
+                       "topology": topo}
+                for label, algo in (("flat", "ring"), ("hier", "hier")):
+                    os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = algo
+
+                    @mpx.spmd(comm=comm)
+                    def prog(x):
+                        def body(_, v):
+                            s, _tok = mpx.allreduce(v, op=mpx.PROD)
+                            return mpx.varying(jnp.clip(s, 0.5, 2.0))
+
+                        return jax.lax.fori_loop(0, iters, body, x)
+
+                    x = jnp.ones((n, nelem), jnp.float32)
+                    t = _time_program(prog, (x,)) / iters
+                    row[f"{label}_us"] = round(t * 1e6, 1)
+                row["hier_speedup"] = (
+                    round(row["flat_us"] / row["hier_us"], 2) if n > 1
+                    else None
+                )
+                rows.append(row)
+    finally:
+        for key, val in (("MPI4JAX_TPU_COLLECTIVE_ALGO", saved_algo),
+                         ("MPI4JAX_TPU_TOPOLOGY", saved_topo)):
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+    return rows
+
+
 def bench_fusion(comm, counts=(8, 32), size_kb=64, iters=1):
     """The collective-fusion sweep (``--fusion-sweep``): N small allreduces
     per program, fused (``MPI4JAX_TPU_FUSION=auto``, issue-then-consume
@@ -333,6 +391,20 @@ def main():
     p.add_argument("--overlap-sizes-mb", type=float, nargs="+",
                    default=[1, 4],
                    help="payload sizes for --overlap-sweep (MB)")
+    p.add_argument("--hierarchy-sweep", action="store_true",
+                   help="also run the hierarchical-collective sweep "
+                        "(flat ring vs the forced two-level ICI/DCN "
+                        "lowering over a payload x topology grid; each "
+                        "topology faked via MPI4JAX_TPU_TOPOLOGY and "
+                        "stamped into the saved rows; docs/topology.md)")
+    p.add_argument("--hierarchy-topologies", nargs="+",
+                   default=["2x4", "4x2"],
+                   help="MPI4JAX_TPU_TOPOLOGY specs for "
+                        "--hierarchy-sweep (must cover the mesh size; "
+                        "non-matching specs are skipped with a note)")
+    p.add_argument("--hierarchy-sizes-mb", type=float, nargs="+",
+                   default=[1, 4],
+                   help="payload sizes for --hierarchy-sweep (MB)")
     args = p.parse_args()
 
     devices = jax.devices()
@@ -382,6 +454,10 @@ def main():
     ov = (_section("overlap", bench_overlap, comm,
                    tuple(args.overlap_sizes_mb))
           if args.overlap_sweep else None)
+    hs = (_section("hierarchy", bench_hierarchy, comm,
+                   tuple(args.hierarchy_sizes_mb),
+                   tuple(args.hierarchy_topologies))
+          if args.hierarchy_sweep else None)
 
     payload = {
         "platform": devices[0].platform,
@@ -405,6 +481,9 @@ def main():
         payload["fusion"] = fu
     if ov is not None:
         payload["overlap"] = ov
+    if hs is not None:
+        payload["hierarchy"] = hs
+        payload["hierarchy_topologies"] = list(args.hierarchy_topologies)
     if args.telemetry:
         payload["telemetry"] = telemetry_sections
         mpx.set_telemetry_mode(None)
@@ -449,6 +528,15 @@ def main():
             print(f"  {r['size_mb']:>10.3f} MB   {r['monolithic_us']:>8.1f} us"
                   f"   {r['overlap_us']:>8.1f} us"
                   f"   {r['overlap_speedup']:>6.2f}x")
+    if hs is not None:
+        print("\nhierarchy sweep (PROD, f32)   topology   flat ring"
+              "    two-level    hier speedup")
+        for r in hs:
+            sp = (f"{r['hier_speedup']:>6.2f}x"
+                  if r["hier_speedup"] is not None else "n/a (1 device)")
+            print(f"  {r['size_mb']:>10.3f} MB   {r['topology']:>8}"
+                  f"   {r['flat_us']:>8.1f} us   {r['hier_us']:>8.1f} us"
+                  f"   {sp}")
 
 
 if __name__ == "__main__":

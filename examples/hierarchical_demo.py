@@ -1,0 +1,129 @@
+"""Hierarchical topology-aware collectives — the two-level ICI/DCN layer
+end to end (docs/topology.md).
+
+Run plain (single host: every comm is one-host, the hierarchy stays out
+of the way and the flat algorithms run) or under a faked multi-host
+topology, the way the CI topology lane does on the 8-device CPU mesh:
+
+    MPI4JAX_TPU_TOPOLOGY=2x4 python examples/hierarchical_demo.py
+
+Three stages, printing what the topology layer did:
+
+1. **plan** — what host partition was derived for the world comm and
+   whether the two-level decomposition is expressible;
+2. **equivalence** — the SAME ``PROD`` allreduce, broadcast, and
+   reduce_scatter forced through the flat ring and the two-level
+   lowering must agree (the trace-level proof lives in
+   tests/test_hierarchy.py's lockstep simulator, and the program-cache
+   keys retrace per setting);
+3. **telemetry** — counters-tier per-link-class byte split: the
+   hierarchical allreduce lands its modeled wire bytes on the
+   ``intra_host`` (ICI) and ``inter_host`` (DCN) classes
+   (docs/observability.md).
+
+Verified clean by the trace-time verifier in CI
+(``python -m mpi4jax_tpu.analysis examples/hierarchical_demo.py``), with
+and without the topology faked: payloads stay below the ring crossover,
+so the forced-flat sections never trip the MPX113 advisory.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+from mpi4jax_tpu.ops._hierarchy import hier_plan  # noqa: E402
+
+
+class _forced_algo:
+    """Temporarily force MPI4JAX_TPU_COLLECTIVE_ALGO (folded into the
+    program-cache keys, so each setting traces its own program)."""
+
+    def __init__(self, algo):
+        self.algo = algo
+
+    def __enter__(self):
+        self.saved = os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO")
+        os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = self.algo
+
+    def __exit__(self, *exc):
+        if self.saved is None:
+            os.environ.pop("MPI4JAX_TPU_COLLECTIVE_ALGO", None)
+        else:
+            os.environ["MPI4JAX_TPU_COLLECTIVE_ALGO"] = self.saved
+        return False
+
+
+def main():
+    devices = jax.devices()
+    mesh = mpx.make_world_mesh(devices=devices)
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+
+    # --- 1. the plan: what the topology layer sees
+    spec = os.environ.get("MPI4JAX_TPU_TOPOLOGY", "")
+    plan = hier_plan(comm)
+    if plan is None:
+        print(f"topology: {spec or 'derived from mesh'} -> no multi-host "
+              f"hierarchy for this {n}-device comm (flat algorithms "
+              "everywhere — the correct no-op)")
+    else:
+        print(f"topology: {spec or 'derived from mesh'} -> "
+              f"{plan.h} hosts x {plan.r} ranks/host; two-level "
+              "lowerings available")
+
+    # --- 2. equivalence: flat ring vs the forced two-level lowering
+    x = jnp.stack([
+        jnp.full((4096,), 1.0 + 0.001 * r, jnp.float32) for r in range(n)
+    ])
+    blocks = jnp.stack([
+        jnp.arange(n * 8, dtype=jnp.float32).reshape(n, 8) + r
+        for r in range(n)
+    ])
+    results = {}
+    for algo in ("ring", "hier"):
+        with _forced_algo(algo):
+
+            @mpx.spmd(comm=comm)
+            def prog(v, b):
+                s, tok = mpx.allreduce(v, op=mpx.PROD)
+                c, tok = mpx.bcast(b[0], root=1, token=tok)
+                d, _ = mpx.reduce_scatter(b, op=mpx.SUM, token=tok)
+                return mpx.varying(s), mpx.varying(c), mpx.varying(d)
+
+            results[algo] = [np.asarray(o) for o in prog(x, blocks)]
+    for flat_out, hier_out in zip(results["ring"], results["hier"]):
+        np.testing.assert_allclose(flat_out, hier_out, rtol=1e-6)
+    print("equivalence: PROD allreduce + bcast + reduce_scatter agree "
+          "between the flat ring and the two-level lowering")
+
+    # --- 3. telemetry: the per-link-class byte split
+    mpx.set_telemetry_mode("counters")
+    try:
+        with _forced_algo("hier" if plan is not None else "ring"):
+
+            @mpx.spmd(comm=comm)
+            def counted(v):
+                s, _ = mpx.allreduce(v, op=mpx.PROD)
+                return mpx.varying(s)
+
+            counted(x)
+        rows = mpx.telemetry.snapshot()["ops"].values()
+        for row in rows:
+            print(f"telemetry: {row['op']} algo={row['algo']} "
+                  f"intra_host={row['intra_bytes']} B "
+                  f"inter_host={row['inter_bytes']} B "
+                  f"(payload {row['bytes']} B)")
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+
+if __name__ == "__main__":
+    main()

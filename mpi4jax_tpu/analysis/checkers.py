@@ -351,6 +351,52 @@ def check_start_wait(graph: CollectiveGraph) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# topology advisory (MPX113)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX113")
+def check_flat_over_dcn(graph: CollectiveGraph) -> List[Finding]:
+    """Flat ring/butterfly on a multi-host comm above the ring crossover:
+    the payload is large enough that ``auto`` would have chosen the
+    two-level ICI/DCN lowering, but a forced flat algorithm (or an
+    explicit crossover move) kept the single-level one — every round of
+    which is gated on the slowest DCN hop.
+
+    Events carry ``hosts`` only when a hierarchical plan was derivable
+    for their comm (``ops/_hierarchy.annotate_selection``), so comms
+    whose host partition is non-uniform — where flat is the only option —
+    never fire this.  Requires ``comm_size > hosts`` (with one rank per
+    host there is no intra level and hier degenerates to flat).
+    """
+    crossover = graph.meta.get("ring_crossover_bytes")
+    if not crossover:
+        return []
+    findings: List[Finding] = []
+    for e in graph.events:
+        if e.op not in ALGO_OPS or e.algo not in ("ring", "butterfly"):
+            continue
+        if not e.hosts or e.hosts <= 1:
+            continue
+        if e.comm_size is None or e.comm_size <= e.hosts:
+            continue
+        if e.payload_bytes < crossover:
+            continue
+        findings.append(Finding(
+            code="MPX113", op=e.op, index=e.index,
+            message=(f"{e.op} on comm {e.comm_uid} spans {e.hosts} hosts "
+                     f"({e.comm_size} ranks) but ran the flat '{e.algo}' "
+                     f"algorithm at {e.payload_bytes} B (>= the "
+                     f"{crossover} B ring crossover): every round is "
+                     "gated on the slowest DCN hop"),
+            suggestion=("let algo=auto pick the two-level lowering, or "
+                        "force MPI4JAX_TPU_COLLECTIVE_ALGO=hier for an "
+                        "A/B run — see docs/topology.md"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # perf advisory (MPX109)
 # ---------------------------------------------------------------------------
 

@@ -42,7 +42,8 @@ class CollectiveEvent:
     pairs: Optional[Tuple[Tuple[int, int], ...]] = None
     tag: Optional[int] = None
     reduction: Optional[str] = None
-    algo: Optional[str] = None          # "native" | "butterfly" | "ring"
+    algo: Optional[str] = None    # "native" | "butterfly" | "ring" | "hier"
+    hosts: Optional[int] = None   # hosts the comm's widest group spans
     token_in: Optional[int] = None
     token_out: Optional[int] = None
     eager: bool = False

@@ -84,6 +84,8 @@ def config_snapshot() -> dict:
     return {
         "collective_algo": config.collective_algo(),
         "ring_crossover_bytes": config.ring_crossover_bytes(),
+        "dcn_crossover_bytes": config.dcn_crossover_bytes(),
+        "topology": config.topology_spec(),
         "fusion": fusion_mode(),
         "fusion_bucket_bytes": config.fusion_bucket_bytes(),
     }

@@ -117,6 +117,16 @@ CODES = {
             "ran without a live start (double wait).  Each start pairs "
             "with exactly one wait on the same handle.",
         ),
+        CodeInfo(
+            "MPX113", "flat algorithm on a multi-host comm", ADVISORY,
+            "A comm spanning multiple hosts ran a flat (single-level) "
+            "ring or butterfly at a payload above the ring crossover: "
+            "every round is then gated on the slowest DCN hop.  The "
+            "two-level hierarchical lowering (intra-host over ICI, "
+            "inter-host over DCN) was expressible here — let auto pick "
+            "it, or force MPI4JAX_TPU_COLLECTIVE_ALGO=hier "
+            "(docs/topology.md).",
+        ),
     )
 }
 

@@ -64,36 +64,67 @@ def algo_cache_token() -> tuple:
     """Hashable fingerprint of the algorithm-selection configuration —
     folded into every compiled-program cache key that caches op lowerings
     (mirrors ``resilience.runtime.cache_token``), so toggling
-    ``MPI4JAX_TPU_COLLECTIVE_ALGO`` retraces instead of silently serving
-    the old program."""
-    return (config.collective_algo(), config.ring_crossover_bytes())
+    ``MPI4JAX_TPU_COLLECTIVE_ALGO`` — or the topology override / DCN
+    crossover the hierarchical layer reads — retraces instead of silently
+    serving the old program.  (The mesh-derived half of the topology is
+    already in both cache keys via the mesh itself.)"""
+    return (config.collective_algo(), config.ring_crossover_bytes(),
+            config.dcn_crossover_bytes(), config.topology_spec())
 
 
 def static_group_size(comm):
     """The comm's uniform static group size, or ``None`` when group sizes
     differ (unequal color splits cannot ring: the chunk count is the group
-    size and one SPMD program cannot express per-rank chunk counts)."""
+    size and one SPMD program cannot express per-rank chunk counts).
+    Plain delegation to ``Comm.uniform_size`` — the explicit accessor that
+    replaced catching ``Get_size``'s ``RuntimeError`` as control flow —
+    with the one remaining exceptional case (an unbound whole-axes comm
+    outside any trace has no size at all) still mapped to ``None``."""
     try:
-        return comm.Get_size()
-    except RuntimeError:
+        return comm.uniform_size()
+    except RuntimeError:  # unbound comm outside any trace
         return None
 
 
-def resolve_algo(algo: str, payload_bytes: int, k: int, ring_ok: bool) -> str:
-    """Pick ``"butterfly"`` or ``"ring"`` for one call.
+def resolve_algo(algo: str, payload_bytes: int, k: int, ring_ok: bool,
+                 hier_ok: bool = False) -> str:
+    """Pick ``"butterfly"``, ``"ring"``, or ``"hier"`` for one call.
 
     ``algo`` is the configured value (``config.collective_algo()``); forced
-    values win, except that a forced ring falls back to the butterfly where
-    the ring is not expressible (``ring_ok=False``: unequal groups, k <= 1,
-    or a callable op on the chunked-allreduce path).  ``auto`` picks the
-    ring for payloads at/above ``ring_crossover_bytes()`` on groups of at
-    least ``RING_MIN_GROUP``.
+    values win, except that a forced algorithm falls back where it is not
+    expressible — a forced ring to the butterfly (``ring_ok=False``:
+    unequal groups, k <= 1, or a callable op on the chunked-allreduce
+    path), a forced hier to the ``auto`` rules (``hier_ok=False``: no
+    derivable topology, single-host comm, or a non-uniform /
+    non-contiguous host partition — see ``_hierarchy.hier_plan``); never
+    an error.  ``auto`` picks the two-level hierarchical lowering when the
+    comm spans more than one host (``hier_ok``) and the payload clears the
+    ring crossover, the flat ring for single-host payloads at/above
+    ``ring_crossover_bytes()`` on groups of at least ``RING_MIN_GROUP``,
+    and the butterfly otherwise.
     """
+    if algo == "hier":
+        if hier_ok:
+            return "hier"
+        algo = "auto"  # inexpressible: fall back to the auto rules
     if not ring_ok or algo == "butterfly":
         return "butterfly"
     if algo == "ring":
         return "ring"
     if k >= RING_MIN_GROUP and payload_bytes >= config.ring_crossover_bytes():
+        return "hier" if hier_ok else "ring"
+    return "butterfly"
+
+
+def resolve_dcn_algo(shard_bytes: int, h: int, ring_ok: bool = True) -> str:
+    """Inter-host (DCN) phase selection for the hierarchical lowerings:
+    ring when the per-host shard clears ``dcn_crossover_bytes()`` on at
+    least ``RING_MIN_GROUP`` hosts (DCN rounds are expensive — see the
+    flag's default rationale in utils/config.py), butterfly otherwise.
+    ``ring_ok=False`` (callable reductions: the DCN ring would re-chunk
+    the shard) keeps the butterfly."""
+    if (ring_ok and h >= RING_MIN_GROUP
+            and shard_bytes >= config.dcn_crossover_bytes()):
         return "ring"
     return "butterfly"
 
@@ -318,6 +349,31 @@ def apply_ring_allreduce(x, op, comm, k=None):
     return full.reshape(-1)[:n].reshape(shape)
 
 
+def apply_binomial_scatter(buf, groups, root: int, axis, relpos, K: int):
+    """The binomial-halving scatter phase shared by ``apply_vdg_bcast``
+    (over the whole comm) and the hierarchical broadcast (over the
+    intra-host blocks — ops/_hierarchy.py): ``buf`` holds ``K`` virtual
+    chunk rows addressed by ABSOLUTE chunk index, ``relpos`` is this
+    rank's position in the root-rotated frame (= the chunk index it ends
+    up owning).  Each round halves the in-flight span; pairs whose
+    receiver falls outside a group carry only padding and are dropped by
+    ``vdg_scatter_pairs``; non-participants' clamped slices are garbage
+    no pair routes and the ``where`` discards."""
+    for w in vdg_widths(K):
+        pairs = vdg_scatter_pairs(groups, root, w, K)
+        if not pairs:
+            continue
+        slab = lax.dynamic_slice_in_dim(buf, relpos + w, w, axis=0)
+        recvd = lax.ppermute(slab, axis, pairs)
+        is_recv = (relpos % (2 * w)) == w
+        buf = jnp.where(
+            is_recv,
+            lax.dynamic_update_slice_in_dim(buf, recvd, relpos, axis=0),
+            buf,
+        )
+    return buf
+
+
 def apply_vdg_bcast(x, comm, root: int, k=None):
     """Large-payload broadcast: binomial-halving scatter from ``root`` +
     ring allgather (van de Geijn).
@@ -353,21 +409,10 @@ def apply_vdg_bcast(x, comm, root: int, k=None):
     chunk, _ = chunk_layout(n, k)
     K = next_pow2(k)
     buf = _pad_to(x.reshape(-1), K * chunk).reshape(K, chunk)
-    for w in vdg_widths(K):
-        pairs = vdg_scatter_pairs(groups, root, w, K)
-        if not pairs:
-            continue
-        # senders (relpos % 2w == 0) hold virtual chunks [relpos, relpos+2w)
-        # and ship the far half; the receiver at relpos+w writes it at its
-        # OWN relpos.  Non-participants' slices are clamped garbage that no
-        # pair routes and the where() discards.
-        slab = lax.dynamic_slice_in_dim(buf, relpos + w, w, axis=0)
-        recvd = lax.ppermute(slab, axis, pairs)
-        is_recv = (relpos % (2 * w)) == w
-        buf = jnp.where(
-            is_recv, lax.dynamic_update_slice_in_dim(buf, recvd, relpos, axis=0),
-            buf,
-        )
+    # senders (relpos % 2w == 0) hold virtual chunks [relpos, relpos+2w)
+    # and ship the far half; the receiver at relpos+w writes it at its
+    # OWN relpos (see apply_binomial_scatter)
+    buf = apply_binomial_scatter(buf, groups, root, axis, relpos, K)
     mine = jnp.take(buf, relpos, axis=0)  # this rank's real chunk (relpos < k)
     full = apply_ring_allgather(mine, comm, k, relpos)
     return full.reshape(-1)[:n].reshape(shape)
@@ -381,14 +426,18 @@ def apply_reduce_scatter(xl, op, comm):
     Native path: one ``psum_scatter`` HLO for SUM on a whole single-axis
     comm under ``auto``.  Otherwise butterfly (allreduce the block stack,
     keep own block — O(size·log k) bytes) vs ring (O(size·(k-1)/k) bytes)
-    by the selector.  Blocks are the user's own, so block-wise callables
-    (including whole-block ops like ``jnp.matmul``, which batch over the
-    leading axis on the butterfly path) are valid on BOTH algorithms —
-    the chunked-allreduce elementwise caveat does not apply here.
+    vs the two-level hierarchical split (``_hierarchy``: intra-host
+    reduce-scatter of position super-blocks over ICI, inter-host
+    reduce-scatter of the per-host partials over DCN) by the selector.
+    Blocks are the user's own, so block-wise callables (including
+    whole-block ops like ``jnp.matmul``, which batch over the leading
+    axis) are valid on EVERY algorithm — the chunked-allreduce
+    elementwise caveat does not apply here.
     """
     from ._base import Op, apply_butterfly_allreduce, as_varying
     from ..analysis.hook import annotate
     from ..telemetry.core import annotate as t_annotate
+    from . import _hierarchy
 
     k = comm.Get_size()  # static; raises the clear error on unequal splits
     xl = as_varying(xl, comm.axes)
@@ -406,9 +455,14 @@ def apply_reduce_scatter(xl, op, comm):
             return res
         except NotImplementedError:  # shard_map/backend gap: fall through
             pass
-    algo = resolve_algo(algo, xl.size * xl.dtype.itemsize, k, ring_ok=True)
-    annotate(algo=algo)
-    t_annotate(algo=algo)
+    plan = _hierarchy.hier_plan(comm)
+    nbytes = xl.size * xl.dtype.itemsize
+    algo = resolve_algo(algo, nbytes, k, ring_ok=True,
+                        hier_ok=plan is not None)
+    _hierarchy.annotate_selection("reduce_scatter", algo, nbytes, k, plan,
+                                  comm, preserve=not isinstance(op, Op))
+    if algo == "hier":
+        return _hierarchy.apply_hier_reduce_scatter(xl, op, comm, plan)
     if algo == "ring":
         return apply_ring_reduce_scatter(xl, op, comm, k)
     full = apply_butterfly_allreduce(xl, op, comm)

@@ -96,7 +96,8 @@ class AsyncHandle:
     telemetry bracket end, native op_end)."""
 
     __slots__ = ("kind", "comm", "reduction", "shape", "dtype", "sizes",
-                 "k", "mode", "pieces", "span", "uid", "waited", "algo")
+                 "k", "mode", "pieces", "span", "uid", "waited", "algo",
+                 "plan")
 
     def __init__(self, kind, comm, reduction):
         self.kind = kind
@@ -106,12 +107,13 @@ class AsyncHandle:
         self.dtype = None
         self.sizes = None       # chunk element counts (ring mode)
         self.k = None
-        self.mode = None        # "ring" | "full"
+        self.mode = None        # "ring" | "hier" | "full"
         self.pieces = None
         self.span = None
         self.uid = next(_span_counter)
         self.waited = False
         self.algo = None
+        self.plan = None        # HierPlan (hier mode only)
 
     def __repr__(self):
         state = "waited" if self.waited else "in-flight"
@@ -196,12 +198,20 @@ def _require_region(opname: str, comm):
     return comm
 
 
-def _annotate_algo(algo: str) -> None:
+def _annotate_algo(algo: str, link=None) -> None:
+    """Record the selected algorithm (analysis + telemetry) and, when
+    given, the modeled per-link-class wire bytes.  The start op carries
+    the FULL model for the exchange it initiates; the wait op annotates
+    ``(0, 0)`` — its traffic is already accounted at the start, and the
+    payload-on-intra default would double-count the pieces."""
     from ..analysis.hook import annotate
     from ..telemetry.core import annotate as t_annotate
 
     annotate(algo=algo)
-    t_annotate(algo=algo)
+    if link is None:
+        t_annotate(algo=algo)
+    else:
+        t_annotate(algo=algo, link_bytes=link)
 
 
 # ---------------------------------------------------------------------------
@@ -240,23 +250,58 @@ def allreduce_start(x, op=None, *, comm: Optional[Comm] = None,
             handle.algo = "butterfly"
             full = apply_allreduce(xl, op, comm)
             return full, produce(token, full)
-        handle.mode = "ring"
-        handle.algo = "ring"
+        # hierarchical composition (docs/topology.md): when the comm
+        # spans multiple hosts and the selector would pick the two-level
+        # lowering, each overlap chunk's start phase runs the intra-host
+        # reduce-scatter AND the inter-host (DCN) exchange, and the wait
+        # phase is the intra-host allgather — so independent compute
+        # overlaps the expensive DCN rounds, not just the ICI ring.
+        from . import _hierarchy
+
+        plan = _hierarchy.hier_plan(comm)
+        use_hier = (
+            plan is not None and plan.r > 1
+            and _algos.resolve_algo(
+                algo, xl.size * xl.dtype.itemsize, k, ring_ok=True,
+                hier_ok=True) == "hier"
+        )
+        handle.mode = "hier" if use_hier else "ring"
+        handle.algo = "hier" if use_hier else "ring"
         handle.k = k
+        handle.plan = plan if use_hier else None
         xl = as_varying(xl, comm.axes)
         flat = xl.reshape(-1)
         sizes = overlap_chunk_split(flat.shape[0], config.overlap_chunks())
         handle.sizes = sizes
-        _annotate_algo("ring")
+        nbytes = flat.shape[0] * xl.dtype.itemsize
+        if use_hier:
+            link = _hierarchy.hier_link_bytes("allreduce", nbytes, plan.h,
+                                              plan.r)
+        else:
+            link = _hierarchy.flat_link_bytes(
+                "allreduce", "ring", nbytes, k, _hierarchy.comm_hosts(comm)
+            )
+        _annotate_algo(handle.algo, link)
         _meter_chunks("allreduce", comm, flat.dtype, len(sizes))
         pieces = []
         off = 0
         for csz in sizes:
             seg = flat[off:off + csz]
             off += csz
-            chunk, padded = _algos.chunk_layout(csz, k)
-            blocks = _algos._pad_to(seg, padded).reshape(k, chunk)
-            pieces.append(_algos.apply_ring_reduce_scatter(blocks, op, comm, k))
+            if use_hier:
+                chunk, padded = _algos.chunk_layout(csz, plan.r)
+                blocks = _algos._pad_to(seg, padded).reshape(plan.r, chunk)
+                piece = _algos.apply_ring_reduce_scatter(
+                    blocks, op, plan.intra, plan.r
+                )
+                piece = _hierarchy._inter_allreduce(
+                    piece, op, plan, chunk * xl.dtype.itemsize
+                )
+            else:
+                chunk, padded = _algos.chunk_layout(csz, k)
+                blocks = _algos._pad_to(seg, padded).reshape(k, chunk)
+                piece = _algos.apply_ring_reduce_scatter(blocks, op, comm, k)
+            pieces.append(piece)
         return (*pieces, produce(token, pieces[0]))
 
     out = dispatch("allreduce_start", comm, body, (x,), token,
@@ -287,14 +332,21 @@ def allreduce_wait(handle, *, token: Optional[Token] = None):
         else:
             import jax.numpy as jnp
 
-            k, pos = handle.k, comm.Get_rank()
+            if handle.mode == "hier":
+                # the wait phase of the two-level split: the intra-host
+                # (ICI) allgather — the DCN exchange already ran at start
+                gather_comm = handle.plan.intra
+                k = handle.plan.r
+                pos = gather_comm.Get_rank()
+            else:
+                gather_comm, k, pos = comm, handle.k, comm.Get_rank()
             parts = []
             for piece, csz in zip(arrays, handle.sizes):
-                full = _algos.apply_ring_allgather(piece, comm, k, pos)
+                full = _algos.apply_ring_allgather(piece, gather_comm, k, pos)
                 parts.append(full.reshape(-1)[:csz])
             flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
             res = flat.reshape(handle.shape)
-        _annotate_algo(handle.algo)
+        _annotate_algo(handle.algo, link=(0, 0))
         _span_close(handle, comm, res, [res])
         return res, produce(token, res)
 
@@ -345,25 +397,55 @@ def reduce_scatter_start(x, op=None, *, comm: Optional[Comm] = None,
             handle.algo = "butterfly"
             res = xl[0]
             return res, produce(token, res)
-        if not isinstance(op, Op) or config.collective_algo() == "butterfly":
+        algo = config.collective_algo()
+        if not isinstance(op, Op) or algo == "butterfly":
             handle.mode = "full"
             handle.algo = "butterfly"
             res = _algos.apply_reduce_scatter(xl, op, comm)
             return res, produce(token, res)
+        # hierarchical composition: each chunk's start runs the full
+        # two-level exchange (intra super-block reduce-scatter over ICI,
+        # then the inter-host reduce-scatter over DCN); there is no
+        # second data-movement phase for reduce_scatter, so the wait
+        # stays pure reassembly and everything in the gap overlaps both
+        # levels (docs/topology.md)
+        from . import _hierarchy
+
+        plan = _hierarchy.hier_plan(comm)
+        use_hier = (
+            plan is not None
+            and _algos.resolve_algo(
+                algo, xl.size * xl.dtype.itemsize, size, ring_ok=True,
+                hier_ok=True) == "hier"
+        )
         handle.mode = "ring"
-        handle.algo = "ring"
+        handle.algo = "hier" if use_hier else "ring"
         blocks = xl.reshape(size, -1)
         sizes = overlap_chunk_split(blocks.shape[1], config.overlap_chunks())
         handle.sizes = sizes
-        _annotate_algo("ring")
+        nbytes = xl.size * xl.dtype.itemsize
+        if use_hier:
+            link = _hierarchy.hier_link_bytes("reduce_scatter", nbytes,
+                                              plan.h, plan.r)
+        else:
+            link = _hierarchy.flat_link_bytes(
+                "reduce_scatter", "ring", nbytes, size,
+                _hierarchy.comm_hosts(comm)
+            )
+        _annotate_algo(handle.algo, link)
         _meter_chunks("reduce_scatter", comm, blocks.dtype, len(sizes))
         pieces = []
         off = 0
         for csz in sizes:
             sub = blocks[:, off:off + csz]
             off += csz
-            pieces.append(_algos.apply_ring_reduce_scatter(sub, op, comm,
-                                                           size))
+            if use_hier:
+                pieces.append(
+                    _hierarchy.apply_hier_reduce_scatter(sub, op, comm, plan)
+                )
+            else:
+                pieces.append(_algos.apply_ring_reduce_scatter(sub, op, comm,
+                                                               size))
         return (*pieces, produce(token, pieces[0]))
 
     out = dispatch("reduce_scatter_start", comm, body, (x,), token,
@@ -396,7 +478,7 @@ def reduce_scatter_wait(handle, *, token: Optional[Token] = None):
             flat = (jnp.concatenate(arrays) if len(arrays) > 1
                     else arrays[0])
             res = flat.reshape(handle.shape)
-        _annotate_algo(handle.algo)
+        _annotate_algo(handle.algo, link=(0, 0))
         _span_close(handle, comm, res, [res])
         return res, produce(token, res)
 

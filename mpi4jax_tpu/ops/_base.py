@@ -214,13 +214,20 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
       ~``2·(k-1)/k·size`` bytes per rank, the win for large payloads
       (gradient buckets, halo frames).
 
-    Both preserve the deterministic ascending group-rank fold for
-    associative non-commutative callables; the ring additionally requires
-    an elementwise callable and a uniform static group size (see
-    ``_algos`` module docstring), so ``auto`` only routes enum ``Op``s on
-    uniform groups to it.
+    On a multi-host comm (derivable host topology spanning ``h > 1``
+    hosts with uniform contiguous blocks — ``_hierarchy.hier_plan``),
+    ``auto`` instead picks the two-level **hierarchical** lowering above
+    the ring crossover: intra-host ring reduce-scatter over ICI →
+    inter-host allreduce of the shards over DCN → intra-host allgather
+    (``MPI4JAX_TPU_COLLECTIVE_ALGO=hier`` forces it; docs/topology.md).
+
+    All three preserve the deterministic ascending group-rank fold for
+    associative non-commutative callables; the ring and hierarchical
+    paths additionally require an elementwise callable and a uniform
+    static group size (see the ``_algos`` module docstring), so ``auto``
+    only routes enum ``Op``s on uniform groups to them.
     """
-    from . import _algos
+    from . import _algos, _hierarchy
     from ..utils.config import collective_algo
 
     axes = comm.axes
@@ -232,13 +239,22 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
         _telemetry.annotate(algo="native")
         return _NATIVE_COLLECTIVE[op](x, axes)
     k = _algos.static_group_size(comm)
+    chunk_ok = isinstance(op, Op) or algo in ("ring", "hier")
     ring_ok = k is not None and k > 1 and (
         isinstance(op, Op) or algo == "ring"  # auto never chunks callables
     )
-    algo = _algos.resolve_algo(algo, x.size * x.dtype.itemsize,
-                               k or 1, ring_ok)
-    _analysis.annotate(algo=algo)
-    _telemetry.annotate(algo=algo)
+    plan = _hierarchy.hier_plan(comm) if k is not None and k > 1 else None
+    nbytes = x.size * x.dtype.itemsize
+    algo = _algos.resolve_algo(algo, nbytes, k or 1, ring_ok,
+                               hier_ok=plan is not None and chunk_ok)
+    # the annotation's plan is gated on chunk_ok too: a callable under
+    # ``auto`` can never route to the hierarchy, so MPX113 must not
+    # advise a choice that does not exist for this call
+    _hierarchy.annotate_selection("allreduce", algo, nbytes, k or 1,
+                                  plan if chunk_ok else None,
+                                  comm, preserve=not isinstance(op, Op))
+    if algo == "hier":
+        return _hierarchy.apply_hier_allreduce(x, op, comm, plan)
     if algo == "ring":
         return _algos.apply_ring_allreduce(x, op, comm, k)
     return apply_butterfly_allreduce(x, op, comm)

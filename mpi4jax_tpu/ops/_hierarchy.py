@@ -1,0 +1,498 @@
+"""Hierarchical topology-aware collectives: two-level ICI/DCN lowerings.
+
+The flat algorithms in ``_algos.py`` treat the communicator as one ring —
+on a multi-host pod that serializes every DCN (cross-host) hop behind the
+slowest ICI step: a flat ring over ``h`` hosts × ``r`` local ranks pays
+``2·(h·r - 1)`` rounds, ``h`` of them over DCN *per circulation*.  The
+standard fix (Horovod's hierarchical allreduce; NCCL's intra/inter split)
+is a **two-level decomposition** keyed on the host topology
+(``parallel/topology.py``):
+
+- ``apply_hier_allreduce`` — intra-host ring reduce-scatter over ICI
+  (each local rank ends owning a ``1/r`` shard of its host's partial
+  reduction) → inter-host allreduce over DCN among the ``r`` position
+  groups (one leader shard per host per position; ring or butterfly by
+  shard bytes vs ``MPI4JAX_TPU_DCN_CROSSOVER_BYTES``) → intra-host ring
+  allgather.  Per-rank bytes: ``~2·(r-1)/r·size`` over ICI plus
+  ``~2·(h-1)/h·size/r`` over DCN — vs the flat ring's ``2·(k-1)/k·size``
+  with every round gated on DCN.
+- ``apply_hier_reduce_scatter`` — the same split without the trailing
+  allgather: intra-host reduce-scatter of position super-blocks, then an
+  inter-host reduce-scatter of the per-host partials.
+- ``apply_hier_bcast`` — binomial-halving **scatter** within the root's
+  host (reusing ``vdg_scatter_pairs``), inter-host broadcast of each
+  chunk from the root's host (doubling or van de Geijn by chunk bytes vs
+  the DCN crossover), then an intra-host ring allgather: the root ships
+  ``~size`` total, DCN carries ``~size/r`` per position instead of the
+  full payload.
+
+**Fold order.**  The two-level fold combines each host block in ascending
+group order (the intra ring reduce-scatter reuses ``rs_update_pair``'s
+order-preserving lo/hi accumulator for callables), then combines the
+per-host partials in ascending host order.  Because a hierarchical plan
+requires each group's host blocks to be CONTIGUOUS ascending runs of the
+group order, the resulting operand sequence is exactly the flat ascending
+group-rank fold — associativity alone (no commutativity) makes
+hierarchical == flat, for enum ``Op``s and callables alike (pinned by the
+lockstep simulator in tests/test_hierarchy.py).
+
+**Expressibility and fallback.**  A plan exists only when every group of
+the comm splits into ``h >= 2`` contiguous host blocks of one uniform
+size ``r``, identical across groups (one SPMD program cannot express
+per-group hierarchies).  Non-uniform partitions (e.g. a ``3,5`` host
+split), single-host comms, round-robin rank placement, and comms with no
+derivable topology all yield ``hier_plan(comm) is None`` and keep the
+flat algorithms — topology support never turns a working program into an
+error.
+
+The plan geometry (``host_blocks`` / ``hier_split``) and the per-link-
+class byte models (``hier_link_bytes`` / ``flat_link_bytes``) are plain
+Python over ints and tuples, shared with the lockstep simulator in
+tests/test_hierarchy.py — the bandwidth claim is a test, not a comment.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from . import _algos
+
+__all__ = [
+    "host_blocks",
+    "hier_split",
+    "hier_plan",
+    "comm_hosts",
+    "hier_link_bytes",
+    "flat_link_bytes",
+    "annotate_selection",
+    "apply_hier_allreduce",
+    "apply_hier_reduce_scatter",
+    "apply_hier_bcast",
+]
+
+
+# ---------------------------------------------------------------------------
+# plan geometry (pure — shared with the lockstep simulator)
+# ---------------------------------------------------------------------------
+
+
+def host_blocks(members, host_of_rank) -> Optional[List[List[int]]]:
+    """Split ``members`` (one group, in group order) into runs of
+    same-host ranks.  Returns ``None`` when a host's members are not
+    contiguous in group order (e.g. round-robin placement): the two-level
+    fold would then permute operands relative to the flat ascending fold,
+    breaking the hierarchical == flat contract for non-commutative
+    reductions."""
+    blocks: List[List[int]] = []
+    seen = set()
+    cur = None
+    for m in members:
+        h = host_of_rank[m]
+        if h != cur:
+            if h in seen:
+                return None  # host reappears: non-contiguous
+            seen.add(h)
+            blocks.append([])
+            cur = h
+        blocks[-1].append(m)
+    return blocks
+
+
+def hier_split(groups, host_of_rank):
+    """The two-level partition of ``groups`` under ``host_of_rank``, or
+    ``None`` where no single SPMD program can express it.
+
+    Returns ``(intra_groups, inter_groups, h, r)``: every group splits
+    into ``h >= 2`` contiguous host blocks of uniform size ``r`` (the
+    same ``(h, r)`` for every group); ``intra_groups`` are the host
+    blocks, ``inter_groups`` collect the rank at intra position ``j`` of
+    every host block of one group (the "leader shard" groups — ``r`` per
+    group, ``h`` members each).
+    """
+    intra_groups: List[tuple] = []
+    inter_groups: List[tuple] = []
+    h = r = None
+    for members in groups:
+        blocks = host_blocks(members, host_of_rank)
+        if blocks is None:
+            return None
+        sizes = {len(b) for b in blocks}
+        if len(sizes) != 1:
+            return None  # non-uniform ranks-per-host
+        gh, gr = len(blocks), sizes.pop()
+        if h is None:
+            h, r = gh, gr
+        elif (gh, gr) != (h, r):
+            return None  # per-group hierarchies: inexpressible
+        intra_groups.extend(tuple(b) for b in blocks)
+        for j in range(gr):
+            inter_groups.append(tuple(b[j] for b in blocks))
+    if h is None or h < 2:
+        return None  # single-host (or empty): nothing to hierarchize
+    return tuple(intra_groups), tuple(inter_groups), h, r
+
+
+# ---------------------------------------------------------------------------
+# per-link-class byte models (pure — pinned by tests/test_hierarchy.py)
+# ---------------------------------------------------------------------------
+
+
+def hier_link_bytes(kind: str, nbytes: int, h: int, r: int,
+                    preserve: bool = False) -> Tuple[int, int]:
+    """Modeled per-rank wire bytes ``(intra_host, inter_host)`` for one
+    hierarchical collective of ``nbytes`` payload over ``h`` hosts ×
+    ``r`` ranks/host.
+
+    The models mirror the lowerings below round for round (the inter
+    algorithm is resolved exactly as the lowering resolves it):
+
+    - ``allreduce``: intra ring reduce-scatter + allgather of ``r``
+      chunks (``(r-1)·chunk·(pair+1)`` ≈ ``2·(r-1)/r·size``), inter
+      allreduce of one chunk over ``h`` hosts (≈ ``2·(h-1)/h·size/r``);
+    - ``reduce_scatter``: the same without the allgather — intra
+      ``(r-1)·super·pair`` on ``size/r`` super-blocks, inter
+      reduce-scatter of the per-host partial;
+    - ``bcast``: intra binomial scatter (the root's host ships ``~size``
+      down the halving tree; modeled per-rank as ``size``) + allgather,
+      inter broadcast of one ``size/r`` chunk (doubling or vdg).
+
+    ``pair`` is 2 for order-preserving callables (the lo/hi accumulator
+    ships both halves), 1 for enum ``Op``s.
+    """
+    pair = 2 if preserve else 1
+    chunk = -(-nbytes // r)
+    if kind == "allreduce":
+        intra = (r - 1) * chunk * (pair + 1)
+        dcn = _algos.resolve_dcn_algo(chunk, h, ring_ok=not preserve)
+        inter = _algos.algorithm_bytes_per_rank(dcn, chunk, h, preserve)
+        return intra, inter
+    if kind == "reduce_scatter":
+        super_b = chunk  # one position super-block = size/r bytes
+        intra = (r - 1) * super_b * pair
+        block = -(-super_b // h)
+        if _algos.resolve_dcn_algo(super_b, h) == "ring":
+            inter = (h - 1) * block * pair
+        else:  # butterfly allreduce + own-block select
+            inter = 2 * (h - 1).bit_length() * super_b if h > 1 else 0
+        return intra, inter
+    if kind == "bcast":
+        intra = nbytes + (r - 1) * chunk  # halving scatter + ring allgather
+        if _algos.resolve_dcn_algo(chunk, h) == "ring":
+            inter = 2 * chunk  # van de Geijn: scatter + allgather
+        else:
+            inter = (h - 1).bit_length() * chunk  # doubling rounds
+        return intra, inter
+    raise ValueError(f"unknown hierarchical collective kind {kind!r}")
+
+
+def flat_link_bytes(kind: str, algo: str, nbytes: int, k: int,
+                    h: Optional[int],
+                    preserve: bool = False) -> Tuple[int, int]:
+    """Link-class attribution for a FLAT (single-level) algorithm,
+    modeled per op kind round for round (mirroring the flat lowerings,
+    so flat-vs-hier comparisons in the telemetry report are fair):
+
+    - ``allreduce``: butterfly ``2·ceil(log2 k)·size`` (fold + doubling
+      broadcast), ring ``(k-1)·chunk·(pair+1)``;
+    - ``bcast``: doubling ``ceil(log2 k)·size`` (one full-payload send
+      per round), van de Geijn ``~2·size`` (halving scatter + ring
+      allgather);
+    - ``reduce_scatter``: butterfly = allreduce-then-select
+      (``2·ceil(log2 k)·size``), ring ``(k-1)·chunk·pair`` (no
+      allgather phase).
+
+    The volume lands entirely on the inter-host class when the comm
+    spans ``h > 1`` hosts (every round of a flat algorithm over a
+    multi-host comm is gated on its slowest — DCN — link; exactly the
+    serialization MPX113 advises about), on the intra class otherwise.
+    ``native`` HLO (and comms with no derivable topology) is attributed
+    as payload bytes on the intra class — XLA schedules it, we don't
+    model it."""
+    pair = 2 if preserve else 1
+    rounds = (k - 1).bit_length() if k > 1 else 0  # ceil(log2 k)
+    chunk = -(-nbytes // k) if k else nbytes
+    if algo == "butterfly":
+        if kind == "bcast":
+            total = rounds * nbytes
+        else:  # allreduce; reduce_scatter = allreduce + own-block select
+            total = 2 * rounds * nbytes
+    elif algo == "ring":
+        if kind == "bcast":  # van de Geijn: scatter + ring allgather
+            total = nbytes + (k - 1) * chunk
+        elif kind == "reduce_scatter":
+            total = (k - 1) * chunk * pair
+        else:  # allreduce: reduce-scatter + allgather
+            total = (k - 1) * chunk * (pair + 1)
+    else:
+        return nbytes, 0
+    if h is not None and h > 1:
+        return 0, total
+    return total, 0
+
+
+# ---------------------------------------------------------------------------
+# the plan: derived comms, memoized per (comm, topology)
+# ---------------------------------------------------------------------------
+
+
+class HierPlan:
+    """One comm's two-level decomposition: the intra-host and inter-host
+    derived communicators (color-split comms over the SAME mesh axes, so
+    every phase is ordinary masked ``ppermute`` routing) plus the static
+    geometry."""
+
+    __slots__ = ("intra", "inter", "h", "r")
+
+    def __init__(self, intra, inter, h: int, r: int):
+        self.intra = intra
+        self.inter = inter
+        self.h = h
+        self.r = r
+
+    def __repr__(self):
+        return f"HierPlan(h={self.h}, r={self.r})"
+
+
+# LRU-bounded like the caches it feeds: each entry pins two GroupComms
+# (and through them a mesh reference)
+_plan_memo: "OrderedDict" = OrderedDict()
+_PLAN_MEMO_MAX = 64
+_NO_PLAN = object()
+
+
+def hier_plan(comm) -> Optional[HierPlan]:
+    """The two-level plan for ``comm``, or ``None`` when the hierarchy is
+    not expressible (no derivable topology, single host, non-uniform or
+    non-contiguous host partition) — callers then keep the flat
+    algorithms.  Memoized per (comm, mesh, topology): plan construction
+    walks the world once, which must not run per traced collective."""
+    from ..parallel.topology import derive_world_topology
+
+    topo = derive_world_topology(comm)
+    if topo is None or topo.num_hosts < 2:
+        return None
+    groups = comm.groups
+    if groups is None:
+        try:
+            world = comm.world_size()
+        except RuntimeError:
+            return None
+        groups = (tuple(range(world)),)
+    key = (comm.uid, comm.mesh, comm.axes, topo.fingerprint(), groups)
+    cached = _plan_memo.get(key)
+    if cached is not None:
+        _plan_memo.move_to_end(key)
+        return None if cached is _NO_PLAN else cached
+    split = hier_split(groups, topo.host_of_rank)
+    if split is None:
+        plan = None
+    else:
+        from ..parallel.comm import GroupComm
+
+        intra_groups, inter_groups, h, r = split
+        plan = HierPlan(GroupComm(comm, intra_groups),
+                        GroupComm(comm, inter_groups), h, r)
+    _plan_memo[key] = _NO_PLAN if plan is None else plan
+    if len(_plan_memo) > _PLAN_MEMO_MAX:
+        _plan_memo.popitem(last=False)
+    return plan
+
+
+# memoized like the plan: the per-group span walk is O(world) and runs
+# once per traced collective on comms without a plan (the common
+# single-host case)
+_hosts_memo: "OrderedDict" = OrderedDict()
+_HOSTS_MEMO_MAX = 64
+
+
+def comm_hosts(comm) -> Optional[int]:
+    """How many hosts ``comm``'s widest group spans (``None`` when no
+    topology is derivable) — the multi-host signal the telemetry link
+    classes key on, available even where the full hierarchy is not
+    expressible (non-uniform partitions still ship over DCN)."""
+    from ..parallel.topology import derive_world_topology
+
+    topo = derive_world_topology(comm)
+    if topo is None:
+        return None
+    groups = comm.groups
+    if groups is None:
+        return topo.num_hosts
+    key = (comm.uid, topo.fingerprint(), groups)
+    cached = _hosts_memo.get(key)
+    if cached is not None:
+        _hosts_memo.move_to_end(key)
+        return cached
+    hosts = max(
+        len({topo.host_of_rank[m] for m in members}) for members in groups
+    )
+    _hosts_memo[key] = hosts
+    if len(_hosts_memo) > _HOSTS_MEMO_MAX:
+        _hosts_memo.popitem(last=False)
+    return hosts
+
+
+def annotate_selection(kind: str, algo: str, nbytes: int, k: int,
+                       plan: Optional[HierPlan], comm,
+                       preserve: bool = False) -> None:
+    """One-stop dispatch-point annotation for the reduction family: the
+    selected algorithm (analysis + telemetry), the host span (MPX113),
+    and the modeled per-link-class wire bytes (telemetry's
+    ``intra_host``/``inter_host`` counters).  Pure host-side bookkeeping:
+    never adds an equation to the trace."""
+    from ..analysis.hook import annotate as a_annotate
+    from ..telemetry.core import annotate as t_annotate
+
+    hosts = plan.h if plan is not None else comm_hosts(comm)
+    if algo == "hier":
+        link = hier_link_bytes(kind, nbytes, plan.h, plan.r, preserve)
+    else:
+        link = flat_link_bytes(kind, algo, nbytes, k, hosts, preserve)
+    # the analysis event carries ``hosts`` only when the hierarchy was
+    # actually expressible (a plan existed): MPX113 advises on a CHOICE,
+    # and where flat is the only option there is nothing to advise.  The
+    # telemetry link classes keep the broader host signal — a flat
+    # algorithm on a non-uniform multi-host comm still ships over DCN.
+    a_annotate(algo=algo, hosts=plan.h if plan is not None else None)
+    t_annotate(algo=algo, link_bytes=link)
+
+
+# ---------------------------------------------------------------------------
+# traced appliers
+# ---------------------------------------------------------------------------
+
+
+def apply_hier_allreduce(x, op, comm, plan: HierPlan):
+    """Two-level allreduce: intra-host ring reduce-scatter (ICI) →
+    inter-host allreduce of each rank's shard (DCN; ring or butterfly by
+    ``resolve_dcn_algo``) → intra-host ring allgather (ICI).
+
+    Same contract as the flat lowerings: all 10 ``Op``s plus associative
+    callables folded in ascending group-rank order (callables must be
+    ELEMENTWISE — the payload is chunked, the same caveat as the flat
+    ring; ``auto`` never routes callables here, only a forced ``hier``
+    does).  Bit-identical to the flat algorithms under exact arithmetic
+    (tests/test_hierarchy.py pins all 10 ops across 4 topologies).
+    """
+    from ._base import as_varying
+
+    x = as_varying(x, comm.axes)
+    r, h = plan.r, plan.h
+    if r == 1:
+        # one rank per host: the inter phase IS the whole collective
+        return _inter_allreduce(x, op, plan, x.size * x.dtype.itemsize)
+    shape, n = x.shape, x.size
+    chunk, padded = _algos.chunk_layout(n, r)
+    blocks = _algos._pad_to(x.reshape(-1), padded).reshape(r, chunk)
+    mine = _algos.apply_ring_reduce_scatter(blocks, op, plan.intra, r)
+    reduced = _inter_allreduce(mine, op, plan, chunk * x.dtype.itemsize)
+    pos = plan.intra.Get_rank()
+    full = _algos.apply_ring_allgather(reduced, plan.intra, r, pos)
+    return full.reshape(-1)[:n].reshape(shape)
+
+
+def _inter_allreduce(v, op, plan: HierPlan, shard_bytes: int):
+    """The DCN phase: allreduce ``v`` over the inter (leader-shard) comm,
+    ring or butterfly by shard size vs the DCN crossover.  Callables keep
+    the butterfly (the DCN ring would re-chunk the shard — the
+    elementwise caveat squared)."""
+    from ._base import Op, apply_butterfly_allreduce
+
+    if plan.h == 1:
+        return v
+    ring_ok = isinstance(op, Op)
+    if _algos.resolve_dcn_algo(shard_bytes, plan.h, ring_ok) == "ring":
+        return _algos.apply_ring_allreduce(v, op, plan.inter, plan.h)
+    return apply_butterfly_allreduce(v, op, plan.inter)
+
+
+def apply_hier_reduce_scatter(xl, op, comm, plan: HierPlan):
+    """Two-level reduce-scatter of ``xl`` (shape ``(k, *s)``, block ``i``
+    addressed to group position ``i``): intra-host ring reduce-scatter of
+    the ``r`` position SUPER-blocks (super-block ``j`` stacks the ``h``
+    blocks addressed to intra position ``j`` of each host) → inter-host
+    reduce-scatter of the per-host partials.  No allgather phase — the
+    result is each rank's own folded block, shape ``(*s,)``.
+
+    Blocks are the user's own (never re-chunked), so block-wise callables
+    remain valid — the combine sees ``(h, *s)`` stacks in the intra phase
+    and must batch over the leading axis (e.g. ``jnp.matmul`` does).
+    """
+    from ._base import as_varying
+
+    xl = as_varying(xl, comm.axes)
+    r, h = plan.r, plan.h
+    if r == 1:
+        return _inter_reduce_scatter(xl, op, plan)
+    s = xl.shape[1:]
+    y = jnp.moveaxis(xl.reshape((h, r) + s), 1, 0)  # y[j, b] = block b·r+j
+    partial = _algos.apply_ring_reduce_scatter(y, op, plan.intra, r)
+    return _inter_reduce_scatter(partial, op, plan)
+
+
+def _inter_reduce_scatter(blocks, op, plan: HierPlan):
+    """DCN phase of the hierarchical reduce-scatter: ``blocks`` (shape
+    ``(h, *s)``) holds this rank's per-host partials; host ``b``'s rank
+    receives the ascending-host fold of every host's partial ``b``."""
+    from ._base import apply_butterfly_allreduce
+
+    h = plan.h
+    if h == 1:
+        return blocks[0]
+    nbytes = int(blocks.size) * blocks.dtype.itemsize
+    if _algos.resolve_dcn_algo(nbytes, h) == "ring":
+        return _algos.apply_ring_reduce_scatter(blocks, op, plan.inter, h)
+    full = apply_butterfly_allreduce(blocks, op, plan.inter)
+    return jnp.take(full, plan.inter.Get_rank(), axis=0)
+
+
+def apply_hier_bcast(x, comm, root: int, plan: HierPlan):
+    """Two-level broadcast from group position ``root``: binomial-halving
+    scatter of the ``r`` payload chunks within the root's host block
+    (reusing ``vdg_scatter_pairs`` over the intra groups) → inter-host
+    broadcast of each chunk from the root's host (doubling or van de
+    Geijn by chunk bytes vs the DCN crossover) → intra-host ring
+    allgather.  DCN carries ``~size/r`` per position instead of the full
+    payload.
+
+    ``root`` is a group position (the same convention as the flat
+    lowerings); with contiguous uniform host blocks its host index and
+    intra position are the static pair ``divmod(root, r)``.
+    """
+    from ._base import _permute_axis, as_varying
+
+    x = as_varying(x, comm.axes)
+    r, h = plan.r, plan.h
+    itemsize = x.dtype.itemsize
+    if r == 1:
+        return _inter_bcast(x, plan, root, x.size * itemsize)
+    b0, j0 = divmod(root, r)
+    shape, n = x.shape, x.size
+    chunk, _ = _algos.chunk_layout(n, r)
+    R = _algos.next_pow2(r)
+    pos = plan.intra.Get_rank()
+    relpos = (pos - j0) % r
+    axis = _permute_axis(comm)
+    buf = _algos._pad_to(x.reshape(-1), R * chunk).reshape(R, chunk)
+    buf = _algos.apply_binomial_scatter(buf, plan.intra.groups, j0, axis,
+                                        relpos, R)
+    mine = jnp.take(buf, relpos, axis=0)  # this rank's chunk (relpos < r)
+    mine = _inter_bcast(mine, plan, b0, chunk * itemsize)
+    full = _algos.apply_ring_allgather(mine, plan.intra, r, relpos)
+    return full.reshape(-1)[:n].reshape(shape)
+
+
+def _inter_bcast(v, plan: HierPlan, b0: int, nbytes: int):
+    """DCN phase of the hierarchical broadcast: every inter group
+    broadcasts from group position ``b0`` (the root's host index —
+    uniform across groups by plan construction)."""
+    from ._base import apply_doubling_bcast
+
+    if plan.h == 1:
+        return v
+    if _algos.resolve_dcn_algo(nbytes, plan.h) == "ring":
+        return _algos.apply_vdg_bcast(v, plan.inter, b0, plan.h)
+    return apply_doubling_bcast(v, plan.inter, b0)

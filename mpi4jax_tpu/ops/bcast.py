@@ -54,7 +54,7 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
         return deferred
 
     def body(comm, arrays, token):
-        from . import _algos
+        from . import _algos, _hierarchy
         from ..analysis.hook import annotate
         from ..utils.config import collective_algo
 
@@ -83,18 +83,27 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
         else:
             # color splits (XLA's axis_index_groups is unavailable under
             # shard_map — see Comm.Split) and forced algorithms: doubling
-            # (butterfly) vs van de Geijn (ring) by static payload bytes.
-            # The vdg scatter needs a uniform static group size; unequal
-            # partitions keep the doubling broadcast, which works on any
-            # partition (the r4 lowering was a full AllGather + per-group
-            # take: O(world) bandwidth per call).
+            # (butterfly) vs van de Geijn (ring) by static payload bytes,
+            # vs the two-level scatter + inter-host bcast + allgather
+            # (_hierarchy.apply_hier_bcast) on multi-host comms.  The vdg
+            # scatter and the hierarchy need a uniform static group size;
+            # unequal partitions keep the doubling broadcast, which works
+            # on any partition (the r4 lowering was a full AllGather +
+            # per-group take: O(world) bandwidth per call).
             k = _algos.static_group_size(comm)
+            plan = (_hierarchy.hier_plan(comm)
+                    if k is not None and k > 1 else None)
+            nbytes = xl.size * xl.dtype.itemsize
             picked = _algos.resolve_algo(
-                algo, xl.size * xl.dtype.itemsize, k or 1,
+                algo, nbytes, k or 1,
                 ring_ok=k is not None and k > 1,
+                hier_ok=plan is not None,
             )
-            annotate(algo=picked)
-            if picked == "ring":
+            _hierarchy.annotate_selection("bcast", picked, nbytes, k or 1,
+                                          plan, comm)
+            if picked == "hier":
+                res = _hierarchy.apply_hier_bcast(xl, comm, root, plan)
+            elif picked == "ring":
                 res = _algos.apply_vdg_bcast(xl, comm, root, k)
             else:
                 res = apply_doubling_bcast(xl, comm, root)

@@ -177,6 +177,19 @@ class Comm:
         the bound a static root index must satisfy on every group."""
         return self.Get_size()
 
+    def uniform_size(self) -> Optional[int]:
+        """The single static group size shared by every group, or
+        ``None`` when group sizes differ (only possible on a color-split
+        comm — see ``GroupComm.uniform_size``).
+
+        The explicit accessor the algorithm selector uses
+        (``ops/_algos.static_group_size``): asking "can this comm ring?"
+        is an ordinary question with an ordinary ``None`` answer, not an
+        exception (``Get_size`` keeps its loud error for the gather
+        family, whose output SHAPES genuinely require a uniform size).
+        """
+        return self.Get_size()
+
     def Clone(self) -> "Comm":
         """Fresh matching namespace over the same group.
 
@@ -320,8 +333,8 @@ class GroupComm(Comm):
         return self._groups
 
     def Get_size(self) -> int:
-        sizes = {len(g) for g in self._groups}
-        if len(sizes) != 1:
+        size = self.uniform_size()
+        if size is None:
             raise RuntimeError(
                 f"Get_size on a color-split comm with unequal group sizes "
                 f"{sorted(len(g) for g in self._groups)} has no single "
@@ -330,7 +343,7 @@ class GroupComm(Comm):
                 "groups — their shapes/blocking depend on the group size; "
                 "every other op works on unequal groups."
             )
-        return sizes.pop()
+        return size
 
     def Get_rank(self):
         """Group-local rank (traced), per MPI_Comm_split semantics."""
@@ -343,6 +356,15 @@ class GroupComm(Comm):
 
     def min_size(self) -> int:
         return min(len(g) for g in self._groups)
+
+    def uniform_size(self) -> Optional[int]:
+        """The uniform group size, or ``None`` for unequal splits —
+        without raising (``Get_size`` raises, which forced the algorithm
+        selector into ``RuntimeError``-as-control-flow)."""
+        sizes = {len(g) for g in self._groups}
+        if len(sizes) != 1:
+            return None
+        return sizes.pop()
 
     def group_size_table(self):
         """Static per-GLOBAL-rank group-size tuple (``table[r]`` = size of

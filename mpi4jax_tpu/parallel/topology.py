@@ -1,0 +1,190 @@
+"""The host-topology model: where ranks live.
+
+The algorithm layer added in ``ops/_algos.py`` selects ring vs butterfly
+from payload bytes alone — it is blind to *where* ranks live.  On a
+multi-host pod that matters enormously: devices on one host talk over ICI
+(fast, low-latency links), devices on different hosts talk over DCN (the
+data-center network, roughly an order of magnitude more per-hop latency
+and less bandwidth).  A flat ring over a 2-host pod serializes every DCN
+hop behind the slowest ICI step.
+
+This module derives a :class:`Topology` — the static host partition of a
+communicator's flat rank space — from either:
+
+- the **JAX process layout** of the comm's bound mesh: device ``d``'s
+  ``process_index`` says which host owns it (``init_distributed`` /
+  ``make_world_mesh`` already arrange the global device order so that
+  processes own contiguous blocks where possible); or
+- the **``MPI4JAX_TPU_TOPOLOGY`` override** (declared in the
+  ``utils/config.py`` flag registry): ``<hosts>x<ranks_per_host>`` (e.g.
+  ``2x4``) or comma-separated per-host counts (``3,5``) — the test and
+  heterogeneous-cluster knob, and how the CI topology lane fakes a
+  2-host pod on the 8-device virtual CPU mesh.
+
+Host ids are *canonical* (renumbered by first appearance in flat rank
+order), so two meshes with the same co-location pattern but different
+process ids compare equal — the hierarchical lowerings only care about
+the partition, never the physical ids.  The topology's fingerprint is
+hashable and folds into ``ops/_algos.algo_cache_token()`` (via the raw
+spec) and both compiled-program cache keys, so changing topology
+retraces like every other knob (docs/topology.md).
+
+Derivation is best-effort by design: whenever the host partition cannot
+be established (unbound comm outside a trace, a spec whose rank count
+does not match this comm's world, a mesh whose axis slabs disagree on
+the co-location pattern), ``derive_world_topology`` returns ``None`` and
+the caller keeps the flat single-level algorithms — topology support
+never turns a working program into an error.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from ..utils import config
+
+
+def canonical_labels(raw: Sequence) -> Tuple[int, ...]:
+    """Renumber arbitrary host labels by first appearance: ``(7, 7, 3)``
+    -> ``(0, 0, 1)``.  The hierarchical lowerings depend only on the
+    partition pattern, so canonical labels make topologies comparable
+    (and cache keys stable) across physical process ids."""
+    seen: dict = {}
+    out = []
+    for x in raw:
+        if x not in seen:
+            seen[x] = len(seen)
+        out.append(seen[x])
+    return tuple(out)
+
+
+class Topology:
+    """The static host partition of a flat rank space.
+
+    ``host_of_rank[r]`` is the canonical host index of flat rank ``r``
+    (the row-major rank order of the comm's mesh axes — the same order
+    ``Comm.Get_rank`` defines).
+    """
+
+    __slots__ = ("host_of_rank",)
+
+    def __init__(self, host_of_rank: Sequence[int]):
+        self.host_of_rank = canonical_labels(host_of_rank)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(set(self.host_of_rank)) if self.host_of_rank else 0
+
+    @property
+    def ranks_per_host(self) -> Tuple[int, ...]:
+        """Rank count per host, in host order."""
+        counts: dict = {}
+        for h in self.host_of_rank:
+            counts[h] = counts.get(h, 0) + 1
+        return tuple(counts[h] for h in sorted(counts))
+
+    def fingerprint(self) -> tuple:
+        """Hashable identity for cache keys and plan memos."""
+        return self.host_of_rank
+
+    def __eq__(self, other):
+        return (isinstance(other, Topology)
+                and self.host_of_rank == other.host_of_rank)
+
+    def __hash__(self):
+        return hash(self.host_of_rank)
+
+    def __repr__(self):
+        return (f"Topology(num_hosts={self.num_hosts}, "
+                f"ranks_per_host={self.ranks_per_host})")
+
+
+def from_counts(counts: Sequence[int]) -> Topology:
+    """Topology from per-host rank counts: ``(3, 5)`` -> ranks 0-2 on
+    host 0, ranks 3-7 on host 1."""
+    host_of_rank = []
+    for h, c in enumerate(counts):
+        host_of_rank.extend([h] * c)
+    return Topology(host_of_rank)
+
+
+# memoized: derivation walks the device list / parses the spec, and it
+# runs once per traced collective (LRU-bounded — mesh keys pin meshes)
+from collections import OrderedDict
+
+_topo_memo: "OrderedDict" = OrderedDict()
+_TOPO_MEMO_MAX = 64
+_NO_TOPO = object()
+
+
+def derive_world_topology(comm) -> Optional[Topology]:
+    """The host partition of ``comm``'s flat (full-axes) rank space, or
+    ``None`` when it cannot be established (the caller falls back to the
+    flat algorithms — never an error).
+
+    Priority: the ``MPI4JAX_TPU_TOPOLOGY`` spec when its total rank count
+    matches this comm's world (a mismatched spec — e.g. a world spec seen
+    by a smaller sub-comm — yields ``None`` for that comm); otherwise the
+    bound mesh's JAX process layout.
+    """
+    spec = config.topology_spec()
+    if spec:
+        try:
+            world = comm.world_size()
+        except RuntimeError:  # unbound comm outside any trace
+            return None
+        key = ("spec", spec, world)
+    else:
+        mesh = comm.mesh
+        if mesh is None:
+            return None
+        key = ("mesh", mesh, comm.axes)
+    cached = _topo_memo.get(key)
+    if cached is not None:
+        _topo_memo.move_to_end(key)
+        return None if cached is _NO_TOPO else cached
+    if spec:
+        counts = config.parse_topology_spec(spec)
+        topo = from_counts(counts) if sum(counts) == world else None
+    else:
+        topo = mesh_topology(mesh, comm.axes)
+    _topo_memo[key] = _NO_TOPO if topo is None else topo
+    if len(_topo_memo) > _TOPO_MEMO_MAX:
+        _topo_memo.popitem(last=False)
+    return topo
+
+
+def mesh_topology(mesh, axes: Tuple[str, ...]) -> Optional[Topology]:
+    """Host partition of the flat rank space over ``axes`` of ``mesh``,
+    from each device's ``process_index``.
+
+    The flat rank order is row-major over ``axes`` (matching
+    ``Comm.Get_rank``).  For a comm over a *subset* of the mesh axes, one
+    comm rank maps to many devices (one per remaining-axes coordinate);
+    the SPMD program is shared, so a topology exists only when every
+    remaining-axes slab exhibits the SAME canonical co-location pattern —
+    otherwise ``None`` (flat fallback).
+    """
+    import numpy as np
+
+    names = tuple(mesh.axis_names)
+    if any(a not in names for a in axes):
+        return None
+    devs = np.asarray(mesh.devices)
+    order = [names.index(a) for a in axes] + [
+        i for i, n in enumerate(names) if n not in axes
+    ]
+    arr = np.transpose(devs, order)
+    k = 1
+    for a in axes:
+        k *= mesh.shape[a]
+    arr = arr.reshape(k, -1)
+    patterns = {
+        canonical_labels(
+            [getattr(d, "process_index", 0) for d in arr[:, j]]
+        )
+        for j in range(arr.shape[1])
+    }
+    if len(patterns) != 1:
+        return None
+    return Topology(patterns.pop())

@@ -108,11 +108,22 @@ class _Counters:
         self.meters: Dict[str, int] = {}
         self.latency: Dict[str, Histogram] = {}
 
-    def count_op(self, key: str, nbytes: int) -> None:
+    def count_op(self, key: str, nbytes: int,
+                 intra: Optional[int] = None,
+                 inter: Optional[int] = None) -> None:
         with self.lock:
-            row = self.ops.setdefault(key, {"calls": 0, "bytes": 0})
+            row = self.ops.setdefault(
+                key, {"calls": 0, "bytes": 0,
+                      "intra_bytes": 0, "inter_bytes": 0}
+            )
             row["calls"] += 1
             row["bytes"] += int(nbytes)
+            # link-class attribution (docs/topology.md): modeled per-rank
+            # wire bytes by ICI (intra_host) vs DCN (inter_host), filled
+            # by the algorithm layer; ops without a model (p2p, gather
+            # family, native HLO) default to payload-on-intra
+            row["intra_bytes"] += int(nbytes if intra is None else intra)
+            row["inter_bytes"] += int(0 if inter is None else inter)
 
     def bump(self, name: str, n: int) -> None:
         with self.lock:
@@ -162,7 +173,7 @@ class OpRecord:
     """One in-flight dispatch's telemetry view (host-side, trace-time)."""
 
     __slots__ = ("op", "comm_uid", "comm_axes", "bytes", "dtype", "algo",
-                 "counted")
+                 "counted", "intra_bytes", "inter_bytes")
 
     def __init__(self, op, comm_uid, comm_axes, nbytes, dtype, counted):
         self.op = op
@@ -172,6 +183,10 @@ class OpRecord:
         self.dtype = dtype
         self.algo = "native"
         self.counted = counted
+        # per-link-class modeled wire bytes (None until the algorithm
+        # layer annotates them; count_op defaults payload-on-intra)
+        self.intra_bytes = None
+        self.inter_bytes = None
 
     def key(self) -> str:
         return op_key(self.op, self.comm_uid, self.algo, self.dtype)
@@ -259,9 +274,12 @@ def open_op(opname: str, comm, arrays) -> Optional[OpRecord]:
 
 
 def annotate(**fields) -> None:
-    """Record trace-time facts only the op body knows — currently the
-    selected algorithm.  No-op when nothing is open (safe to call
-    unconditionally from op bodies, mirroring ``analysis.hook.annotate``)."""
+    """Record trace-time facts only the op body knows — the selected
+    algorithm, and the modeled per-link-class wire bytes
+    (``link_bytes=(intra_host, inter_host)``, see
+    ``ops/_hierarchy.annotate_selection``).  No-op when nothing is open
+    (safe to call unconditionally from op bodies, mirroring
+    ``analysis.hook.annotate``)."""
     if not _open_ops:
         return
     rec = _open_ops[-1]
@@ -269,6 +287,9 @@ def annotate(**fields) -> None:
     if algo is not None:
         rec.algo = algo
         meter(f"algo.{rec.op}.{algo}")
+    link = fields.get("link_bytes")
+    if link is not None:
+        rec.intra_bytes, rec.inter_bytes = link
 
 
 def close_op(rec: Optional[OpRecord]) -> None:
@@ -282,7 +303,8 @@ def close_op(rec: Optional[OpRecord]) -> None:
         _eager_cell._pending.append(rec)
         return
     if rec.counted:
-        _counters.count_op(rec.key(), rec.bytes)
+        _counters.count_op(rec.key(), rec.bytes,
+                           rec.intra_bytes, rec.inter_bytes)
 
 
 def abort_op(rec: Optional[OpRecord]) -> None:
@@ -298,7 +320,8 @@ def count_eager_call(cell: EagerCell, sig: tuple) -> None:
     if effective_mode() == "off":
         return
     for rec in cell.records_for(sig):
-        _counters.count_op(rec.key(), rec.bytes)
+        _counters.count_op(rec.key(), rec.bytes,
+                           rec.intra_bytes, rec.inter_bytes)
 
 
 def current_open() -> Optional[OpRecord]:
@@ -327,6 +350,8 @@ def snapshot(include_events: bool = False) -> dict:
                 "dtype": key.split("|")[3],
                 "calls": row["calls"],
                 "bytes": row["bytes"],
+                "intra_bytes": row.get("intra_bytes", 0),
+                "inter_bytes": row.get("inter_bytes", 0),
             }
             for key, row in _counters.ops.items()
         }
@@ -338,6 +363,8 @@ def snapshot(include_events: bool = False) -> dict:
                 "dtype": key.split("|")[3],
                 "calls": 0,
                 "bytes": 0,
+                "intra_bytes": 0,
+                "inter_bytes": 0,
             })["latency"] = h.to_dict()
         meters = dict(_counters.meters)
     snap = {

@@ -92,10 +92,13 @@ def _merge_counters(snaps: List[dict]) -> dict:
             dst = out.setdefault(key, {
                 **{k: row[k] for k in
                    ("op", "comm_uid", "algo", "dtype")},
-                "calls": 0, "bytes": 0, "hist": Histogram(),
+                "calls": 0, "bytes": 0, "intra_bytes": 0,
+                "inter_bytes": 0, "hist": Histogram(),
             })
             dst["calls"] += row.get("calls", 0)
             dst["bytes"] += row.get("bytes", 0)
+            dst["intra_bytes"] += row.get("intra_bytes", 0)
+            dst["inter_bytes"] += row.get("inter_bytes", 0)
             if "latency" in row:
                 dst["hist"] = dst["hist"].merge(
                     Histogram.from_dict(row["latency"])
@@ -133,7 +136,8 @@ def render(snaps: List[dict]) -> str:
 
     header = (
         f"{'op':<16} {'comm':>4} {'algo':<10} {'dtype':<9} {'calls':>7} "
-        f"{'bytes':>9} {'execs':>6} {'min us':>9} {'p50 us':>9} "
+        f"{'bytes':>9} {'intra B':>9} {'inter B':>9} {'execs':>6} "
+        f"{'min us':>9} {'p50 us':>9} "
         f"{'p99 us':>9} {'skew us':>9} {'straggler':>9}"
     )
     lines = [header, "-" * len(header)]
@@ -152,7 +156,9 @@ def render(snaps: List[dict]) -> str:
         lines.append(
             f"{row['op']:<16} {row['comm_uid']:>4} {row['algo']:<10} "
             f"{row['dtype']:<9} {row['calls']:>7} "
-            f"{_fmt_bytes(row['bytes']):>9} {h.count:>6} "
+            f"{_fmt_bytes(row['bytes']):>9} "
+            f"{_fmt_bytes(row['intra_bytes']):>9} "
+            f"{_fmt_bytes(row['inter_bytes']):>9} {h.count:>6} "
             f"{_fmt_us(h.min):>9} {_fmt_us(h.quantile(0.5)):>9} "
             f"{_fmt_us(h.quantile(0.99)):>9} "
             f"{_fmt_us(sk['max_skew']) if sk else '-':>9} "

@@ -31,7 +31,7 @@ class Flag(NamedTuple):
 
 
 ANALYZE_MODES = ("off", "warn", "error")
-COLLECTIVE_ALGOS = ("auto", "butterfly", "ring")
+COLLECTIVE_ALGOS = ("auto", "butterfly", "ring", "hier")
 TELEMETRY_MODES = ("off", "counters", "events")
 FUSION_MODES = ("off", "auto", "force")
 
@@ -53,6 +53,14 @@ DEFAULT_OVERLAP_CHUNKS = 2
 # the ring's O(size) vs O(size·log k) byte volume dominates.  Measured per
 # platform by ``benchmarks/micro.py --save`` (docs/microbenchmarks.md).
 DEFAULT_RING_CROSSOVER_BYTES = 1 << 20
+
+# default DCN ring crossover for the inter-host phase of the hierarchical
+# lowerings (ops/_hierarchy.py): 4 MiB — a DCN round-trip costs roughly an
+# order of magnitude more latency than an ICI hop, so the inter-host ring's
+# 2·(h-1) rounds need a correspondingly larger shard before they beat the
+# butterfly's 2·ceil(log2 h).  Measured per pod by
+# ``benchmarks/micro.py --hierarchy-sweep`` (docs/topology.md).
+DEFAULT_DCN_CROSSOVER_BYTES = 4 << 20
 
 FLAGS = {
     f.name: f
@@ -85,14 +93,34 @@ FLAGS = {
              "byte-identical to a build without the guards."),
         Flag("MPI4JAX_TPU_COLLECTIVE_ALGO", "choice", "auto",
              "Reduction-family algorithm (ops/_algos.py): ``auto`` picks "
-             "per call from static payload bytes and group size; "
-             "``butterfly``/``ring`` force one lowering.",
+             "per call from static payload bytes, group size, and host "
+             "topology; ``butterfly``/``ring``/``hier`` force one "
+             "lowering (``hier`` = the two-level ICI/DCN lowering of "
+             "ops/_hierarchy.py, falling back to flat where "
+             "inexpressible).",
              choices=COLLECTIVE_ALGOS),
         Flag("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "int",
              DEFAULT_RING_CROSSOVER_BYTES,
              "Payload size (bytes) at which ``auto`` switches from the "
              "log-depth butterfly to the bandwidth-optimal ring "
              "lowerings.  Default 1 MiB."),
+        Flag("MPI4JAX_TPU_TOPOLOGY", "str", "",
+             "Host-topology override for the hierarchical collective "
+             "layer (parallel/topology.py): ``<hosts>x<ranks_per_host>`` "
+             "(e.g. ``2x4``) for uniform pods, or comma-separated "
+             "per-host rank counts (e.g. ``3,5``) for heterogeneous "
+             "clusters.  Empty (default) derives the topology from the "
+             "JAX process layout of the bound mesh.  A spec whose total "
+             "rank count does not match a communicator's world falls "
+             "back to the flat (single-level) algorithms for that comm "
+             "(docs/topology.md)."),
+        Flag("MPI4JAX_TPU_DCN_CROSSOVER_BYTES", "int",
+             DEFAULT_DCN_CROSSOVER_BYTES,
+             "Shard size (bytes) at which the hierarchical lowerings' "
+             "inter-host (DCN) phase switches from the log-depth "
+             "butterfly to the bandwidth-optimal ring.  Default 4 MiB "
+             "(DCN rounds cost ~10x an ICI hop, so the ring needs a "
+             "larger payload to win than on ICI)."),
         Flag("MPI4JAX_TPU_ANALYZE", "choice", "off",
              "Trace-time collective verifier (analysis/): ``warn`` runs "
              "the MPX checkers over every spmd region / eager op as it "
@@ -325,6 +353,55 @@ def ring_crossover_bytes() -> int:
             "must be >= 0"
         )
     return val
+
+
+def dcn_crossover_bytes() -> int:
+    """Shard bytes at which the hierarchical lowerings' inter-host (DCN)
+    phase prefers the ring (``MPI4JAX_TPU_DCN_CROSSOVER_BYTES``; default
+    4 MiB — see docs/topology.md)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_DCN_CROSSOVER_BYTES", DEFAULT_DCN_CROSSOVER_BYTES
+    )
+
+
+def topology_spec() -> str:
+    """Raw ``MPI4JAX_TPU_TOPOLOGY`` string ('' = derive from the mesh's
+    JAX process layout).  Parsed by :func:`parse_topology_spec`."""
+    return (_getenv("MPI4JAX_TPU_TOPOLOGY") or "").strip()
+
+
+def parse_topology_spec(raw: str) -> Optional[Tuple[int, ...]]:
+    """Parse a topology spec into per-host rank counts.
+
+    Grammar (docs/topology.md): ``<hosts>x<ranks_per_host>`` for uniform
+    pods (``2x4`` -> ``(4, 4)``), or comma-separated per-host counts for
+    heterogeneous clusters (``3,5`` -> ``(3, 5)``).  Empty/None ->
+    ``None`` (no override).  Raises ``ValueError`` on malformed specs —
+    a typo'd override must not silently disable the hierarchical layer.
+    """
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if not raw:
+        return None
+    try:
+        if "x" in raw:
+            hosts_s, _, per_s = raw.partition("x")
+            hosts, per = int(hosts_s), int(per_s)
+            if hosts < 1 or per < 1:
+                raise ValueError
+            return (per,) * hosts
+        counts = tuple(int(c) for c in raw.split(","))
+        if not counts or any(c < 1 for c in counts):
+            raise ValueError
+        return counts
+    except ValueError:
+        raise ValueError(
+            f"Environment variable MPI4JAX_TPU_TOPOLOGY={raw!r} could not "
+            "be parsed: expected '<hosts>x<ranks_per_host>' (e.g. '2x4') "
+            "or comma-separated per-host rank counts (e.g. '3,5'), all "
+            "positive integers"
+        ) from None
 
 
 def analyze_mode() -> str:

@@ -323,6 +323,63 @@ def test_mpx109_forced_algo_is_deterministic_hence_clean(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# MPX113 — flat algorithm on a multi-host comm (docs/topology.md)
+# ---------------------------------------------------------------------------
+
+
+def test_mpx113_flat_on_multihost_advisory(monkeypatch):
+    _, size = world()
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "1024")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    x = ranks_arange((1024,))  # 4096 B/rank, above the crossover
+    report = mpx.analyze(_prod_reduce, x)
+    assert codes(report) == ["MPX113"], report.render()
+    (f,) = report.findings
+    assert f.severity == "advisory"
+    assert "2 hosts" in f.message and "'ring'" in f.message
+    assert "hier" in f.suggestion
+
+    mpx.set_analyze_mode("error")
+    with pytest.raises(mpx.AnalysisError, match="MPX113"):
+        mpx.run(_prod_reduce, x)
+
+
+def test_mpx113_negative_auto_picks_hier(monkeypatch):
+    # same topology and payload, but auto: the two-level lowering runs
+    # and there is nothing to advise about
+    _, size = world()
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "1024")
+    report = mpx.analyze(_prod_reduce, ranks_arange((1024,)))
+    assert report.ok, report.render()
+    (evt,) = report.events
+    assert evt.algo == "hier" and evt.hosts == 2
+
+
+def test_mpx113_negative_single_host_and_small_payload(monkeypatch):
+    _, size = world()
+    # no topology: a forced ring is as good as it gets — clean
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "1024")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    report = mpx.analyze(_prod_reduce, ranks_arange((1024,)))
+    assert report.ok, report.render()
+    # multi-host but below the crossover: the flat butterfly is right
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "butterfly")
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", str(1 << 24))
+    report = mpx.analyze(_prod_reduce, ranks_arange((1024,)))
+    assert report.ok, report.render()
+    # non-uniform host partition: flat is the ONLY option — clean
+    monkeypatch.setenv(
+        "MPI4JAX_TPU_TOPOLOGY", f"{size - 3},3" if size > 3 else "1,1")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "1024")
+    report = mpx.analyze(_prod_reduce, ranks_arange((1024,)))
+    assert report.ok, report.render()
+
+
+# ---------------------------------------------------------------------------
 # the event stream (graph extraction)
 # ---------------------------------------------------------------------------
 

@@ -279,6 +279,56 @@ def test_mpx109_negative_cases():
 
 
 # ---------------------------------------------------------------------------
+# MPX113 — flat algorithm on a multi-host comm
+# ---------------------------------------------------------------------------
+
+
+def _hier_graph(payload=1 << 22, algo="ring", hosts=2, k=8,
+                op="allreduce", crossover=1 << 20, mode="ring"):
+    return G(
+        events=[E(0, op, comm_uid=1, comm_size=k, payload_bytes=payload,
+                  algo=algo, hosts=hosts)],
+        meta={"collective_algo": mode, "ring_crossover_bytes": crossover},
+    )
+
+
+def test_mpx113_flat_over_dcn_fires():
+    (f,) = checkers.run_checkers(_hier_graph())
+    assert f.code == "MPX113"
+    assert f.severity == "advisory"
+    assert "2 hosts" in f.message and "'ring'" in f.message
+    assert "DCN" in f.message
+    assert "MPI4JAX_TPU_COLLECTIVE_ALGO=hier" in f.suggestion
+    # a forced butterfly on a multi-host comm fires too, and the payload
+    # + topology that triggered it are in the message
+    (f2,) = checkers.run_checkers(_hier_graph(algo="butterfly",
+                                              payload=1 << 21))
+    assert f2.code == "MPX113" and f"{1 << 21} B" in f2.message
+    # reduce_scatter and bcast are in the algorithm family
+    (f3,) = checkers.run_checkers(_hier_graph(op="reduce_scatter"))
+    assert f3.code == "MPX113"
+
+
+def test_mpx113_negative_cases():
+    # the hierarchical lowering actually ran: nothing to advise
+    assert codes_of(_hier_graph(algo="hier", mode="hier")) == []
+    # single host (or no derivable topology -> hosts is None): flat is right
+    assert codes_of(_hier_graph(hosts=1)) == []
+    assert codes_of(_hier_graph(hosts=None)) == []
+    # below the ring crossover the flat butterfly IS the right choice
+    # (MPX109 may still advise about crossover proximity — not this rule)
+    assert "MPX113" not in codes_of(_hier_graph(payload=(1 << 20) - 1,
+                                                algo="butterfly",
+                                                mode="auto"))
+    # one rank per host: hier degenerates to flat, nothing to gain
+    assert codes_of(_hier_graph(hosts=8, k=8)) == []
+    # native HLO is XLA-scheduled; not ours to advise on
+    assert codes_of(_hier_graph(algo="native")) == []
+    # non-algorithm ops never fire
+    assert codes_of(_hier_graph(op="scan")) == []
+
+
+# ---------------------------------------------------------------------------
 # MPX111 — adjacent fusable collectives not fused
 # ---------------------------------------------------------------------------
 

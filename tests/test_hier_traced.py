@@ -1,0 +1,439 @@
+"""Hierarchical collectives: the traced integration half (docs/topology.md).
+
+The pure lockstep proofs live in tests/test_hierarchy.py; here the same
+two-level lowerings run for real on the 8-device CPU mesh under a faked
+multi-host topology (``MPI4JAX_TPU_TOPOLOGY`` — the same knob the CI
+topology lane uses):
+
+- forced two-level vs forced flat equality for the reduction family
+  (enum ops, a non-commutative callable, bcast across roots,
+  reduce_scatter, a color split spanning hosts);
+- ``auto`` selection (hier above the ring crossover on multi-host,
+  flat otherwise), non-uniform fallback, and the HLO pins: single-host /
+  below-crossover programs are byte-identical with and without topology
+  support, and the forced two-level program moves chunk-sized payloads
+  only;
+- toggle-retrace for both program caches (topology + DCN crossover in
+  the cache keys);
+- composition: fused buckets ride the hierarchy, start/wait pairs split
+  the two levels across the gap;
+- telemetry's per-link-class byte counters match the pinned models.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from helpers import per_rank, ranks_arange, world
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology_env(monkeypatch):
+    for flag in ("MPI4JAX_TPU_TOPOLOGY", "MPI4JAX_TPU_DCN_CROSSOVER_BYTES",
+                 "MPI4JAX_TPU_COLLECTIVE_ALGO",
+                 "MPI4JAX_TPU_RING_CROSSOVER_BYTES"):
+        monkeypatch.delenv(flag, raising=False)
+    yield
+
+
+def _two_hosts(monkeypatch):
+    _, size = world()
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    return 2, size // 2
+
+
+def _forced(monkeypatch, algo):
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", algo)
+
+
+# ---------------------------------------------------------------------------
+# equivalence: two-level == flat on the same data
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("op,npred", [
+    (mpx.SUM, np.add.reduce),
+    (mpx.PROD, np.multiply.reduce),
+    (mpx.MIN, np.minimum.reduce),
+    (mpx.MAX, np.maximum.reduce),
+    (mpx.BXOR, np.bitwise_xor.reduce),
+    (mpx.LAND, np.logical_and.reduce),
+])
+def test_hier_allreduce_matches_flat(op, npred, monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    if op in (mpx.BXOR,):
+        vals = np.arange(size * 5, dtype=np.int32).reshape(size, 5)
+    elif op is mpx.LAND:
+        vals = (np.arange(size * 5).reshape(size, 5) % 3 != 0)
+    elif op is mpx.PROD:
+        vals = 1.0 + np.arange(size * 5, dtype=np.float64).reshape(
+            size, 5) % 3  # small integer factors: exact in f64
+    else:
+        vals = np.arange(size * 5, dtype=np.float64).reshape(size, 5)
+    x = jnp.asarray(vals)
+    outs = {}
+    for algo in ("butterfly", "hier"):
+        _forced(monkeypatch, algo)
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.allreduce(xl, op=op)
+            return res
+
+        outs[algo] = np.asarray(f(x))
+    # exact data: the two-level fold must agree with the flat fold
+    # bit for bit, and both with numpy's ascending reduction
+    assert np.array_equal(outs["hier"], outs["butterfly"])
+    expected = npred(vals, axis=0)
+    assert np.array_equal(outs["hier"][0], expected)
+
+
+def test_hier_allreduce_callable_right_projection(monkeypatch):
+    """Right-projection is associative, non-commutative, elementwise: the
+    ascending group-rank fold must yield the LAST rank's value through
+    the two-level (forced) path too."""
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced(monkeypatch, "hier")
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=lambda a, b: b)
+        return res
+
+    out = np.asarray(f(ranks_arange((5,))))
+    assert np.allclose(out, size - 1), out
+
+
+def test_hier_bcast_matches_flat_all_roots(monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    x = per_rank(lambda r: np.arange(6, dtype=np.float32) + 10 * r)
+    for root in range(size):
+        outs = {}
+        for algo in ("butterfly", "hier"):
+            _forced(monkeypatch, algo)
+
+            @mpx.spmd
+            def f(xl):
+                res, _ = mpx.bcast(xl, root)
+                return res
+
+            outs[algo] = np.asarray(f(x))
+        assert np.array_equal(outs["hier"], outs["butterfly"]), root
+        expected = np.arange(6, dtype=np.float32) + 10 * root
+        for r in range(size):
+            assert np.array_equal(outs["hier"][r], expected), (root, r)
+
+
+def test_hier_reduce_scatter_matches_flat(monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    x = per_rank(
+        lambda r: np.arange(size * 3, dtype=np.float64).reshape(size, 3) + r
+    )
+    outs = {}
+    for algo in ("butterfly", "hier"):
+        _forced(monkeypatch, algo)
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.reduce_scatter(xl, op=mpx.SUM)
+            return res
+
+        outs[algo] = np.asarray(f(x))
+    assert np.array_equal(outs["hier"], outs["butterfly"])
+    base = np.arange(size * 3, dtype=np.float64).reshape(size, 3)
+    for r in range(size):
+        expected = base[r] * size + sum(range(size))
+        assert np.array_equal(outs["hier"][r], expected), r
+
+
+def test_hier_on_color_split_spanning_hosts(monkeypatch):
+    comm, size = world()
+    _two_hosts(monkeypatch)
+    r = size // 2
+    # two groups, each spanning both hosts with contiguous blocks
+    colors = [0] * (r // 2) + [1] * (r - r // 2)
+    colors = colors + colors  # e.g. 8 ranks, 2x4: (0,0,1,1, 0,0,1,1)
+    split = comm.Split(colors)
+    vals = np.arange(size * 4, dtype=np.float64).reshape(size, 4)
+    x = jnp.asarray(vals)
+    outs = {}
+    for algo in ("butterfly", "hier"):
+        _forced(monkeypatch, algo)
+
+        @mpx.spmd(comm=comm)
+        def f(xl):
+            res, _ = mpx.allreduce(xl, op=mpx.SUM, comm=split)
+            return res
+
+        outs[algo] = np.asarray(f(x))
+    assert np.array_equal(outs["hier"], outs["butterfly"])
+    for g in split.groups:
+        expected = vals[list(g)].sum(axis=0)
+        for m in g:
+            assert np.array_equal(outs["hier"][m], expected), (g, m)
+
+
+def test_nonuniform_topology_falls_back_to_flat(monkeypatch):
+    """A 3/5 host split: the hierarchy is inexpressible, a forced hier
+    falls back to the auto rules — never an error, same results."""
+    _, size = world()
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"{size - 3},3")
+    x = ranks_arange((4,))
+    _forced(monkeypatch, "hier")
+
+    @mpx.spmd
+    def f(xl):
+        res, _ = mpx.allreduce(xl, op=mpx.PROD)
+        return res
+
+    out = np.asarray(f(x))
+    assert np.allclose(out, 0.0)  # rank 0 contributes 0 to the product
+    report = mpx.analyze(f, x)
+    (evt,) = report.events
+    assert evt.algo in ("butterfly", "ring")  # flat fallback
+    assert evt.hosts is None  # no plan -> nothing for MPX113 to advise
+
+
+# ---------------------------------------------------------------------------
+# selection + HLO pins
+# ---------------------------------------------------------------------------
+
+
+def _prod(x):
+    res, _ = mpx.allreduce(x, op=mpx.PROD)
+    return res
+
+
+def test_auto_picks_hier_above_crossover_only(monkeypatch):
+    _two_hosts(monkeypatch)
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "1024")
+    report = mpx.analyze(_prod, ranks_arange((1024,)))  # 4 KiB payload
+    (evt,) = report.events
+    assert evt.algo == "hier" and evt.hosts == 2
+    report = mpx.analyze(_prod, ranks_arange((8,)))  # 32 B payload
+    (evt,) = report.events
+    assert evt.algo == "butterfly"
+
+
+def _lowered_prod(x):
+    @mpx.spmd
+    def f(xl):
+        res, _ = mpx.allreduce(xl, op=mpx.PROD)
+        return res
+
+    return jax.jit(f).lower(x).as_text()
+
+
+def test_hlo_byte_identical_single_host_and_below_crossover(monkeypatch):
+    """The zero-cost contract: with no topology, an explicit single-host
+    topology, or a multi-host topology at a below-crossover payload,
+    the lowered program is byte-identical — topology support changes
+    nothing until the hierarchy actually engages."""
+    _, size = world()
+    x = jnp.ones((size, 64), jnp.float32)  # 256 B: far below crossover
+    base = _lowered_prod(x)
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"1x{size}")
+    assert _lowered_prod(x) == base
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    assert _lowered_prod(x) == base
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"{size - 3},3")
+    assert _lowered_prod(x) == base
+
+
+def test_hier_hlo_moves_chunks_only(monkeypatch):
+    """The byte-volume pin for the two-level program: every
+    CollectivePermute round (intra reduce-scatter, inter exchange, intra
+    allgather) carries an intra-chunk-sized payload — the full payload
+    never rides a permute round."""
+    _, size = world()
+    h, r = _two_hosts(monkeypatch)
+    _forced(monkeypatch, "hier")
+    nelem = 64 * r  # intra chunk = 64 elements
+    x = jnp.ones((size, nelem), jnp.float32)
+    lines = [ln for ln in _lowered_prod(x).splitlines()
+             if "collective_permute" in ln]
+    # (r-1) intra reduce-scatter + >=1 inter + (r-1) intra allgather
+    assert len(lines) >= 2 * (r - 1) + 1, len(lines)
+    assert any(f"tensor<{nelem // r}xf32>" in ln for ln in lines)
+    for ln in lines:
+        assert f"tensor<{nelem}xf32>" not in ln, ln
+
+
+# ---------------------------------------------------------------------------
+# toggle-retrace: topology knobs are in both program-cache keys
+# ---------------------------------------------------------------------------
+
+
+def test_topology_toggle_retraces_eager_program(monkeypatch):
+    _, size = world()
+    mpx.clear_caches()
+    x = ranks_arange((4,))
+    mpx.allreduce(x, op=mpx.PROD)
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    mpx.allreduce(x, op=mpx.PROD)          # new topology: must retrace
+    monkeypatch.setenv("MPI4JAX_TPU_DCN_CROSSOVER_BYTES", "123")
+    mpx.allreduce(x, op=mpx.PROD)          # new DCN crossover: retrace
+    monkeypatch.delenv("MPI4JAX_TPU_TOPOLOGY")
+    monkeypatch.delenv("MPI4JAX_TPU_DCN_CROSSOVER_BYTES")
+    mpx.allreduce(x, op=mpx.PROD)          # back to the first program
+    s = mpx.cache_stats()
+    assert s["misses"] == 3 and s["hits"] == 1
+    mpx.clear_caches()
+
+
+def test_topology_toggle_retraces_spmd_program(monkeypatch):
+    _, size = world()
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode("counters")
+    try:
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.allreduce(xl, op=mpx.PROD)
+            return res
+
+        x = ranks_arange((4,))
+        f(x)
+        f(x)                                        # hit
+        monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+        f(x)                                        # miss: retrace
+        meters = mpx.telemetry.snapshot()["meters"]
+        assert meters.get("spmd_cache.misses") == 2
+        assert meters.get("spmd_cache.hits") == 1
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# composition: fusion buckets and start/wait pairs ride the hierarchy
+# ---------------------------------------------------------------------------
+
+
+def test_fused_bucket_rides_hierarchy(monkeypatch):
+    """Fusion + topology: the fused flat-buffer bucket flushes through
+    the ordinary dispatch point, so N member allreduces become ONE
+    two-level exchange (the algo meter counts a single hier
+    selection)."""
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced(monkeypatch, "hier")
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode("counters")
+    mpx.set_fusion_mode("auto")
+    try:
+
+        @mpx.spmd
+        def f(a, b):
+            ra = mpx.allreduce(a, op=mpx.SUM)[0]
+            rb = mpx.allreduce(b, op=mpx.SUM)[0]
+            return mpx.varying(ra * 1.0), mpx.varying(rb * 1.0)
+
+        a = jnp.full((size, 8), 2.0, jnp.float32)
+        b = jnp.full((size, 4), 3.0, jnp.float32)
+        oa, ob = f(a, b)
+        assert np.allclose(np.asarray(oa), 2.0 * size)
+        assert np.allclose(np.asarray(ob), 3.0 * size)
+        meters = mpx.telemetry.snapshot()["meters"]
+        buckets = sum(v for k, v in meters.items()
+                      if k.startswith("fusion.") and k.endswith(".buckets"))
+        assert buckets == 1
+        assert meters.get("algo.allreduce.hier") == 1  # one exchange
+    finally:
+        mpx.set_fusion_mode(None)
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+
+def test_start_wait_pair_splits_the_two_levels(monkeypatch):
+    """allreduce_start runs intra reduce-scatter + the DCN exchange and
+    allreduce_wait the intra allgather; reduce_scatter_start runs the
+    whole two-level exchange with a reassembly-only wait.  Results must
+    match the monolithic flat collective (odd payload exercises chunk
+    padding)."""
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced(monkeypatch, "hier")
+    vals = 1.0 + (np.arange(size * 513).reshape(size, 513) % 3)
+    x = jnp.asarray(vals, jnp.float32)
+
+    @mpx.spmd
+    def split_ar(g):
+        h, tok = mpx.allreduce_start(g, op=mpx.SUM)
+        s, _ = mpx.allreduce_wait(h, token=tok)
+        return mpx.varying(s)
+
+    out = np.asarray(split_ar(x))
+    expected = vals.sum(axis=0)
+    assert np.allclose(out, expected)
+
+    rs_vals = np.arange(size * size * 2, dtype=np.float32).reshape(
+        size, size, 2)
+    xr = jnp.asarray(rs_vals)
+
+    @mpx.spmd
+    def split_rs(g):
+        h, tok = mpx.reduce_scatter_start(g, op=mpx.SUM)
+        s, _ = mpx.reduce_scatter_wait(h, token=tok)
+        return mpx.varying(s)
+
+    out_rs = np.asarray(split_rs(xr))
+    for r in range(size):
+        assert np.allclose(out_rs[r], rs_vals[:, r].sum(axis=0)), r
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the per-link-class byte counters match the pinned models
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_link_classes_match_models(monkeypatch):
+    from mpi4jax_tpu.ops._algos import algorithm_bytes_per_rank
+    from mpi4jax_tpu.ops._hierarchy import hier_link_bytes
+
+    _, size = world()
+    h, r = _two_hosts(monkeypatch)
+    nelem = 256
+    nbytes = nelem * 4
+    x = jnp.ones((size, nelem), jnp.float32)
+
+    def run(algo):
+        _forced(monkeypatch, algo)
+        mpx.telemetry.reset()
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.allreduce(xl, op=mpx.PROD)
+            return res
+
+        f(x)
+        rows = {row["algo"]: row
+                for row in mpx.telemetry.snapshot()["ops"].values()}
+        return rows[algo]
+
+    mpx.set_telemetry_mode("counters")
+    try:
+        row = run("hier")
+        assert (row["intra_bytes"], row["inter_bytes"]) == \
+            hier_link_bytes("allreduce", nbytes, h, r)
+        # a flat algorithm on the same multi-host comm: every round gates
+        # on DCN, so the whole volume lands on the inter class
+        row = run("ring")
+        assert row["intra_bytes"] == 0
+        assert row["inter_bytes"] == \
+            algorithm_bytes_per_rank("ring", nbytes, size)
+        # single host: everything back on intra
+        monkeypatch.delenv("MPI4JAX_TPU_TOPOLOGY")
+        row = run("ring")
+        assert row["inter_bytes"] == 0
+        assert row["intra_bytes"] == \
+            algorithm_bytes_per_rank("ring", nbytes, size)
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()

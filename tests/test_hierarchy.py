@@ -1,0 +1,673 @@
+"""Hierarchical topology-aware collectives (ops/_hierarchy.py): the
+synthetic-topology lockstep suite.
+
+Extends the PR-2 lockstep simulator (tests/test_algos.py) to two-level
+topologies: the hierarchical lowerings keep ALL of their static
+structure — the host-partition geometry (``host_blocks``/``hier_split``),
+the per-phase chunk/pair formulas (shared with ``_algos``), and the
+per-link-class byte models — in plain functions polymorphic over Python
+values, so this file drives the SAME functions through pure-Python
+lockstep simulations:
+
+- symbolic string folds pin that the two-level fold (intra-host
+  ascending, then hosts ascending) is EXACTLY the flat ascending
+  group-rank fold — associativity alone, never commutativity;
+- exact-arithmetic numpy folds pin hierarchical == flat **bit-for-bit**
+  for all 10 ``Op``s across the 2x4 / 4x2 / 8x1 (and 2x2) topologies;
+- the non-uniform ``3,5`` split and the 1x8 single-host case pin the
+  flat fallback (plan is ``None``, never an error);
+- explicit per-round message counting pins the per-rank, per-link-class
+  byte volumes (intra ≈ ``2·(r-1)/r·size`` over ICI, inter ≈
+  ``2·(h-1)/h·size/r`` over DCN) — the bandwidth claim is a test.
+
+Loaded under a private package name (``_load_isolated``, mirroring
+tests/test_algos.py) so everything here runs even where the installed
+JAX is below the package's hard floor; the traced integration half lives
+in tests/test_hier_traced.py.
+"""
+
+import importlib
+import os
+import pathlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import test_algos as ta  # the PR-2 lockstep simulator (same directory)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_hier_iso"
+
+
+def _load_isolated():
+    """Load utils/config, ops/_algos, ops/_hierarchy, parallel/topology,
+    and parallel/comm under a private package name, bypassing
+    ``mpi4jax_tpu/__init__.py`` (whose JAX-floor check refuses to import
+    on old JAX) while preserving package context for relative imports."""
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "ops", "parallel"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "ops._algos", "ops._hierarchy",
+                "parallel.topology", "parallel.comm"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+al = ISO.ops._algos
+hi = ISO.ops._hierarchy
+config = ISO.utils.config
+topo_mod = ISO.parallel.topology
+comm_mod = ISO.parallel.comm
+
+# the synthetic topology matrix of ISSUE 6: (hosts, ranks_per_host) over
+# 8 ranks, plus a small 2x2; 1x8 is the single-host fallback case
+TOPOLOGIES = [(2, 4), (4, 2), (8, 1), (2, 2)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in ("MPI4JAX_TPU_COLLECTIVE_ALGO",
+                  "MPI4JAX_TPU_RING_CROSSOVER_BYTES",
+                  "MPI4JAX_TPU_DCN_CROSSOVER_BYTES",
+                  "MPI4JAX_TPU_TOPOLOGY")
+    }
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def hosts_of(h, r):
+    return tuple(b for b in range(h) for _ in range(r))
+
+
+# ---------------------------------------------------------------------------
+# spec parsing + the topology model
+# ---------------------------------------------------------------------------
+
+
+def test_parse_topology_spec():
+    assert config.parse_topology_spec("") is None
+    assert config.parse_topology_spec(None) is None
+    assert config.parse_topology_spec("2x4") == (4, 4)
+    assert config.parse_topology_spec("8x1") == (1,) * 8
+    assert config.parse_topology_spec(" 4X2 ") == (2, 2, 2, 2)
+    assert config.parse_topology_spec("3,5") == (3, 5)
+    assert config.parse_topology_spec("1,2,5") == (1, 2, 5)
+    for bad in ("2x", "x4", "0x4", "2x-1", "3,0", "a,b", "2x4x2", "nope"):
+        with pytest.raises(ValueError, match="MPI4JAX_TPU_TOPOLOGY"):
+            config.parse_topology_spec(bad)
+
+
+def test_canonical_labels_and_topology():
+    assert topo_mod.canonical_labels((7, 7, 3, 7)) == (0, 0, 1, 0)
+    t = topo_mod.from_counts((3, 5))
+    assert t.num_hosts == 2
+    assert t.ranks_per_host == (3, 5)
+    assert t.host_of_rank == (0, 0, 0, 1, 1, 1, 1, 1)
+    # canonical: physical ids never matter
+    assert topo_mod.Topology((9, 9, 2, 2)) == topo_mod.Topology((0, 0, 1, 1))
+    assert hash(topo_mod.Topology((9, 9))) == hash(topo_mod.Topology((4, 4)))
+    assert t.fingerprint() == t.host_of_rank
+
+
+class SizedComm(comm_mod.Comm):
+    """An unbound comm with a known world size — enough for the spec-
+    driven topology derivation and plan construction."""
+
+    def __init__(self, axes, world):
+        super().__init__(axes)
+        self._world = world
+
+    def world_size(self):
+        return self._world
+
+
+def test_derive_world_topology_from_spec():
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "2x4"
+    t = topo_mod.derive_world_topology(SizedComm("i", 8))
+    assert t is not None and t.num_hosts == 2
+    assert t.ranks_per_host == (4, 4)
+    # a spec that does not cover this comm's world: flat fallback
+    assert topo_mod.derive_world_topology(SizedComm("i", 4)) is None
+    # no spec, no mesh: underivable
+    del os.environ["MPI4JAX_TPU_TOPOLOGY"]
+    assert topo_mod.derive_world_topology(SizedComm("i", 8)) is None
+
+
+def test_derive_world_topology_nonuniform_spec():
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "3,5"
+    t = topo_mod.derive_world_topology(SizedComm("i", 8))
+    assert t is not None and t.ranks_per_host == (3, 5)
+
+
+# ---------------------------------------------------------------------------
+# plan geometry
+# ---------------------------------------------------------------------------
+
+
+def test_host_blocks_contiguous():
+    assert hi.host_blocks((0, 1, 2, 3), (0, 0, 1, 1)) == [[0, 1], [2, 3]]
+    assert hi.host_blocks((4, 5, 6, 7), hosts_of(2, 4)) == [[4, 5, 6, 7]]
+    # round-robin placement: host 0 reappears -> no hierarchy
+    assert hi.host_blocks((0, 1, 2, 3), (0, 1, 0, 1)) is None
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_split_uniform(h, r):
+    k = h * r
+    split = hi.hier_split((tuple(range(k)),), hosts_of(h, r))
+    assert split is not None
+    intra, inter, hh, rr = split
+    assert (hh, rr) == (h, r)
+    assert intra == tuple(tuple(range(b * r, (b + 1) * r)) for b in range(h))
+    assert inter == tuple(tuple(b * r + j for b in range(h))
+                          for j in range(r))
+    # both levels partition the whole world
+    assert sorted(m for g in intra for m in g) == list(range(k))
+    assert sorted(m for g in inter for m in g) == list(range(k))
+
+
+def test_hier_split_fallbacks():
+    # single host (1x8): nothing to hierarchize
+    assert hi.hier_split((tuple(range(8)),), hosts_of(1, 8)) is None
+    # non-uniform 3/5 split: per-host sizes differ
+    assert hi.hier_split((tuple(range(8)),), (0, 0, 0, 1, 1, 1, 1, 1)) is None
+    # non-contiguous (round-robin) placement
+    assert hi.hier_split((tuple(range(4)),), (0, 1, 0, 1)) is None
+    # per-group hierarchies differ: inexpressible in one SPMD program
+    assert hi.hier_split(((0, 1, 2, 3), (4, 5, 6, 7)),
+                         (0, 0, 1, 1, 2, 2, 2, 2)) is None
+
+
+def test_hier_split_color_groups():
+    # a color split whose groups each span both hosts
+    hosts = hosts_of(2, 4)
+    split = hi.hier_split(((0, 1, 4, 5), (2, 3, 6, 7)), hosts)
+    assert split is not None
+    intra, inter, h, r = split
+    assert (h, r) == (2, 2)
+    assert intra == ((0, 1), (4, 5), (2, 3), (6, 7))
+    assert inter == ((0, 4), (1, 5), (2, 6), (3, 7))
+    # groups that sit entirely within one host: no hierarchy
+    assert hi.hier_split(((0, 1, 2, 3), (4, 5, 6, 7)), hosts) is None
+
+
+def test_hier_plan_from_spec_and_memo():
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "2x4"
+    comm = SizedComm("i", 8)
+    plan = hi.hier_plan(comm)
+    assert plan is not None and (plan.h, plan.r) == (2, 4)
+    assert plan.intra.groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+    assert plan.inter.groups == ((0, 4), (1, 5), (2, 6), (3, 7))
+    assert hi.hier_plan(comm) is plan  # memoized
+    # non-uniform topology: no plan, never an error
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "3,5"
+    assert hi.hier_plan(SizedComm("i", 8)) is None
+    # single host
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "1x8"
+    assert hi.hier_plan(SizedComm("i", 8)) is None
+
+
+def test_hier_plan_on_color_split():
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "2x4"
+    parent = SizedComm("i", 8)
+    gc = comm_mod.GroupComm(parent, ((0, 1, 4, 5), (2, 3, 6, 7)))
+    gc.world_size = lambda: 8
+    plan = hi.hier_plan(gc)
+    assert plan is not None and (plan.h, plan.r) == (2, 2)
+    assert plan.intra.groups == ((0, 1), (4, 5), (2, 3), (6, 7))
+    # groups within one host each: flat fallback
+    gc2 = comm_mod.GroupComm(parent, ((0, 1, 2, 3), (4, 5, 6, 7)))
+    gc2.world_size = lambda: 8
+    assert hi.hier_plan(gc2) is None
+
+
+def test_comm_hosts():
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "2x4"
+    assert hi.comm_hosts(SizedComm("i", 8)) == 2
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "3,5"
+    assert hi.comm_hosts(SizedComm("i", 8)) == 2  # non-uniform still spans 2
+    del os.environ["MPI4JAX_TPU_TOPOLOGY"]
+    assert hi.comm_hosts(SizedComm("i", 8)) is None
+
+
+def test_uniform_size_accessor():
+    """Satellite: the explicit ``uniform_size`` accessor — ``None`` for
+    unequal splits, the size otherwise, and ``static_group_size``
+    delegates to it (behavior identical to the old RuntimeError dance)."""
+    parent = SizedComm("i", 8)
+    equal = comm_mod.GroupComm(parent, ((0, 1, 2), (3, 4, 5)))
+    unequal = comm_mod.GroupComm(parent, ((0, 1, 2), (3, 4)))
+    assert equal.uniform_size() == 3
+    assert unequal.uniform_size() is None
+    assert al.static_group_size(equal) == 3
+    assert al.static_group_size(unequal) is None
+    # Get_size keeps its loud error for the gather family
+    with pytest.raises(RuntimeError, match="unequal group sizes"):
+        unequal.Get_size()
+    assert equal.Get_size() == 3
+    # a whole-axes comm outside any trace still maps to None
+    assert al.static_group_size(comm_mod.Comm("i")) is None
+
+
+# ---------------------------------------------------------------------------
+# lockstep simulation: hierarchical == flat, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def sim_hier_allreduce(xs, fn, h, r, preserve):
+    """Pure-Python lockstep of ``apply_hier_allreduce``: ``xs[g][c]`` is
+    rank ``g``'s chunk ``c`` (``r`` chunks per rank, hosts contiguous);
+    returns ``out[g][c]``.  Phase 1/3 drive the SAME ring machinery as
+    the flat simulator (tests/test_algos.py); phase 2 folds the per-host
+    partials in ascending host order (the order both inter algorithms
+    deliver — the butterfly by construction, the ring via the
+    order-preserving pair, pinned in test_algos)."""
+    k = h * r
+    partial = [None] * k
+    for b in range(h):
+        members = list(range(b * r, (b + 1) * r))
+        if r == 1:
+            partial[members[0]] = xs[members[0]][0]
+        else:
+            blocks = [[xs[m][c] for c in range(r)] for m in members]
+            out = ta.sim_ring_reduce_scatter(blocks, fn, r, preserve)
+            for j, m in enumerate(members):
+                partial[m] = out[j]
+    reduced = []
+    for j in range(r):
+        acc = partial[j]
+        for b in range(1, h):
+            acc = fn(acc, partial[b * r + j])
+        reduced.append(acc)
+    # intra allgather: every rank of every host reassembles all r chunks
+    return [list(reduced) for _ in range(k)]
+
+
+def flat_fold(xs, fn, k, r):
+    """The flat reference: chunk ``c``'s ascending group-rank fold."""
+    out = []
+    for c in range(r):
+        acc = xs[0][c]
+        for g in range(1, k):
+            acc = fn(acc, xs[g][c])
+        out.append(acc)
+    return out
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_allreduce_preserves_ascending_fold_order(h, r):
+    # string concatenation: associative, non-commutative, fully
+    # observable — the two-level fold must produce the IDENTICAL operand
+    # sequence as the flat ascending fold, or the string differs
+    k = h * r
+    xs = [[f"({g}:{c})" for c in range(r)] for g in range(k)]
+    fn = lambda a, b: a + b  # noqa: E731
+    out = sim_hier_allreduce(xs, fn, h, r, preserve=True)
+    expected = flat_fold(xs, fn, k, r)
+    for g in range(k):
+        assert out[g] == expected, (h, r, g)
+        for c in range(r):
+            assert out[g][c] == "".join(f"({j}:{c})" for j in range(k))
+
+
+@pytest.mark.parametrize("opname,npfn", [
+    ("SUM", np.add), ("PROD", np.multiply), ("MIN", np.minimum),
+    ("MAX", np.maximum), ("LAND", np.logical_and), ("LOR", np.logical_or),
+    ("LXOR", np.logical_xor), ("BAND", np.bitwise_and),
+    ("BOR", np.bitwise_or), ("BXOR", np.bitwise_xor),
+])
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_allreduce_all_ops_bit_for_bit(opname, npfn, h, r):
+    # exact-arithmetic data (small integers, bools, bitmasks): every fold
+    # association is exact, so hierarchical == flat must hold BIT FOR BIT
+    import zlib
+
+    k = h * r
+    rng = np.random.default_rng(zlib.crc32(f"hier/{opname}/{h}x{r}".encode()))
+    if opname in ("LAND", "LOR", "LXOR"):
+        data = rng.integers(0, 2, size=(k, r, 3)).astype(bool)
+    elif opname in ("BAND", "BOR", "BXOR"):
+        data = rng.integers(0, 255, size=(k, r, 3)).astype(np.int32)
+    elif opname == "PROD":
+        # k <= 8 factors of 1..3 stay exact in float64
+        data = rng.integers(1, 4, size=(k, r, 3)).astype(np.float64)
+    else:
+        data = rng.integers(-100, 100, size=(k, r, 3)).astype(np.float64)
+    xs = [[data[g, c] for c in range(r)] for g in range(k)]
+    out = sim_hier_allreduce(xs, npfn, h, r, preserve=False)
+    expected = flat_fold(xs, npfn, k, r)
+    for g in range(k):
+        for c in range(r):
+            assert np.array_equal(np.asarray(out[g][c]),
+                                  np.asarray(expected[c])), (h, r, g, c)
+
+
+def sim_hier_reduce_scatter(blocks, fn, h, r, preserve):
+    """Lockstep of ``apply_hier_reduce_scatter``: ``blocks[g][i]`` is
+    rank ``g``'s block addressed to rank ``i``; returns ``final[g]`` —
+    the fold rank ``g`` ends up owning.  The intra phase reduce-scatters
+    position SUPER-blocks (one list entry per host), the inter phase
+    reduce-scatters the per-host partials."""
+    k = h * r
+
+    def fnl(A, B):
+        return [fn(a, b) for a, b in zip(A, B)]
+
+    partial = [None] * k  # partial[m] = per-host list of intra folds
+    for b in range(h):
+        members = list(range(b * r, (b + 1) * r))
+        sb = [
+            [[blocks[m][bp * r + j] for bp in range(h)] for j in range(r)]
+            for m in members
+        ]
+        if r == 1:
+            partial[members[0]] = sb[0][0]
+        else:
+            out = ta.sim_ring_reduce_scatter(sb, fnl, r, preserve)
+            for j, m in enumerate(members):
+                partial[m] = out[j]
+    final = [None] * k
+    for j in range(r):
+        mem = [b * r + j for b in range(h)]
+        if h == 1:
+            final[mem[0]] = partial[mem[0]][0]
+        else:
+            blocks2 = [[partial[m][c] for c in range(h)] for m in mem]
+            out2 = ta.sim_ring_reduce_scatter(blocks2, fn, h, preserve)
+            for b, m in enumerate(mem):
+                final[m] = out2[b]
+    return final
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_reduce_scatter_preserves_fold_order(h, r):
+    k = h * r
+    blocks = [[f"({g}:{i})" for i in range(k)] for g in range(k)]
+    fn = lambda a, b: a + b  # noqa: E731
+    final = sim_hier_reduce_scatter(blocks, fn, h, r, preserve=True)
+    for g in range(k):
+        assert final[g] == "".join(f"({j}:{g})" for j in range(k)), (h, r, g)
+
+
+@pytest.mark.parametrize("opname,npfn", [
+    ("SUM", np.add), ("PROD", np.multiply), ("MIN", np.minimum),
+    ("MAX", np.maximum), ("LAND", np.logical_and), ("LOR", np.logical_or),
+    ("LXOR", np.logical_xor), ("BAND", np.bitwise_and),
+    ("BOR", np.bitwise_or), ("BXOR", np.bitwise_xor),
+])
+@pytest.mark.parametrize("h,r", [(2, 4), (4, 2), (8, 1)])
+def test_hier_reduce_scatter_all_ops_bit_for_bit(opname, npfn, h, r):
+    import zlib
+
+    k = h * r
+    rng = np.random.default_rng(
+        zlib.crc32(f"hier-rs/{opname}/{h}x{r}".encode()))
+    if opname in ("LAND", "LOR", "LXOR"):
+        data = rng.integers(0, 2, size=(k, k, 3)).astype(bool)
+    elif opname in ("BAND", "BOR", "BXOR"):
+        data = rng.integers(0, 255, size=(k, k, 3)).astype(np.int32)
+    elif opname == "PROD":
+        data = rng.integers(1, 4, size=(k, k, 3)).astype(np.float64)
+    else:
+        data = rng.integers(-100, 100, size=(k, k, 3)).astype(np.float64)
+    blocks = [[data[g, i] for i in range(k)] for g in range(k)]
+    final = sim_hier_reduce_scatter(blocks, npfn, h, r, preserve=False)
+    for g in range(k):
+        expected = data[0, g]
+        for j in range(1, k):
+            expected = npfn(expected, data[j, g])
+        assert np.array_equal(np.asarray(final[g]), np.asarray(expected)), \
+            (h, r, g)
+
+
+def _sim_intra_scatter(payloads, j0, r):
+    """Chunk-level lockstep of the intra-host binomial scatter phase of
+    ``apply_hier_bcast`` over one host block of ``r`` positions, rooted
+    at position ``j0`` (drives the REAL ``vdg_scatter_pairs`` — the same
+    clamped-slice semantics as the traced applier).  ``payloads[p]`` is
+    position ``p``'s R-padded chunk list; returns the chunk each
+    position holds afterwards plus the rel index table."""
+    R = al.next_pow2(r)
+    rel = [(p - j0) % r for p in range(r)]
+    buf = [list(payloads[p]) for p in range(r)]
+    groups = [tuple(range(r))]
+    for w in al.vdg_widths(R):
+        pairs = al.vdg_scatter_pairs(groups, j0, w, R)
+
+        def slab(p):
+            start = min(max(rel[p] + w, 0), R - w)
+            return buf[p][start:start + w]
+
+        recvd = {d: slab(s) for s, d in pairs}
+        for p in range(r):
+            if rel[p] % (2 * w) == w:
+                assert p in recvd, (r, j0, w, p)
+                start = min(max(rel[p], 0), R - w)
+                for i, v in enumerate(recvd[p]):
+                    buf[p][start + i] = v
+    return [buf[p][rel[p]] for p in range(r)], rel
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_bcast_delivers_root_payload(h, r):
+    # every (root, rank): the scatter -> inter-bcast -> allgather chain
+    # must reassemble exactly the root's chunks on every rank
+    k = h * r
+    R = al.next_pow2(r)
+    for root in range(k):
+        b0, j0 = divmod(root, r)
+        held = {}
+        rel = None
+        for b in range(h):
+            members = [b * r + p for p in range(r)]
+            payloads = [[("P", m, c) for c in range(R)] for m in members]
+            vals, rel = _sim_intra_scatter(payloads, j0, r)
+            for p, m in enumerate(members):
+                held[m] = vals[p]
+        # after the intra scatter, position p of the ROOT's host holds
+        # chunk rel(p) of the root's payload (other hosts hold their own
+        # position-j0 member's chunks — replaced by the inter bcast)
+        for p in range(r):
+            assert held[b0 * r + p] == ("P", root, rel[p])
+        # inter bcast per position group from host b0 (group-bcast
+        # semantics pinned by test_algos' vdg/doubling suites)
+        for p in range(r):
+            src = held[b0 * r + p]
+            for b in range(h):
+                held[b * r + p] = src
+        # intra ring allgather by rel chunk index (trivial at r == 1)
+        for b in range(h):
+            members = [b * r + p for p in range(r)]
+            if r == 1:
+                out = [[held[members[0]]]]
+            else:
+                out = ta.sim_ring_allgather([held[m] for m in members],
+                                            rel, r)
+            for p, m in enumerate(members):
+                assert out[p] == [("P", root, c) for c in range(r)], \
+                    (h, r, root, m)
+
+
+# ---------------------------------------------------------------------------
+# per-link-class byte volumes: the bandwidth claim as a test
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_allreduce_byte_volumes(h, r):
+    n = 64 * 1024  # payload bytes, divisible by every r in the matrix
+    chunk = -(-n // r)
+    intra, inter = hi.hier_link_bytes("allreduce", n, h, r)
+    # intra: (r-1) reduce-scatter rounds + (r-1) allgather rounds, one
+    # chunk each — the simulated round count, not a free-floating formula
+    assert intra == (r - 1) * chunk * 2
+    if r > 1:
+        assert intra == int(2 * (r - 1) / r * n)  # == 2·(r-1)/r·size
+    # inter: the DCN algorithm on ONE chunk over h hosts (butterfly at
+    # the 4 MiB default crossover and these sizes)
+    dcn = al.resolve_dcn_algo(chunk, h, ring_ok=True)
+    assert dcn == "butterfly"
+    assert inter == al.algorithm_bytes_per_rank("butterfly", chunk, h)
+    # the whole point of the two-level split: DCN traffic scales with
+    # size/r, never with the full payload times log k
+    if h > 1:
+        assert inter <= 2 * ((h - 1).bit_length()) * chunk
+
+
+@pytest.mark.parametrize("h,r", [(4, 2), (8, 1)])
+def test_hier_allreduce_dcn_ring_byte_volumes(h, r):
+    # drop the DCN crossover so the inter phase rings: per-rank DCN bytes
+    # must hit the bandwidth-optimal 2·(h-1)/h·(size/r) bound
+    os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"] = "1"
+    n = 64 * 1024
+    chunk = -(-n // r)
+    assert al.resolve_dcn_algo(chunk, h, ring_ok=True) == "ring"
+    intra, inter = hi.hier_link_bytes("allreduce", n, h, r)
+    assert inter == al.algorithm_bytes_per_rank("ring", chunk, h)
+    assert inter == (h - 1) * (-(-chunk // h)) * 2
+    assert inter <= 2 * chunk  # bandwidth-optimal bound on the shard
+    # order-preserving callables ship the lo/hi pair intra-host but the
+    # DCN phase keeps the butterfly (never re-chunks a callable)
+    intra_p, inter_p = hi.hier_link_bytes("allreduce", n, h, r,
+                                          preserve=True)
+    assert intra_p == (r - 1) * chunk * 3
+    assert inter_p == al.algorithm_bytes_per_rank("butterfly", chunk, h,
+                                                  True)
+
+
+def test_hier_reduce_scatter_and_bcast_byte_models():
+    n = 64 * 1024
+    h, r = 2, 4
+    chunk = -(-n // r)
+    intra, inter = hi.hier_link_bytes("reduce_scatter", n, h, r)
+    assert intra == (r - 1) * chunk  # no allgather phase
+    assert inter == 2 * (h - 1).bit_length() * chunk  # butterfly + select
+    intra_b, inter_b = hi.hier_link_bytes("bcast", n, h, r)
+    assert intra_b == n + (r - 1) * chunk  # halving scatter + allgather
+    assert inter_b == (h - 1).bit_length() * chunk  # doubling rounds
+    with pytest.raises(ValueError, match="unknown hierarchical"):
+        hi.hier_link_bytes("scan", n, h, r)
+
+
+def test_flat_link_bytes_classification():
+    n = 1 << 20
+    # single host (or unknown): everything is intra
+    assert hi.flat_link_bytes("allreduce", "ring", n, 8, None) == \
+        (al.algorithm_bytes_per_rank("ring", n, 8), 0)
+    assert hi.flat_link_bytes("allreduce", "butterfly", n, 8, 1) == \
+        (al.algorithm_bytes_per_rank("butterfly", n, 8), 0)
+    # multi-host: a flat algorithm's every round gates on DCN
+    assert hi.flat_link_bytes("allreduce", "ring", n, 8, 2) == \
+        (0, al.algorithm_bytes_per_rank("ring", n, 8))
+    # native HLO: payload proxy on intra (XLA schedules it, we don't)
+    assert hi.flat_link_bytes("allreduce", "native", n, 8, 2) == (n, 0)
+
+
+def test_flat_link_bytes_per_kind_models():
+    # the flat models mirror each lowering round for round, so the
+    # flat-vs-hier link comparison in the telemetry report is fair
+    n, k = 1 << 20, 8
+    chunk = n // k
+    # doubling broadcast ships the payload once per round, not twice
+    assert hi.flat_link_bytes("bcast", "butterfly", n, k, 2) == \
+        (0, 3 * n)
+    # van de Geijn: halving scatter (~size) + ring allgather
+    assert hi.flat_link_bytes("bcast", "ring", n, k, 2) == \
+        (0, n + (k - 1) * chunk)
+    # reduce_scatter's ring has no allgather phase
+    assert hi.flat_link_bytes("reduce_scatter", "ring", n, k, 2) == \
+        (0, (k - 1) * chunk)
+    assert hi.flat_link_bytes("reduce_scatter", "ring", n, k, 2,
+                              preserve=True) == (0, (k - 1) * chunk * 2)
+    # butterfly reduce_scatter = allreduce + own-block select
+    assert hi.flat_link_bytes("reduce_scatter", "butterfly", n, k, 1) == \
+        (2 * 3 * n, 0)
+
+
+# ---------------------------------------------------------------------------
+# selection
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_algo_hier_rules():
+    cross = config.ring_crossover_bytes()
+    # auto: hier only when expressible AND the payload clears the ring
+    # crossover on a big-enough group
+    assert al.resolve_algo("auto", cross, 8, True, hier_ok=True) == "hier"
+    assert al.resolve_algo("auto", cross - 1, 8, True,
+                           hier_ok=True) == "butterfly"
+    assert al.resolve_algo("auto", cross, 8, True, hier_ok=False) == "ring"
+    assert al.resolve_algo("auto", cross, 2, True, hier_ok=True) == \
+        "butterfly"  # below RING_MIN_GROUP
+    # forced hier wins whenever expressible, any payload
+    assert al.resolve_algo("hier", 1, 8, True, hier_ok=True) == "hier"
+    assert al.resolve_algo("hier", 1, 8, False, hier_ok=True) == "hier"
+    # forced hier falls back to the auto rules where inexpressible —
+    # never an error
+    assert al.resolve_algo("hier", cross, 8, True, hier_ok=False) == "ring"
+    assert al.resolve_algo("hier", cross - 1, 8, True,
+                           hier_ok=False) == "butterfly"
+    assert al.resolve_algo("hier", cross, 8, False,
+                           hier_ok=False) == "butterfly"
+    # forced flat algorithms still win over an expressible hierarchy
+    # (the MPX113 advisory's trigger)
+    assert al.resolve_algo("ring", cross, 8, True, hier_ok=True) == "ring"
+    assert al.resolve_algo("butterfly", cross, 8, True,
+                           hier_ok=True) == "butterfly"
+
+
+def test_resolve_dcn_algo():
+    cross = config.dcn_crossover_bytes()
+    assert cross == config.DEFAULT_DCN_CROSSOVER_BYTES
+    assert al.resolve_dcn_algo(cross, 8) == "ring"
+    assert al.resolve_dcn_algo(cross - 1, 8) == "butterfly"
+    assert al.resolve_dcn_algo(cross, 2) == "butterfly"  # tiny host count
+    assert al.resolve_dcn_algo(cross, 8, ring_ok=False) == "butterfly"
+    os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"] = "256"
+    assert al.resolve_dcn_algo(256, 8) == "ring"
+    assert al.resolve_dcn_algo(255, 8) == "butterfly"
+
+
+def test_dcn_crossover_parsing():
+    os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"] = "-3"
+    with pytest.raises(ValueError, match="must be >= 0"):
+        config.dcn_crossover_bytes()
+    os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"] = "4MB"
+    with pytest.raises(ValueError, match="could not be parsed"):
+        config.dcn_crossover_bytes()
+
+
+def test_algo_cache_token_reflects_topology_knobs():
+    # mirrors test_algos.py::test_algo_cache_token_reflects_every_knob:
+    # the topology fingerprint and DCN crossover must move the compiled-
+    # program cache keys, or toggling them would serve stale programs
+    base = al.algo_cache_token()
+    tokens = {base}
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "2x4"
+    tokens.add(al.algo_cache_token())
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "4x2"
+    tokens.add(al.algo_cache_token())
+    os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"] = "123"
+    tokens.add(al.algo_cache_token())
+    assert len(tokens) == 4
+    del os.environ["MPI4JAX_TPU_TOPOLOGY"]
+    del os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"]
+    assert al.algo_cache_token() == base

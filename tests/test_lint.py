@@ -182,18 +182,19 @@ def test_registry_flags_are_wellformed():
 
 def test_docs_list_every_registered_flag():
     """Docs-sync: each declared flag must appear in the docs flag tables
-    (docs/usage.md, docs/resilience.md, docs/observability.md, or
-    docs/overlap.md) — a flag without documentation is indistinguishable
-    from an undocumented sharp bit."""
+    (docs/usage.md, docs/resilience.md, docs/observability.md,
+    docs/overlap.md, or docs/topology.md) — a flag without documentation
+    is indistinguishable from an undocumented sharp bit."""
     config = _load_config()
     docs = "\n".join(
         (REPO / "docs" / f).read_text()
         for f in ("usage.md", "resilience.md", "observability.md",
-                  "overlap.md")
+                  "overlap.md", "topology.md")
     )
     missing = [name for name in config.FLAGS if name not in docs]
     assert not missing, (
         "flags declared in utils/config.py but absent from the docs flag "
         "tables (docs/usage.md / docs/resilience.md / "
-        "docs/observability.md / docs/overlap.md): " + ", ".join(missing)
+        "docs/observability.md / docs/overlap.md / docs/topology.md): "
+        + ", ".join(missing)
     )

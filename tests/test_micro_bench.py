@@ -91,6 +91,25 @@ def test_bench_overlap_schema():
     assert r["chunks"] >= 1 and r["overlap_speedup"] > 0
 
 
+def test_bench_hierarchy_schema():
+    # compiles the flat ring AND the forced two-level lowering under a
+    # faked 2x4 host topology at a tiny size: a hierarchy regression in
+    # either fails here, fast; a topology spec that does not cover the
+    # mesh is skipped, not an error (docs/topology.md)
+    comm = _world_comm()
+    saved_topo = os.environ.get("MPI4JAX_TPU_TOPOLOGY")
+    saved_algo = os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO")
+    rows = micro.bench_hierarchy(comm, sizes_mb=[0.0001],
+                                 topologies=("2x4", "3x9"), iters=2)
+    assert os.environ.get("MPI4JAX_TPU_TOPOLOGY") == saved_topo  # restored
+    assert os.environ.get("MPI4JAX_TPU_COLLECTIVE_ALGO") == saved_algo
+    assert len(rows) == 1  # 3x9 covers 27 ranks, not this mesh: skipped
+    r = rows[0]
+    assert r["topology"] == "2x4"  # the topology stamp --save commits
+    assert r["flat_us"] > 0 and r["hier_us"] > 0
+    assert (r["hier_speedup"] is None) == (comm.Get_size() == 1)
+
+
 def test_save_results_roundtrip(tmp_path):
     import json
 
