@@ -1,0 +1,104 @@
+"""Seeded rank-divergent deadlock — INTENTIONALLY BROKEN (MPX121).
+
+The classic cross-rank hang the per-trace verifier cannot see: a
+``lax.cond`` on rank parity where BOTH branches communicate (so MPX108
+stays silent), but even and odd ranks issue their point-to-point ops in
+cycle-forming order — every rank posts its *receive* first, and the
+matching send lives after the peer's receive.  Each even/odd pair is a
+two-rank wait cycle: a guaranteed hang under any buffering.
+
+Only the cross-rank schedule pass catches it, by re-tracing once per
+rank (concretizing ``comm.Get_rank`` so the cond takes its real
+per-rank path), matching the per-rank schedules, and walking the
+wait-for graph (docs/analysis.md "Cross-rank verification"):
+
+    python examples/broken/rank_divergent_deadlock.py
+
+runs both front-ends — ``mpx.analyze(ranks='all')`` and the ambient
+``MPI4JAX_TPU_ANALYZE=error`` path — and asserts both flag MPX121.  This
+file lives under ``examples/broken/`` so the CI sweep over
+``examples/*.py`` (which must come back clean) does not pick it up; the
+CI analyze lane instead asserts that analyzing THIS file fails with
+MPX121 (.github/workflows/test.yml).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+
+def build_exchange(comm):
+    """Neighbor exchange over even/odd pairs, recv-before-send on BOTH
+    sides — the textbook head-to-head deadlock, written rank-divergently."""
+    k = comm.Get_size()
+    up = tuple((i, i + 1) for i in range(0, k - 1, 2))    # even -> odd
+    down = tuple((i + 1, i) for i in range(0, k - 1, 2))  # odd -> even
+
+    def exchange(x):
+        r = comm.Get_rank()
+
+        def even_path(v):
+            # wait for the odd neighbor's message... which it only sends
+            # after ITS recv completes: a two-rank cycle
+            got, t = mpx.recv(v, source=down, tag=0, comm=comm)
+            mpx.send(v, up, tag=1, comm=comm, token=t)
+            return got
+
+        def odd_path(v):
+            got, t = mpx.recv(v, source=up, tag=1, comm=comm)
+            mpx.send(v, down, tag=0, comm=comm, token=t)
+            return got
+
+        return lax.cond(r % 2 == 0, even_path, odd_path, x)
+
+    return exchange
+
+
+def main():
+    mesh = mpx.make_world_mesh(devices=jax.devices())
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+    if n < 2:
+        print("needs >= 2 devices (e.g. XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8); nothing to "
+              "deadlock on 1 rank")
+        return
+    exchange = build_exchange(comm)
+    x = jnp.stack([jnp.full((16,), float(r)) for r in range(n)])
+
+    # --- front-end 1: explicit cross-rank analysis
+    report = mpx.analyze(exchange, x, comm=comm, ranks="all")
+    print(report.render(), file=sys.stderr)
+    codes = {f.code for f in report.findings}
+    assert "MPX121" in codes, f"expected MPX121, got {sorted(codes)}"
+    print("mpx.analyze(ranks='all'): deadlock cycle caught (MPX121)",
+          file=sys.stderr)
+
+    # --- front-end 2: the ambient env=error path (the cross-rank pass
+    # runs at spmd trace time, before anything compiles)
+    mpx.set_analyze_mode("error")
+    try:
+        try:
+            mpx.run(exchange, x, comm=comm)
+        except mpx.AnalysisError as e:
+            assert any(f.code == "MPX121" for f in e.findings), e.findings
+            print("MPI4JAX_TPU_ANALYZE=error: deadlock cycle caught "
+                  "(MPX121) at trace time", file=sys.stderr)
+        else:
+            raise AssertionError("ambient cross-rank pass missed the "
+                                 "deadlock")
+    finally:
+        mpx.set_analyze_mode(None)
+        mpx.clear_caches()
+
+
+if __name__ == "__main__":
+    main()

@@ -48,7 +48,7 @@ from .walker import check_cond_divergence  # noqa: F401
 
 
 def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
-            static_argnums=None) -> Report:
+            static_argnums=None, ranks=None) -> Report:
     """Statically verify the collective structure of ``fn(*args)``.
 
     ``fn`` is re-traced abstractly (nothing executes, nothing compiles):
@@ -64,27 +64,47 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
     - ``wrap=False``: traced exactly as given (for eager-style functions
       that take global arrays and call ops outside any region).
 
+    ``ranks`` enables **cross-rank schedule verification** (the
+    whole-program deadlock/progress pass, docs/analysis.md): ``'all'``
+    re-traces the function once per rank of the comm — concretizing
+    ``comm.Get_rank`` so rank-dependent Python/``lax.cond`` branches
+    take their real paths — extracts each rank's ordered op schedule,
+    matches collectives by (comm, seq) / point-to-point by (src, dst,
+    tag) FIFO / start-wait by span across ranks, and checks the matched
+    program for progress (MPX120–MPX125).  An int ``n`` analyzes ranks
+    ``0..n-1``; an iterable names them explicitly.  Requires a
+    region-style function (``wrap=False`` has no per-rank program to
+    concretize).
+
     Returns a :class:`Report`; ``report.raise_if_findings()`` converts it
     into the same :class:`AnalysisError` the
     ``MPI4JAX_TPU_ANALYZE=error`` dispatch mode raises.  Results are
-    memoized per (fn, arg shapes, algo config); ``mpx.clear_caches()``
-    drops the memo.
+    memoized per (fn, arg shapes, ranks, algo config);
+    ``mpx.clear_caches()`` drops the memo.
     """
     import jax
 
     from ..ops._algos import algo_cache_token
-    from ..parallel.region import spmd
+    from ..parallel.region import resolve_comm, spmd
 
     if wrap is None:
         wrap = not getattr(fn, "_mpx_spmd", False)
+    if ranks is not None and not wrap and not getattr(fn, "_mpx_spmd", False):
+        raise ValueError(
+            "analyze(ranks=...) needs a region-style function (plain "
+            "per-rank or spmd-decorated): an eager-style wrap=False "
+            "function has no per-rank program to re-trace"
+        )
 
+    region_comm = comm
     if not wrap and getattr(fn, "_mpx_spmd", False):
         # rebuild the un-jitted twin of the spmd wrapper: jit's trace cache
         # would otherwise serve a cached jaxpr and record nothing
         kw = fn._mpx_spmd_kwargs
+        region_comm = comm if comm is not None else kw["comm"]
         target = spmd(
             fn._mpx_fn,
-            comm=comm if comm is not None else kw["comm"],
+            comm=region_comm,
             in_specs=kw["in_specs"],
             out_specs=kw["out_specs"],
             static_argnums=kw["static_argnums"],
@@ -100,9 +120,33 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
     statics = _normalize_statics(static_argnums, len(args))
     from .hook import _analyze_cache
 
-    key = _cache_key(jax, fn, comm, args, statics, wrap, algo_cache_token())
+    rank_list = None
+    if ranks is not None:
+        from . import crossrank
+
+        c = resolve_comm(region_comm)
+        if c.mesh is None:
+            raise RuntimeError(
+                "analyze(ranks=...) needs a comm bound to a mesh (the "
+                "rank set and axis sizes come from it)"
+            )
+        axis_sizes = [c.mesh.shape[a] for a in c.axes]
+        world = 1
+        for s in axis_sizes:
+            world *= s
+        rank_list = crossrank.resolve_rank_list(ranks, world)
+
+    key = _cache_key(jax, fn, comm, args, statics, wrap, algo_cache_token(),
+                     rank_list)
     if key is not None and key in _analyze_cache:
         return _analyze_cache[key]
+
+    if rank_list is not None:
+        report = _analyze_cross_rank(jax, target, args, statics, c,
+                                     axis_sizes, world, rank_list)
+        if key is not None:
+            _analyze_cache[key] = report
+        return report
 
     rec = Recorder("collect")
     push_recorder(rec)
@@ -134,6 +178,38 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
     return report
 
 
+def _analyze_cross_rank(jax, target, args, statics, c, axis_sizes, world,
+                        rank_list) -> Report:
+    """The ranks= path: per-rank re-traces -> per-rank graph checkers ->
+    global matcher -> progress checker."""
+    from . import crossrank
+    from .hook import config_snapshot
+
+    watermark = crossrank.uid_watermark()
+    per_rank, fatal, closed = crossrank.trace_rank_schedules(
+        target, args, {}, statics, c.axes, axis_sizes, rank_list)
+    findings = list(fatal)
+    # an aborted rank trace is ONE defect per code: the graph checkers
+    # may replay the same hazard from the events recorded before the
+    # raise (the single-trace path applies the same filter)
+    fatal_codes = {f.code for f in fatal}
+    findings.extend(f for f in crossrank.per_rank_graph_findings(per_rank)
+                    if f.code not in fatal_codes)
+    seen_cond = set()
+    for r in sorted(closed):
+        for f in check_cond_divergence(closed[r]):
+            if f.message in seen_cond:
+                continue
+            seen_cond.add(f.message)
+            findings.append(f)
+    if not fatal:
+        findings.extend(
+            crossrank.cross_rank_findings(per_rank, world, watermark))
+    events = per_rank.get(rank_list[0], ())
+    return Report(findings=tuple(findings), events=tuple(events),
+                  meta=dict(config_snapshot(), ranks=list(rank_list)))
+
+
 def _normalize_statics(static_argnums, nargs) -> tuple:
     if static_argnums is None:
         return ()
@@ -142,7 +218,7 @@ def _normalize_statics(static_argnums, nargs) -> tuple:
     return tuple(sorted(i if i >= 0 else i + nargs for i in static_argnums))
 
 
-def _cache_key(jax, fn, comm, args, statics, wrap, algo_token):
+def _cache_key(jax, fn, comm, args, statics, wrap, algo_token, rank_list=None):
     dyn = tuple(a for i, a in enumerate(args) if i not in statics)
     stat_vals = tuple(args[i] for i in statics)
     leaves, treedef = jax.tree.flatten(dyn)
@@ -152,7 +228,7 @@ def _cache_key(jax, fn, comm, args, statics, wrap, algo_token):
         else repr(leaf)
         for leaf in leaves
     )
-    key = (fn, comm, stat_vals, treedef, avals, wrap, algo_token)
+    key = (fn, comm, stat_vals, treedef, avals, wrap, algo_token, rank_list)
     try:
         hash(key)
     except TypeError:

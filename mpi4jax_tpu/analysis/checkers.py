@@ -60,9 +60,15 @@ def checker(*codes: str) -> Callable:
     return register
 
 
-def run_checkers(graph: CollectiveGraph) -> List[Finding]:
+def run_checkers(graph: CollectiveGraph, skip=()) -> List[Finding]:
+    """Run the registry over ``graph``.  ``skip`` names codes whose
+    checkers must not run (the cross-rank front-end skips the per-trace
+    p2p FIFO replay: a single rank's schedule legitimately holds one
+    side of an exchange — see analysis/crossrank.py)."""
     findings: List[Finding] = []
-    for _, fn in CHECKERS:
+    for codes, fn in CHECKERS:
+        if skip and any(c in skip for c in codes):
+            continue
         findings.extend(fn(graph))
     findings.sort(key=lambda f: (f.index if f.index is not None else -1,
                                  f.code))

@@ -1,0 +1,311 @@
+"""Cross-rank verification front-end: re-trace once per rank, then match.
+
+``mpx.analyze(fn, *args, ranks='all')`` and the ambient
+``MPI4JAX_TPU_ANALYZE`` mode share this machinery:
+
+1. the target is re-traced abstractly once **per rank** under a
+   :class:`~.schedule.ConcreteScope` — ``comm.Get_rank`` returns that
+   rank's concrete coordinates, and concrete-predicate ``lax.cond`` /
+   ``lax.switch`` take only the branch the rank would take — so
+   rank-divergent programs yield their real per-rank op streams;
+2. each stream becomes a :class:`~.schedule.SchedOp` schedule
+   (analysis/schedule.py);
+3. the global matcher pairs collectives by (comm, seq), point-to-point
+   by (src, dst, tag) FIFO, and start/wait by span (analysis/matcher.py);
+4. the progress checker simulates the matched program and reports
+   deadlock cycles (analysis/progress.py).
+
+While a per-rank trace runs, in-region send/recv matching relaxes to
+one-sided recording (ops/send.py, ops/recv.py): the whole point is that
+each rank's schedule may legitimately contain only one side of an
+exchange — cross-rank pairing is the matcher's job, not the region
+queue's.  Re-tracing is pure host-side work (``jax.make_jaxpr``: nothing
+compiles or executes), so the ambient pass leaves the lowered HLO
+byte-identical in every mode.
+"""
+
+from __future__ import annotations
+
+import math
+import warnings
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils import config
+from . import hook as _hook
+from . import schedule as _schedule
+from .checkers import run_checkers
+from .matcher import match_schedules
+from .progress import check_progress
+from .report import Finding, Report, finding_from_exception
+
+# the per-trace p2p FIFO replay (MPX101/102/106/110) is skipped on
+# per-rank graphs: a rank's schedule legitimately holds one side of an
+# exchange.  The matcher re-reports MPX101/102/106 with whole-program
+# context, and the progress simulation replays MPX110 (pending-send
+# depth at simulated match time).
+_PER_RANK_SKIP = ("MPX101", "MPX102", "MPX106", "MPX110")
+
+
+@contextmanager
+def _concrete_control_flow():
+    """Patch ``jax.lax.cond``/``switch`` so a concrete (non-tracer)
+    predicate evaluates only the taken branch — rank-dependent structure
+    concretizes; data-dependent control flow traces exactly as before."""
+    import jax
+    from jax import core
+
+    orig_cond = jax.lax.cond
+    orig_switch = jax.lax.switch
+
+    def _is_concrete(x) -> bool:
+        if isinstance(x, core.Tracer):
+            return False
+        try:
+            bool(x == x)  # 0-d arrays and scalars are fine
+        except Exception:
+            return False
+        return True
+
+    def cond(pred, true_fun, false_fun=None, *operands, **kwargs):
+        if false_fun is not None and not kwargs and _is_concrete(pred):
+            return (true_fun if bool(pred) else false_fun)(*operands)
+        if false_fun is None:
+            return orig_cond(pred, true_fun, **kwargs)
+        return orig_cond(pred, true_fun, false_fun, *operands, **kwargs)
+
+    def switch(index, branches, *operands, **kwargs):
+        if not kwargs and branches and _is_concrete(index):
+            i = min(max(int(index), 0), len(branches) - 1)
+            return branches[i](*operands)
+        return orig_switch(index, branches, *operands, **kwargs)
+
+    jax.lax.cond = cond
+    jax.lax.switch = switch
+    try:
+        yield
+    finally:
+        jax.lax.cond = orig_cond
+        jax.lax.switch = orig_switch
+
+
+def trace_rank_schedules(target, args, kwargs, static_argnums,
+                         axis_names: Sequence[str],
+                         axis_sizes: Sequence[int],
+                         rank_list: Sequence[int]):
+    """Re-trace ``target(*args, **kwargs)`` once per rank in
+    ``rank_list``.  Returns ``(per_rank_events, fatal_findings,
+    closed_jaxprs)``; a rank whose trace aborts on an MPX-tagged raise
+    contributes a finding instead of an event stream (untagged
+    exceptions propagate)."""
+    import jax
+    from dataclasses import replace
+
+    per_rank_events: Dict[int, list] = {}
+    closed: Dict[int, object] = {}
+    fatal: List[Finding] = []
+    for r in rank_list:
+        rec = _hook.Recorder("collect")
+        _hook.push_recorder(rec)
+        try:
+            with _schedule.scope(axis_names, axis_sizes, r), \
+                    _concrete_control_flow():
+                closed[r] = jax.make_jaxpr(
+                    target, static_argnums=static_argnums)(*args, **kwargs)
+        except Exception as e:
+            f = finding_from_exception(e)
+            if f is None:
+                raise
+            fatal.append(replace(f, rank=r))
+        finally:
+            _hook.pop_recorder()
+        per_rank_events[r] = rec.events
+    return per_rank_events, fatal, closed
+
+
+def uid_watermark() -> int:
+    """Snapshot the comm-uid counter BEFORE the per-rank re-traces: uids
+    below it belong to comms shared across the traces (stable identity);
+    uids above it are per-trace creations, aligned by creation order
+    (see ``schedule.build_schedule``).  Consumes one uid — uids only
+    need uniqueness."""
+    from ..parallel import comm as _comm
+
+    return next(_comm._uid_counter)
+
+
+def cross_rank_findings(per_rank_events: Dict[int, list], world: int,
+                        watermark: Optional[int] = None) -> List[Finding]:
+    """Schedules -> matcher -> progress, over per-rank event streams."""
+    schedules = {
+        r: _schedule.build_schedule(events, rank=r, world=world,
+                                    uid_watermark=watermark)
+        for r, events in per_rank_events.items()
+    }
+    matched = match_schedules(schedules)
+    findings = list(matched.findings)
+    findings.extend(check_progress(matched))
+    return findings
+
+
+def per_rank_graph_findings(per_rank_events: Dict[int, list]) -> List[Finding]:
+    """The single-trace checkers over each rank's stream (minus the p2p
+    FIFO replay — see ``_PER_RANK_SKIP``), deduplicated across ranks."""
+    findings: List[Finding] = []
+    seen = set()
+    for r in sorted(per_rank_events):
+        graph = _hook.CollectiveGraph(events=per_rank_events[r],
+                                      meta=_hook.config_snapshot())
+        for f in run_checkers(graph, skip=_PER_RANK_SKIP):
+            key = (f.code, f.op, f.index, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(f)
+    return findings
+
+
+def resolve_rank_list(ranks, world: int) -> Tuple[int, ...]:
+    """Normalize the ``ranks`` argument: ``'all'`` -> every rank, an int
+    ``n`` -> ranks ``0..n-1``, any iterable -> its sorted unique ints;
+    every entry must exist on the comm."""
+    if ranks == "all":
+        return tuple(range(world))
+    if isinstance(ranks, bool):
+        raise ValueError("ranks must be 'all', an int, or an iterable "
+                         "of ranks")
+    if isinstance(ranks, int):
+        if not 0 < ranks <= world:
+            raise ValueError(
+                f"ranks={ranks} out of range for a {world}-rank comm")
+        return tuple(range(ranks))
+    out = tuple(sorted({int(r) for r in ranks}))
+    if not out:
+        raise ValueError("ranks must name at least one rank")
+    if out[0] < 0 or out[-1] >= world:
+        raise ValueError(
+            f"ranks {out} out of range for a {world}-rank comm")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the ambient (env-mode) pass, hooked from parallel/region.py
+# ---------------------------------------------------------------------------
+
+
+def _ambient_enabled(world: int) -> bool:
+    setting = config.analyze_ranks()
+    if setting == "off":
+        return False
+    if setting == "auto":
+        return True
+    return world <= setting  # int: cost cap on the per-rank re-traces
+
+
+def verify_region_crossrank(fn, *, comm, in_specs, out_specs,
+                            static_argnums, c, args, kwargs) -> None:
+    """Run the cross-rank pass for an spmd region about to trace
+    (called on a program-cache miss, before the program is built, so
+    ``error`` mode raises before anything compiles or runs).
+
+    No-op when the verifier is off, an explicit recorder is already
+    capturing (``mpx.analyze`` drives its own pass), the cross-rank pass
+    is disabled or capped (``MPI4JAX_TPU_ANALYZE_RANKS``), or the comm's
+    size is not statically known.  Results are memoized alongside the
+    ``mpx.analyze`` reports (same cache, dropped by
+    ``mpx.clear_caches``), keyed by the same config tokens the program
+    caches fold in.
+    """
+    mode = _hook.effective_mode()
+    if mode == "off" or _hook.recording():
+        return
+    mesh = c.mesh
+    if mesh is None:
+        return
+    axis_sizes = [mesh.shape[a] for a in c.axes]
+    world = math.prod(axis_sizes)
+    if world < 2 or not _ambient_enabled(world):
+        return
+
+    import jax
+
+    from ..ops._algos import algo_cache_token
+
+    # kwargs flatten by sorted key with values as leaves, so both the
+    # keyword names (treedef) and their avals key the memo
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    avals = tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+        else repr(leaf)
+        for leaf in leaves
+    )
+    key = ("crossrank", fn, c.uid, treedef, avals,
+           tuple(static_argnums or ()), mode, config.analyze_ranks(),
+           algo_cache_token())
+    try:
+        hash(key)
+    except TypeError:
+        key = None
+    cache = _hook.analyze_cache()
+    fresh = False
+    if key is not None and key in cache:
+        report = cache[key]
+    else:
+        report = _run_region_pass(fn, comm, in_specs, out_specs,
+                                  static_argnums, c, args, kwargs,
+                                  axis_sizes, world)
+        if report is None:
+            return
+        fresh = True
+        if key is not None:
+            cache[key] = report
+    if report.ok:
+        return
+    if fresh:
+        # sink/warn once per verified program, not once per call — a
+        # host loop over a dirty region must not inflate the CLI's
+        # finding counts with duplicates of the same report
+        _hook.sink_report(f"cross-rank pass over spmd region "
+                          f"{getattr(fn, '__name__', fn)!s}", report)
+    if mode == "error":
+        # every call refuses: the program must not run
+        report.raise_if_findings()
+    if fresh:
+        warnings.warn(
+            "MPI4JAX_TPU_ANALYZE: cross-rank findings in spmd region "
+            f"{getattr(fn, '__name__', fn)!s}:\n{report.render()}",
+            stacklevel=3,
+        )
+
+
+def _run_region_pass(fn, comm, in_specs, out_specs, static_argnums,
+                     c, args, kwargs, axis_sizes, world) -> Optional[Report]:
+    from ..parallel.region import spmd
+
+    from . import _normalize_statics
+
+    target = spmd(fn, comm=comm, in_specs=in_specs, out_specs=out_specs,
+                  static_argnums=static_argnums, jit=False)
+    statics = _normalize_statics(static_argnums, len(args))
+    watermark = uid_watermark()
+    try:
+        per_rank, fatal, _ = trace_rank_schedules(
+            target, args, kwargs, statics, c.axes, axis_sizes,
+            range(world))
+    except Exception as e:  # pragma: no cover - defensive
+        # a re-trace failure must never break the user's real trace; the
+        # normal trace path surfaces genuine errors itself
+        warnings.warn(
+            f"MPI4JAX_TPU_ANALYZE: cross-rank pass skipped (per-rank "
+            f"re-trace failed: {type(e).__name__}: {e})", stacklevel=3)
+        return None
+    if fatal:
+        # the normal trace will raise the same tagged error with a full
+        # traceback — do not pre-empt it with a partial cross-rank view
+        return None
+    findings = cross_rank_findings(per_rank, world, watermark)
+    first = per_rank.get(0, ())
+    return Report(findings=tuple(findings), events=tuple(first),
+                  meta=dict(_hook.config_snapshot(),
+                            ranks=list(range(world))))

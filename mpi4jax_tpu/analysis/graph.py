@@ -50,6 +50,17 @@ class CollectiveEvent:
     span: Optional[int] = None          # async start/wait pairing handle id
     fused_members: Optional[int] = None  # member ops packed into this op
     fused_bytes: Optional[int] = None   # flat-buffer payload bytes
+    # per-member (dtype, nelems) composition of a fused flat buffer — the
+    # cross-rank matcher compares it across ranks (MPX124)
+    fused_layout: Optional[Tuple] = None
+    # (hosts, ranks_per_host) of the two-level plan this op lowered with
+    # (ops/_hierarchy.annotate_selection), compared across ranks (MPX125)
+    hier: Optional[Tuple[int, int]] = None
+    # static member groups (global ranks, group order) of this op's comm
+    # when derivable — comm.groups on a split, or the rank-concretization
+    # scope's sub-axes partition during a per-rank schedule trace.  The
+    # cross-rank schedule builder reads participants from here.
+    groups: Optional[Tuple[Tuple[int, ...], ...]] = None
     extra: Dict = field(default_factory=dict)
 
     def where(self) -> str:

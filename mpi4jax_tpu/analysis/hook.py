@@ -58,9 +58,10 @@ def effective_mode() -> str:
 
 def analysis_cache_token() -> tuple:
     """Folded into the compiled-program cache keys (ops/_base.py eager
-    cache, parallel/region.py spmd cache): flipping the mode must retrace
-    — the verifier only sees programs as they trace."""
-    return (effective_mode(),)
+    cache, parallel/region.py spmd cache): flipping the mode — or the
+    cross-rank pass setting — must retrace; the verifier only sees
+    programs as they trace."""
+    return (effective_mode(), config.analyze_ranks())
 
 
 class Recorder:
@@ -153,6 +154,8 @@ def begin_event(opname: str, comm, arrays, token, ana: Optional[dict],
     except RuntimeError:
         min_size = None
     a0 = arrays[0] if arrays else None
+    from .schedule import static_groups_for
+
     evt = CollectiveEvent(
         index=len(rec.events),
         op=opname,
@@ -165,6 +168,7 @@ def begin_event(opname: str, comm, arrays, token, ana: Optional[dict],
         dtype=str(a0.dtype) if a0 is not None else "",
         shape=tuple(a0.shape) if a0 is not None else (),
         eager=eager,
+        groups=static_groups_for(comm),
     )
     if ana:
         for k, v in ana.items():
@@ -223,12 +227,38 @@ def finish_context(ctx, where: str) -> None:
         return
     report = Report(findings=tuple(findings), events=tuple(rec.events),
                     meta=dict(graph.meta))
+    sink_report(where, report)
     if rec.mode == "error":
         report.raise_if_findings()
     warnings.warn(
         f"MPI4JAX_TPU_ANALYZE: findings in {where}:\n{report.render()}",
         stacklevel=2,
     )
+
+
+# ---------------------------------------------------------------------------
+# report sink (the CLI's aggregation channel)
+# ---------------------------------------------------------------------------
+#
+# ``python -m mpi4jax_tpu.analysis`` installs a sink so the exit-code
+# contract (1 on any error-severity finding) and the ``--json`` payload
+# can aggregate findings across every region of every script without
+# aborting at the first one.
+
+_report_sink: Optional[list] = None
+
+
+def set_report_sink(sink: Optional[list]) -> None:
+    """Install (or clear, with ``None``) the ambient report sink: every
+    env-mode report — single-trace and cross-rank — is appended to it as
+    ``(where, Report)`` before the mode's warn/raise action runs."""
+    global _report_sink
+    _report_sink = sink
+
+
+def sink_report(where: str, report) -> None:
+    if _report_sink is not None:
+        _report_sink.append((where, report))
 
 
 # ---------------------------------------------------------------------------

@@ -127,6 +127,58 @@ CODES = {
             "it, or force MPI4JAX_TPU_COLLECTIVE_ALGO=hier "
             "(docs/topology.md).",
         ),
+        # --- cross-rank schedule codes (analysis/matcher.py + progress.py):
+        # whole-program properties over the per-rank schedules the
+        # ranks= re-trace (or a hand-built schedule set) provides.
+        CodeInfo(
+            "MPX120", "cross-rank collective order mismatch", ERROR,
+            "Member ranks of one communicator issue different "
+            "collectives at the same schedule position, or are mutually "
+            "blocked in collectives on different communicators (an "
+            "interleave cycle).  Each side waits in a collective its "
+            "peers never enter — a hang at run time (ISP/MUST-style "
+            "schedule matching makes this decidable statically).",
+        ),
+        CodeInfo(
+            "MPX121", "send/recv deadlock cycle", ERROR,
+            "A cycle of ranks each blocked in a point-to-point receive "
+            "whose matching send is issued only after the next rank in "
+            "the cycle unblocks.  The cycle is rendered rank-by-rank; "
+            "it deadlocks under ANY buffering (sends are modeled "
+            "buffered, matching this library's deferred pairing), so "
+            "the reference runtime hangs too.",
+        ),
+        CodeInfo(
+            "MPX122", "collective/p2p interleave deadlock", ERROR,
+            "A dependency cycle mixing collectives and point-to-point: "
+            "some ranks wait in a collective while its other members "
+            "are blocked in receives (or vice versa).  No schedule "
+            "order exists in which every rank progresses.",
+        ),
+        CodeInfo(
+            "MPX123", "orphaned rank", ERROR,
+            "A rank is a member of a communicator group but never "
+            "issues the collective its peers are matched in: the peers "
+            "block in the collective forever.  Classic cause: a "
+            "rank-divergent branch that skips a collective on some "
+            "ranks only.",
+        ),
+        CodeInfo(
+            "MPX124", "rank-divergent fusion bucketing", ERROR,
+            "Member ranks of one fused collective would pack different "
+            "flat buffers (member count, packed bytes, or dtype layout "
+            "differ): the flat-buffer exchange would ship mismatched "
+            "payloads.  Fusion deferral must see the same op sequence "
+            "on every rank.",
+        ),
+        CodeInfo(
+            "MPX125", "hierarchical decomposition mismatch", ERROR,
+            "A rank's two-level ICI/DCN plan (ops/_hierarchy.py) "
+            "disagrees with its peers' for the same collective under "
+            "the declared Topology: intra-host and inter-host phases "
+            "would pair different groups.  All members must derive the "
+            "same (hosts, ranks-per-host) decomposition.",
+        ),
     )
 }
 
@@ -148,13 +200,19 @@ def mpx_error(exc_type, code: str, message: str):
 
 @dataclass(frozen=True)
 class Finding:
-    """One diagnostic: a stable code, a one-line message, a suggested fix."""
+    """One diagnostic: a stable code, a one-line message, a suggested fix.
+
+    ``rank`` and ``seq`` are the cross-rank provenance fields (which
+    rank's schedule anchors the finding, and at which per-comm collective
+    sequence number) — ``None`` for single-trace findings."""
 
     code: str
     message: str
     suggestion: str = ""
     op: Optional[str] = None
     index: Optional[int] = None
+    rank: Optional[int] = None
+    seq: Optional[int] = None
 
     @property
     def severity(self) -> str:
@@ -162,10 +220,27 @@ class Finding:
 
     def render(self) -> str:
         where = f" at {self.op}#{self.index}" if self.op is not None else ""
+        if self.rank is not None:
+            where += f" (rank {self.rank})"
         line = f"{self.code} [{self.severity}]{where}: {self.message}"
         if self.suggestion:
             line += f"\n    fix: {self.suggestion}"
         return line
+
+    def to_json(self) -> Dict:
+        """Machine-readable form (one object per finding, with rank/op/
+        seq provenance) — the unit of ``Report.to_json``."""
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "title": CODES[self.code].title,
+            "message": self.message,
+            "suggestion": self.suggestion,
+            "op": self.op,
+            "index": self.index,
+            "rank": self.rank,
+            "seq": self.seq,
+        }
 
 
 def finding_from_exception(exc) -> Optional[Finding]:
@@ -212,6 +287,32 @@ class Report:
 
     def __str__(self) -> str:
         return self.render()
+
+    def to_json(self) -> Dict:
+        """CI-consumable payload: counts, the config snapshot, and one
+        object per finding with rank/op/seq provenance (printed by
+        ``python -m mpi4jax_tpu.analysis --json``)."""
+        codes: Dict[str, int] = {}
+        for f in self.findings:
+            codes[f.code] = codes.get(f.code, 0) + 1
+        meta = {}
+        for k, v in self.meta.items():
+            if isinstance(v, (str, int, float, bool)) or v is None:
+                meta[k] = v
+            elif isinstance(v, (list, tuple)):
+                meta[k] = [x if isinstance(x, (str, int, float, bool))
+                           else repr(x) for x in v]
+            else:
+                meta[k] = repr(v)
+        return {
+            "ok": self.ok,
+            "errors": len(self.errors),
+            "advisories": len(self.advisories),
+            "events": len(self.events),
+            "codes": codes,
+            "meta": meta,
+            "findings": [f.to_json() for f in self.findings],
+        }
 
     def raise_if_findings(self) -> None:
         if self.findings:

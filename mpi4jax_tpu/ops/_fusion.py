@@ -413,8 +413,15 @@ def _flush_queue(q: _Queue) -> None:
             flats = [entries[i].array.reshape(-1) for i in members]
             flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
             _meter_bucket(q, flat, len(members))
+            # the layout (per-member dtype + element count, in pack
+            # order) is what the cross-rank matcher compares: two ranks
+            # packing different flat buffers is MPX124
             _pending_ana = {"fused_members": len(members),
-                            "fused_bytes": int(flat.size) * flat.dtype.itemsize}
+                            "fused_bytes": int(flat.size) * flat.dtype.itemsize,
+                            "fused_layout": tuple(
+                                (str(entries[i].array.dtype),
+                                 int(entries[i].array.size))
+                                for i in members)}
             try:
                 fused = _run_member(q, flat)
             finally:

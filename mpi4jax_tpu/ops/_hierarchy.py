@@ -356,7 +356,12 @@ def annotate_selection(kind: str, algo: str, nbytes: int, k: int,
     # and where flat is the only option there is nothing to advise.  The
     # telemetry link classes keep the broader host signal — a flat
     # algorithm on a non-uniform multi-host comm still ships over DCN.
-    a_annotate(algo=algo, hosts=plan.h if plan is not None else None)
+    # ``hier`` records the two-level decomposition this op actually
+    # lowered with — the cross-rank matcher compares it across member
+    # ranks (MPX125, analysis/matcher.py).
+    a_annotate(algo=algo, hosts=plan.h if plan is not None else None,
+               hier=(plan.h, plan.r) if (plan is not None
+                                         and algo == "hier") else None)
     t_annotate(algo=algo, link_bytes=link)
 
 

@@ -73,8 +73,22 @@ def recv(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
     def body(comm, arrays, token):
         from ..analysis.hook import annotate
         from ..analysis.report import mpx_error
+        from ..analysis.schedule import concretizing
 
         (template,) = arrays
+        if concretizing():
+            # per-rank schedule trace: the matching send may live on a
+            # DIFFERENT rank's schedule, so the region queue cannot pair
+            # it — record the recv one-sided (explicit source resolves
+            # the routing; source=None is a wildcard for the matcher)
+            # and type the result by the template, like the reference
+            pairs = (resolve_routing(comm, source, None, what="recv")
+                     if source is not None else None)
+            annotate(pairs=pairs)
+            res = as_varying(template, comm.axes)
+            if status is not None and pairs:
+                _fill_status(status, pairs, comm, res.size, res.dtype, tag)
+            return res, produce(token, res)
         ctx = current_context()
         q = ctx.queue(comm.uid, tag)
         if not q:

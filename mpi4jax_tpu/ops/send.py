@@ -108,6 +108,7 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
 
     def body(comm, arrays, token):
         from ..analysis.hook import annotate
+        from ..analysis.schedule import concretizing
 
         (xl,) = arrays
         pairs = resolve_routing(comm, None, dest, what="send")  # GLOBAL
@@ -115,6 +116,13 @@ def send(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
         xl = consume(token, xl)
         log_op("MPI_Send", comm.Get_rank(),
                f"{xl.size} items along {list(pairs)} (tag {tag})")
+        if concretizing():
+            # per-rank schedule trace (analysis/crossrank.py): record the
+            # send one-sided — the cross-rank matcher pairs it with the
+            # peer rank's recv; the region queue must stay empty so a
+            # rank whose schedule legitimately holds only this side does
+            # not trip the single-trace MPX101 drain check
+            return (produce(token, xl),)
         ctx = current_context()
         ctx.queue(comm.uid, tag).append(PendingSend(xl, pairs, token))
         return (produce(token, xl),)

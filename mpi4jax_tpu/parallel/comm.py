@@ -134,7 +134,18 @@ class Comm:
         all ranks, so the rank is a traced scalar.  Use it for data
         (coordinates, masks); structural choices (roots, routing) take static
         Python values.
+
+        Exception: while the cross-rank verifier re-traces the program
+        for ONE rank (``mpx.analyze(ranks=...)`` or the ambient
+        cross-rank pass — analysis/schedule.py), the rank is that rank's
+        concrete Python int, so rank-dependent branches take their real
+        per-rank paths.
         """
+        from ..analysis.schedule import concrete_comm_rank
+
+        concrete = concrete_comm_rank(self._axes)
+        if concrete is not None:
+            return concrete
         rank = lax.axis_index(self._axes[0])
         for a in self._axes[1:]:
             rank = rank * lax.axis_size(a) + lax.axis_index(a)
@@ -346,10 +357,17 @@ class GroupComm(Comm):
         return size
 
     def Get_rank(self):
-        """Group-local rank (traced), per MPI_Comm_split semantics."""
+        """Group-local rank (traced), per MPI_Comm_split semantics.
+        Concrete (a Python int, via the static group tables) while the
+        cross-rank verifier re-traces for one rank — see ``Comm.Get_rank``."""
+        g = self.global_rank()
+        if isinstance(g, int):
+            from ..analysis.schedule import RankConcrete
+
+            return RankConcrete(self._lrank[g])
         import jax.numpy as jnp
 
-        return jnp.asarray(self._lrank)[self.global_rank()]
+        return jnp.asarray(self._lrank)[g]
 
     rank = Get_rank
     size = Get_size

@@ -93,6 +93,19 @@ def normalize_dest(spec: RankSpecLike, size: int, *,
             "program serves all ranks); if you are passing it through jit, "
             "mark it static (static_argnums).",
         )
+    from ..analysis.schedule import is_rank_concrete
+
+    if is_rank_concrete(spec):
+        # the cross-rank verifier's concretized rank: structure must stay
+        # rank-uniform even in a per-rank re-trace (the traced-rank form
+        # of this mistake raises the same code just above)
+        raise mpx_error(
+            TypeError, "MPX104",
+            f"{what}: routing spec is the comm rank (concretized for "
+            "per-rank analysis). Routing is structure — it must be "
+            "rank-uniform static values describing the whole pattern "
+            "(pairs/shift/dict), not a per-rank destination.",
+        )
     if isinstance(spec, int):
         raise mpx_error(
             TypeError, "MPX103",

@@ -199,12 +199,28 @@ def spmd(
             # half comes pre-parsed and hash-cached from the dispatch fast
             # path (ops/_base.dynamic_cache_token): a warm call re-parses
             # no environment flags.
-            from ..ops._base import dynamic_cache_token
+            from ..ops._base import _dynamic_state
             from ..telemetry import core as _telemetry
 
+            dyn_token, analysis_off, _ = _dynamic_state()
             key = (c.mesh, c.uid, statics, static_vals, kw_names, n_dyn,
-                   dynamic_cache_token())
+                   dyn_token)
             sm = program_cache.get(key)
+            if not analysis_off:
+                # ambient cross-rank pass (analysis/crossrank.py): runs
+                # per CALL, not per program-cache miss — jit retraces
+                # internally on new argument shapes without missing this
+                # cache, and a shape-dependent rank-divergent path must
+                # still be verified before it compiles.  Memoized by
+                # avals + config inside, so warm calls cost one memo
+                # lookup; with the verifier off (the default) this
+                # branch is a single memoized-flag test.
+                from ..analysis import crossrank as _crossrank
+
+                _crossrank.verify_region_crossrank(
+                    f, comm=comm, in_specs=in_specs, out_specs=out_specs,
+                    static_argnums=statics_raw, c=c, args=args,
+                    kwargs=kwargs)
             if sm is not None:
                 _telemetry.meter("spmd_cache.hits")
             else:

@@ -128,6 +128,17 @@ FLAGS = {
              "``AnalysisError`` instead.  ``off`` (default) records "
              "nothing; the lowered HLO is byte-identical in every mode.",
              choices=ANALYZE_MODES),
+        Flag("MPI4JAX_TPU_ANALYZE_RANKS", "str", "auto",
+             "Cross-rank schedule verification (analysis/crossrank.py) "
+             "under ``MPI4JAX_TPU_ANALYZE=warn|error``: each spmd "
+             "region is re-traced once per rank at trace time and the "
+             "per-rank schedules are matched for deadlock/progress "
+             "(MPX120-MPX125).  ``auto`` (default) runs the pass "
+             "whenever the comm's size is statically known; ``off`` "
+             "disables it; a positive integer N runs it only for comms "
+             "of at most N ranks (a cost cap — the pass re-traces once "
+             "per rank).  ``python -m mpi4jax_tpu.analysis --ranks N`` "
+             "sets this."),
         Flag("MPI4JAX_TPU_TELEMETRY", "choice", "off",
              "Runtime telemetry tier (telemetry/): ``counters`` keeps "
              "host-side per-(op, comm, algo, dtype) call/byte counters "
@@ -408,6 +419,30 @@ def analyze_mode() -> str:
     """Trace-time collective verifier mode (``MPI4JAX_TPU_ANALYZE``):
     ``off`` (default) / ``warn`` / ``error`` — see mpi4jax_tpu/analysis/."""
     return _parse_env_choice("MPI4JAX_TPU_ANALYZE")
+
+
+def analyze_ranks():
+    """Cross-rank pass setting (``MPI4JAX_TPU_ANALYZE_RANKS``):
+    ``"auto"`` (default), ``"off"``, or a positive int cap on the comm
+    sizes the ambient per-rank re-trace covers."""
+    raw = (_getenv("MPI4JAX_TPU_ANALYZE_RANKS") or "").strip().lower()
+    if not raw or raw == "auto":
+        return "auto"
+    if raw == "off":
+        return "off"
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"Environment variable MPI4JAX_TPU_ANALYZE_RANKS={raw!r} must "
+            "be 'auto', 'off', or a positive integer rank cap"
+        ) from None
+    if val < 1:
+        raise ValueError(
+            f"Environment variable MPI4JAX_TPU_ANALYZE_RANKS={raw!r} must "
+            "be 'auto', 'off', or a positive integer rank cap"
+        )
+    return val
 
 
 def telemetry_mode() -> str:

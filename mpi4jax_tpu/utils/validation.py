@@ -15,6 +15,11 @@ import numpy as np
 
 import jax.core
 
+# dependency-free by contract (no cycle), and needed on the per-call
+# validation path below — module-level so the hot loop pays no repeated
+# import-machinery lookups
+from ..analysis.schedule import is_rank_concrete
+
 
 def _type_name(t) -> str:
     if isinstance(t, tuple):
@@ -56,6 +61,25 @@ def enforce_types(**type_specs):
             for name, spec in norm.items():
                 val = bound.arguments[name]
                 if isinstance(val, spec):
+                    if is_rank_concrete(val):
+                        # the cross-rank verifier's concretized rank: an
+                        # int for data, but structure must stay
+                        # rank-uniform — a per-rank re-trace must refuse
+                        # exactly what the real (traced-rank) trace
+                        # refuses (analysis/schedule.RankConcrete)
+                        from ..analysis.report import mpx_error
+
+                        raise mpx_error(
+                            TypeError, "MPX104",
+                            f"{fn.__name__}: argument {name!r} is the "
+                            "comm rank (concretized for per-rank "
+                            "analysis); structural arguments like "
+                            "roots, tags, and routing specs must be "
+                            "rank-uniform static Python values — one "
+                            "program's structure serves all ranks. Use "
+                            "a static value, or derive per-rank DATA "
+                            "from the rank instead.",
+                        )
                     continue
                 if isinstance(val, jax.core.Tracer):
                     # Ref: mpi4jax/_src/validation.py:77-88 — the "abstract

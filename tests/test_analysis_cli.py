@@ -1,0 +1,154 @@
+"""``python -m mpi4jax_tpu.analysis`` CLI: the exit-code contract.
+
+Subprocess tests pinning all three exit codes (docs/analysis.md):
+
+- 0 — scripts analyzed, no error-severity finding;
+- 1 — at least one error-severity finding (a clean JSON payload with
+  the findings is still printed under ``--json``);
+- 2 — usage error / a script failing outside the verifier.
+
+Plus the ``--json`` payload shape (scripts' own stdout is redirected to
+stderr so the payload owns stdout) and ``--ranks`` plumbing into
+``MPI4JAX_TPU_ANALYZE_RANKS``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from envcheck import jax_meets_package_floor, subprocess_import_skip_reason
+
+pytestmark = pytest.mark.skipif(
+    not jax_meets_package_floor(), reason=subprocess_import_skip_reason()
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(tmp_path, script_body, *flags, name="script.py"):
+    path = tmp_path / name
+    path.write_text(script_body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("MPI4JAX_TPU_ANALYZE", None)
+    env.setdefault(
+        "XLA_FLAGS",
+        "--xla_force_host_platform_device_count=8")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analysis", *flags, str(path)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+
+
+_CLEAN = """
+import jax
+import mpi4jax_tpu as mpx
+
+mesh = mpx.make_world_mesh(devices=jax.devices())
+comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+
+@mpx.spmd(comm=comm)
+def step(x):
+    out, _ = mpx.allreduce(x, comm=comm)
+    return mpx.varying(out)
+
+import jax.numpy as jnp
+x = jnp.stack([jnp.full((8,), float(r)) for r in range(comm.Get_size())])
+print("ran:", step(x).shape)
+"""
+
+_DIRTY = """
+import jax
+import jax.numpy as jnp
+import mpi4jax_tpu as mpx
+
+mesh = mpx.make_world_mesh(devices=jax.devices())
+comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+
+@mpx.spmd(comm=comm)
+def step(x):
+    t = mpx.create_token()
+    a, t1 = mpx.allreduce(x, token=t, comm=comm)
+    b, t2 = mpx.allreduce(x * 2, token=t, comm=comm)  # forked token
+    return mpx.varying(a + b)
+
+x = jnp.stack([jnp.full((8,), float(r)) for r in range(comm.Get_size())])
+step(x)
+"""
+
+_BROKEN = """
+raise ImportError("this script cannot even start")
+"""
+
+
+def test_exit_0_on_clean_script(tmp_path):
+    res = _run_cli(tmp_path, _CLEAN, "--ranks", "8")
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "no errors" in res.stderr
+
+
+def test_exit_1_on_error_finding_with_json(tmp_path):
+    res = _run_cli(tmp_path, _DIRTY, "--ranks", "8", "--json")
+    assert res.returncode == 1, res.stderr[-3000:]
+    payload = json.loads(res.stdout)  # script prints went to stderr
+    assert payload["errors"] >= 1
+    findings = [f for rep in payload["reports"] for f in rep["findings"]]
+    assert any(f["code"] == "MPX107" for f in findings)
+    assert all({"code", "severity", "message", "op", "index", "rank",
+                "seq"} <= set(f) for f in findings)
+
+
+def test_exit_1_on_seeded_crossrank_deadlock():
+    # the seeded rank-divergent deadlock example must FAIL the CLI with
+    # MPX121 in the payload (the CI lane asserts the same)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("MPI4JAX_TPU_ANALYZE", None)
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analysis", "--ranks", "8",
+         "--json", "examples/broken/rank_divergent_deadlock.py"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert res.returncode == 1, res.stderr[-3000:]
+    payload = json.loads(res.stdout)
+    codes = {f["code"] for rep in payload["reports"]
+             for f in rep["findings"]}
+    assert "MPX121" in codes
+
+
+def test_sys_exit_does_not_bypass_exit_code_contract(tmp_path):
+    # a script ending in sys.exit(0) must not launder away its error
+    # findings: the CLI's contract decides the process exit
+    res = _run_cli(tmp_path, _DIRTY + "\nimport sys\nsys.exit(0)\n",
+                   "--json")
+    assert res.returncode == 1, res.stderr[-3000:]
+    payload = json.loads(res.stdout)
+    assert payload["errors"] >= 1
+
+
+def test_exit_2_on_trace_failure(tmp_path):
+    res = _run_cli(tmp_path, _BROKEN)
+    assert res.returncode == 2, res.stderr[-3000:]
+    assert "ImportError" in res.stderr
+
+
+def test_exit_2_on_usage_error(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analysis"],  # no scripts
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert res.returncode == 2
+    assert "usage:" in res.stderr
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analysis", "--bogus", "x.py"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert res.returncode == 2

@@ -43,7 +43,8 @@ def _load_isolated():
         setattr(root, sub, m)
     for mod in ("utils.config", "ops._fusion", "analysis.report",
                 "analysis.graph", "analysis.checkers", "analysis.walker",
-                "analysis.hook", "parallel.rankspec"):
+                "analysis.hook", "analysis.schedule", "analysis.matcher",
+                "analysis.progress", "parallel.rankspec"):
         importlib.import_module(f"{_ISO_NAME}.{mod}")
     return root
 
@@ -71,10 +72,18 @@ def codes_of(g):
 
 
 def test_catalog_is_fully_owned():
-    # every code is emitted by a graph checker, except MPX108 which the
-    # jaxpr walker owns (control-flow structure is invisible to the
-    # event stream)
-    assert checkers.registered_codes() | {"MPX108"} == set(report.CODES)
+    # every code is emitted by a graph checker, except MPX108 (the jaxpr
+    # walker owns it: control-flow structure is invisible to the event
+    # stream) and the cross-rank codes (the schedule matcher and the
+    # progress checker own those — analysis/matcher.py + progress.py)
+    matcher = sys.modules[f"{_ISO_NAME}.analysis.matcher"]
+    progress = sys.modules[f"{_ISO_NAME}.analysis.progress"]
+    crossrank_owned = set(matcher.CROSSRANK_CODES) | set(
+        progress.CROSSRANK_CODES)
+    assert (checkers.registered_codes() | {"MPX108"} | crossrank_owned
+            == set(report.CODES))
+    # the two registries never claim the same code
+    assert not crossrank_owned & checkers.registered_codes()
 
 
 def test_codes_have_severity_and_docs():
@@ -581,10 +590,16 @@ def test_analyze_mode_parsing():
 
 def test_mode_override_and_cache_token():
     assert hook.effective_mode() == "off"
-    assert hook.analysis_cache_token() == ("off",)
+    assert hook.analysis_cache_token() == ("off", "auto")
     hook.set_analyze_mode("error")
     assert hook.effective_mode() == "error"
-    assert hook.analysis_cache_token() == ("error",)
+    assert hook.analysis_cache_token() == ("error", "auto")
+    # the cross-rank setting is part of the token: flipping it retraces
+    os.environ["MPI4JAX_TPU_ANALYZE_RANKS"] = "off"
+    try:
+        assert hook.analysis_cache_token() == ("error", "off")
+    finally:
+        del os.environ["MPI4JAX_TPU_ANALYZE_RANKS"]
     hook.set_analyze_mode(None)
     os.environ["MPI4JAX_TPU_ANALYZE"] = "warn"
     assert hook.effective_mode() == "warn"

@@ -1,0 +1,369 @@
+"""Cross-rank schedule verifier: the traced integration half.
+
+Real 8-device programs through both front-ends (docs/analysis.md
+"Cross-rank verification"):
+
+- ``mpx.analyze(fn, *args, ranks='all')`` — per-rank re-trace with
+  ``comm.Get_rank`` concretized, global matching, progress checking;
+- the ambient ``MPI4JAX_TPU_ANALYZE=error`` path — the same pass at
+  spmd trace time, before anything compiles.
+
+Includes the seeded rank-divergent ``lax.cond`` deadlock
+(examples/broken/rank_divergent_deadlock.py drives the same program),
+a cross-host hierarchical program under a faked 2x4 topology, clean
+full-scale programs (halo rings, split comms, fusion, start/wait), and
+the HLO byte-identity pin with the cross-rank pass armed.  The pure
+matcher/progress matrix lives in tests/test_crossrank_pure.py.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.analysis import crossrank, schedule
+from helpers import ranks_arange, world
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "examples", "broken"))
+
+
+@pytest.fixture(autouse=True)
+def _reset_analysis(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE", raising=False)
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE_RANKS", raising=False)
+    yield
+    mpx.set_analyze_mode(None)
+    mpx.clear_caches()
+
+
+def codes(report):
+    return [f.code for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# rank concretization through the real Comm
+# ---------------------------------------------------------------------------
+
+
+def test_get_rank_concretizes_inside_scope():
+    comm, size = world()
+    with schedule.scope(comm.axes, [size], 3):
+        assert comm.Get_rank() == 3
+        assert comm.global_rank() == 3
+    # and returns to traced behavior outside
+    assert not schedule.concretizing()
+
+
+def test_group_comm_rank_concretizes():
+    comm, size = world()
+    split = comm.Split([r % 2 for r in range(size)])
+    with schedule.scope(comm.axes, [size], 5):
+        assert split.Get_rank() == 2  # rank 5 is the 3rd odd rank
+        assert split.global_rank() == 5
+
+
+# ---------------------------------------------------------------------------
+# the seeded rank-divergent cond deadlock (both front-ends)
+# ---------------------------------------------------------------------------
+
+
+def _divergent_exchange(comm):
+    from rank_divergent_deadlock import build_exchange
+
+    return build_exchange(comm)
+
+
+def test_seeded_deadlock_flagged_mpx121_by_analyze():
+    comm, size = world()
+    exchange = _divergent_exchange(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(exchange, x, comm=comm, ranks="all")
+    assert "MPX121" in codes(report)
+    cycles = [f for f in report.findings if f.code == "MPX121"]
+    # one 2-rank cycle per even/odd pair
+    assert len(cycles) == size // 2
+    f = min(cycles, key=lambda f: f.rank)
+    # the cycle is rendered rank-by-rank
+    assert "rank 0: blocked at recv" in f.message
+    assert "waits for rank 1" in f.message
+    assert f.severity == "error"
+    assert report.meta["ranks"] == list(range(size))
+
+
+def test_seeded_deadlock_flagged_by_env_error_path():
+    comm, _ = world()
+    exchange = _divergent_exchange(comm)
+    x = ranks_arange((16,))
+    mpx.set_analyze_mode("error")
+    with pytest.raises(mpx.AnalysisError) as ei:
+        mpx.run(exchange, x, comm=comm)
+    assert any(f.code == "MPX121" for f in ei.value.findings)
+
+
+def test_env_warn_path_warns_not_raises():
+    comm, _ = world()
+    exchange = _divergent_exchange(comm)
+    x = ranks_arange((16,))
+    mpx.set_analyze_mode("warn")
+    with pytest.warns(UserWarning, match="MPX121"):
+        # the cross-rank pass warns at trace time; the normal trace then
+        # raises MPX102 (the divergent cond's recv has no queued send in
+        # the single-program model) — both behaviors are the contract
+        with pytest.raises(RuntimeError, match="MPX102"):
+            mpx.run(exchange, x, comm=comm)
+
+
+def test_env_ranks_off_disables_ambient_pass(monkeypatch):
+    comm, _ = world()
+    exchange = _divergent_exchange(comm)
+    x = ranks_arange((16,))
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", "off")
+    mpx.set_analyze_mode("error")
+    # without the cross-rank pass the divergent cond surfaces as the
+    # single-trace MPX102 instead
+    with pytest.raises(RuntimeError, match="MPX102"):
+        mpx.run(exchange, x, comm=comm)
+
+
+def test_env_ranks_cap_gates_by_world(monkeypatch):
+    comm, size = world()
+    exchange = _divergent_exchange(comm)
+    x = ranks_arange((16,))
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", str(size - 1))
+    mpx.set_analyze_mode("error")
+    with pytest.raises(RuntimeError, match="MPX102"):  # capped out
+        mpx.run(exchange, x, comm=comm)
+    mpx.clear_caches()
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", str(size))
+    with pytest.raises(mpx.AnalysisError):  # within the cap
+        mpx.run(exchange, x, comm=comm)
+
+
+# ---------------------------------------------------------------------------
+# divergent collective orders (MPX120 / MPX123) through analyze(ranks=)
+# ---------------------------------------------------------------------------
+
+
+def test_divergent_collective_interleave_mpx120():
+    from jax import lax
+
+    comm, _ = world()
+    sub = comm.Clone()
+
+    def step(x):
+        r = comm.Get_rank()
+
+        def even(v):
+            a, t = mpx.allreduce(v, comm=comm)
+            b, _ = mpx.allreduce(a, comm=sub, token=t)
+            return b
+
+        def odd(v):
+            a, t = mpx.allreduce(v, comm=sub)
+            b, _ = mpx.allreduce(a, comm=comm, token=t)
+            return b
+
+        return lax.cond(r % 2 == 0, even, odd, x)
+
+    report = mpx.analyze(step, ranks_arange((8,)), comm=comm, ranks="all")
+    assert "MPX120" in codes(report)
+
+
+def test_orphaned_rank_mpx123():
+    from jax import lax
+
+    comm, _ = world()
+
+    def step(x):
+        r = comm.Get_rank()
+
+        def zero(v):
+            return v * 2.0  # rank 0 skips the collective entirely
+
+        def rest(v):
+            out, _ = mpx.allreduce(v, comm=comm)
+            return out
+
+        return lax.cond(r == 0, zero, rest, x)
+
+    report = mpx.analyze(step, ranks_arange((8,)), comm=comm, ranks="all")
+    assert "MPX123" in codes(report)
+    (f,) = [f for f in report.findings if f.code == "MPX123"]
+    assert f.rank == 0
+
+
+def test_rank_as_structure_stays_mpx104_under_ranks():
+    # concretization must not LAUNDER the rank into a valid static root:
+    # the per-rank re-trace refuses rank-as-structure exactly like the
+    # traced-rank form (analysis/schedule.RankConcrete), instead of
+    # reporting the divergent roots as MPX120
+    comm, _ = world()
+
+    def step(x):
+        out, _ = mpx.bcast(x, comm.Get_rank(), comm=comm)
+        return out
+
+    report = mpx.analyze(step, ranks_arange((8,)), comm=comm, ranks="all")
+    assert "MPX104" in codes(report)
+    assert "MPX120" not in codes(report)
+    # rank-DERIVED statics are fine: a Python branch on parity picking a
+    # uniform static root is the supported idiom
+    def ok(x):
+        r = comm.Get_rank()
+        root = 0 if r % 2 == 0 else 0  # derived, uniform
+        out, _ = mpx.bcast(x, root, comm=comm)
+        return out
+
+    assert mpx.analyze(ok, ranks_arange((8,)), comm=comm, ranks="all").ok
+
+
+def test_ranks_subset_and_int():
+    comm, size = world()
+
+    def step(x):
+        out, _ = mpx.allreduce(x, comm=comm)
+        return out
+
+    x = ranks_arange((8,))
+    assert mpx.analyze(step, x, comm=comm, ranks=size).ok
+    assert mpx.analyze(step, x, comm=comm, ranks=[0, 1]).ok
+    with pytest.raises(ValueError, match="out of range"):
+        mpx.analyze(step, x, comm=comm, ranks=size + 1)
+    with pytest.raises(ValueError, match="region-style"):
+        mpx.analyze(step, x, comm=comm, ranks="all", wrap=False)
+
+
+# ---------------------------------------------------------------------------
+# clean full-scale programs stay clean
+# ---------------------------------------------------------------------------
+
+
+def test_clean_halo_ring_and_split_and_fusion():
+    comm, size = world()
+    half = comm.Split([r % 2 for r in range(size)])
+
+    def step(x):
+        # sendrecv halo ring (send-then-recv per rank: buffered-safe)
+        halo, t = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=comm)
+        # whole-comm then split-comm collectives, token-chained
+        a, t = mpx.allreduce(x + halo, comm=comm, token=t)
+        b, t = mpx.allreduce(a, comm=half, token=t)
+        c, _ = mpx.bcast(b, 0, comm=comm, token=t)
+        return c
+
+    report = mpx.analyze(step, ranks_arange((16,)), comm=comm, ranks="all")
+    assert report.ok, report.render()
+
+
+def test_clean_start_wait_overlap():
+    comm, _ = world()
+
+    def step(x):
+        h = mpx.allreduce_start(x, comm=comm)
+        y = x * 3.0
+        out, _ = mpx.allreduce_wait(h)
+        return out + y
+
+    report = mpx.analyze(step, ranks_arange((64,)), comm=comm, ranks="all")
+    assert report.ok, report.render()
+
+
+def test_cross_host_hier_program_clean(monkeypatch):
+    # the hierarchical_demo-style program under a faked 2x4 pod: the
+    # two-level plan must agree on every rank (no MPX125) and the
+    # schedules must match clean
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", "2x4")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "hier")
+    mpx.clear_caches()
+    comm, size = world()
+
+    def step(v, b):
+        s, tok = mpx.allreduce(v, op=mpx.PROD)
+        c, tok = mpx.bcast(b[0], root=1, token=tok)
+        d, _ = mpx.reduce_scatter(b, op=mpx.SUM, token=tok)
+        return mpx.varying(s), mpx.varying(c), mpx.varying(d)
+
+    v = ranks_arange((4096,))
+    b = jnp.stack([
+        jnp.arange(size * 8, dtype=jnp.float32).reshape(size, 8) + r
+        for r in range(size)
+    ])
+    report = mpx.analyze(step, v, b, comm=comm, ranks="all")
+    assert report.ok, report.render()
+    # the hier plan was actually recorded and agreed on
+    hiers = {e.hier for e in report.events if e.op == "allreduce"}
+    assert (2, 4) in hiers
+
+
+def test_examples_style_program_through_env_error():
+    comm, _ = world()
+    mpx.set_analyze_mode("error")
+
+    @mpx.spmd(comm=comm)
+    def step(x):
+        halo, t = mpx.sendrecv(x, x, dest=mpx.shift(1), comm=comm)
+        out, _ = mpx.allreduce(x + halo, comm=comm, token=t)
+        return mpx.varying(out)
+
+    out = step(ranks_arange((8,)))  # traces + runs clean
+    assert np.asarray(out).shape[0] == world()[1]
+
+
+# ---------------------------------------------------------------------------
+# zero-cost + memo contracts
+# ---------------------------------------------------------------------------
+
+
+def _lowered_text(fn, *args):
+    return jax.jit(fn).lower(*args).as_text()
+
+
+def test_hlo_byte_identical_across_modes_with_crossrank():
+    # the ambient cross-rank pass is pure host-side re-tracing: the
+    # lowered HLO must stay byte-identical in off/warn/error (the
+    # acceptance pin; the per-checker version lives in test_analysis.py)
+    from mpi4jax_tpu.parallel.region import spmd
+
+    comm, _ = world()
+    x = ranks_arange((8,))
+    texts = {}
+    for mode in (None, "warn", "error"):
+        mpx.set_analyze_mode(mode)
+        mpx.clear_caches()
+        twin = spmd(lambda v: mpx.varying(mpx.allreduce(v, comm=comm)[0]),
+                    comm=comm, jit=False)
+        texts[mode] = _lowered_text(twin, x)
+    assert texts[None] == texts["warn"] == texts["error"]
+
+
+def test_ambient_pass_memoized_per_program(monkeypatch):
+    comm, _ = world()
+    calls = {"n": 0}
+    orig = crossrank._run_region_pass
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(crossrank, "_run_region_pass", counting)
+    mpx.set_analyze_mode("warn")
+
+    @mpx.spmd(comm=comm)
+    def step(x):
+        out, _ = mpx.allreduce(x, comm=comm)
+        return mpx.varying(out)
+
+    x = ranks_arange((8,))
+    step(x)
+    step(x)  # warm call: the avals-keyed memo answers, no new pass
+    assert calls["n"] == 1
+    step(ranks_arange((16,)))  # new shapes: jit retraces AND so do we
+    assert calls["n"] == 2
+    mpx.clear_caches()
+    step(x)  # memo dropped: the pass re-runs even on a cached program
+    assert calls["n"] == 3

@@ -1,0 +1,679 @@
+"""Cross-rank schedule verifier: the pure-Python half (docs/analysis.md
+"Cross-rank verification").
+
+Positive/negative matrix for MPX120–MPX125 (plus the cross-rank reuses
+of MPX101/102/106) driven by hand-built per-rank schedules through the
+matcher (analysis/matcher.py) and the progress checker
+(analysis/progress.py), plus the rank-concretization scope and the
+schedule builder (analysis/schedule.py) — all loaded under a private
+package name (the tests/test_analysis_pure.py isolated loader) so these
+run even where the installed JAX is below the package's floor.  The
+traced integration half — real 8-device programs through
+``mpx.analyze(ranks='all')`` and the ambient env path — lives in
+tests/test_crossrank.py.
+"""
+
+import importlib
+import pathlib
+import sys
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_crossrank_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "analysis", "ops", "parallel"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "ops._fusion", "analysis.report",
+                "analysis.graph", "analysis.checkers", "analysis.schedule",
+                "analysis.matcher", "analysis.progress",
+                "parallel.rankspec"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+report = sys.modules[f"{_ISO_NAME}.analysis.report"]
+graph = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+schedule = sys.modules[f"{_ISO_NAME}.analysis.schedule"]
+matcher = sys.modules[f"{_ISO_NAME}.analysis.matcher"]
+progress = sys.modules[f"{_ISO_NAME}.analysis.progress"]
+
+S = schedule.SchedOp
+E = graph.CollectiveEvent
+
+
+def verify(schedules):
+    """matcher + progress, returning the finding codes in order."""
+    m = matcher.match_schedules(schedules)
+    return [f.code for f in m.findings + progress.check_progress(m)]
+
+
+def coll(rank, pos, seq, ck=0, op="allreduce", parts=(0, 1), **kw):
+    return S(rank=rank, pos=pos, kind="coll", op=op, comm_key=ck, seq=seq,
+             participants=parts, **kw)
+
+
+def send(rank, pos, dst, tag=0, ck=0, **kw):
+    return S(rank=rank, pos=pos, kind="send", op="send", comm_key=ck,
+             src=rank, dst=dst, tag=tag, **kw)
+
+
+def recv(rank, pos, src, tag=0, ck=0, **kw):
+    return S(rank=rank, pos=pos, kind="recv", op="recv", comm_key=ck,
+             src=src, dst=rank, tag=tag, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MPX120 — cross-rank collective order mismatch
+# ---------------------------------------------------------------------------
+
+
+def test_mpx120_kind_mismatch_fires():
+    codes = verify({
+        0: [coll(0, 0, 0, op="allreduce")],
+        1: [coll(1, 0, 0, op="bcast")],
+    })
+    assert "MPX120" in codes
+    m = matcher.match_schedules({
+        0: [coll(0, 0, 0, op="allreduce")],
+        1: [coll(1, 0, 0, op="bcast")],
+    })
+    (f,) = [x for x in m.findings if x.code == "MPX120"]
+    assert "allreduce" in f.message and "bcast" in f.message
+    assert f.severity == "error"
+    assert f.seq == 0
+
+
+def test_mpx120_root_and_reduction_mismatch_fire():
+    assert "MPX120" in verify({
+        0: [coll(0, 0, 0, op="bcast", root=0)],
+        1: [coll(1, 0, 0, op="bcast", root=1)],
+    })
+    assert "MPX120" in verify({
+        0: [coll(0, 0, 0, reduction="sum")],
+        1: [coll(1, 0, 0, reduction="max")],
+    })
+
+
+def test_mpx120_interleave_cycle_fires():
+    # comm A then B on rank 0; B then A on rank 1 — per-comm sequences
+    # agree, the INTERLEAVE deadlocks: the progress checker reports it
+    codes = verify({
+        0: [coll(0, 0, 0, ck=0), coll(0, 1, 0, ck=1)],
+        1: [coll(1, 0, 0, ck=1), coll(1, 1, 0, ck=0)],
+    })
+    assert codes == ["MPX120"]
+
+
+def test_mpx120_clean():
+    assert verify({
+        0: [coll(0, 0, 0), coll(0, 1, 1, op="bcast", root=0)],
+        1: [coll(1, 0, 0), coll(1, 1, 1, op="bcast", root=0)],
+    }) == []
+
+
+# ---------------------------------------------------------------------------
+# MPX121 — send/recv deadlock cycle
+# ---------------------------------------------------------------------------
+
+
+def test_mpx121_recv_cycle_fires_rank_by_rank():
+    m = matcher.match_schedules({
+        0: [recv(0, 0, src=1, tag=1), send(0, 1, dst=1, tag=0)],
+        1: [recv(1, 0, src=0, tag=0), send(1, 1, dst=0, tag=1)],
+    })
+    assert m.findings == []  # counts match; the ORDER deadlocks
+    (f,) = progress.check_progress(m)
+    assert f.code == "MPX121" and f.severity == "error"
+    # the full cycle, rendered rank-by-rank
+    assert "rank 0: blocked at recv(src=1, tag=1)" in f.message
+    assert "rank 1: blocked at recv(src=0, tag=0)" in f.message
+    assert "waits for rank" in f.message
+
+
+def test_mpx121_four_rank_ring_cycle():
+    # every rank recvs from its left neighbor before sending right
+    k = 4
+    scheds = {
+        r: [recv(r, 0, src=(r - 1) % k), send(r, 1, dst=(r + 1) % k)]
+        for r in range(k)
+    }
+    codes = verify(scheds)
+    assert codes == ["MPX121"]
+
+
+def test_mpx121_negative_buffered_exchange():
+    # send-then-recv head-to-head: safe under this library's buffered
+    # (deferred-pairing) sends — must NOT fire
+    assert verify({
+        0: [send(0, 0, dst=1), recv(0, 1, src=1)],
+        1: [send(1, 0, dst=0), recv(1, 1, src=0)],
+    }) == []
+
+
+def test_mpx121_negative_safe_ring():
+    # sendrecv-style: everyone sends first, then receives — clean
+    k = 4
+    assert verify({
+        r: [send(r, 0, dst=(r + 1) % k), recv(r, 1, src=(r - 1) % k)]
+        for r in range(k)
+    }) == []
+
+
+# ---------------------------------------------------------------------------
+# MPX122 — collective/p2p interleave deadlock
+# ---------------------------------------------------------------------------
+
+
+def test_mpx122_mixed_cycle_fires():
+    codes = verify({
+        0: [recv(0, 0, src=1), coll(0, 1, 0, ck=1)],
+        1: [coll(1, 0, 0, ck=1), send(1, 1, dst=0)],
+    })
+    assert codes == ["MPX122"]
+
+
+def test_mpx122_negative_ordered():
+    assert verify({
+        0: [coll(0, 0, 0, ck=1), recv(0, 1, src=1)],
+        1: [coll(1, 0, 0, ck=1), send(1, 1, dst=0)],
+    }) == []
+
+
+# ---------------------------------------------------------------------------
+# MPX123 — orphaned rank
+# ---------------------------------------------------------------------------
+
+
+def test_mpx123_orphan_fires():
+    m = matcher.match_schedules({
+        0: [coll(0, 0, 0, op="barrier")],
+        1: [],
+    })
+    (f,) = m.findings
+    assert f.code == "MPX123" and f.rank == 1 and f.seq == 0
+    assert "never issues" in f.message
+
+
+def test_mpx123_reported_once_per_rank_and_comm():
+    m = matcher.match_schedules({
+        0: [coll(0, 0, 0), coll(0, 1, 1), coll(0, 2, 2)],
+        1: [],
+    })
+    assert [f.code for f in m.findings] == ["MPX123"]
+
+
+def test_mpx123_negative_partial_analysis():
+    # analyzing a subset of the comm must not orphan the absent ranks
+    assert verify({
+        0: [coll(0, 0, 0, parts=(0, 1, 2, 3))],
+        1: [coll(1, 0, 0, parts=(0, 1, 2, 3))],
+    }) == []
+
+
+# ---------------------------------------------------------------------------
+# MPX124 / MPX125 — fusion bucketing and hierarchy plan divergence
+# ---------------------------------------------------------------------------
+
+
+def test_mpx124_divergent_bucketing_fires():
+    lay2 = (("float32", 16), ("float32", 16))
+    lay3 = lay2 + (("float32", 16),)
+    m = matcher.match_schedules({
+        0: [coll(0, 0, 0, fused=(2, 128, lay2))],
+        1: [coll(1, 0, 0, fused=(3, 192, lay3))],
+    })
+    (f,) = m.findings
+    assert f.code == "MPX124"
+    assert "2 member(s)" in f.message and "3 member(s)" in f.message
+
+
+def test_mpx124_negative_same_buckets():
+    lay = (("float32", 16),)
+    assert verify({
+        0: [coll(0, 0, 0, fused=(1, 64, lay))],
+        1: [coll(1, 0, 0, fused=(1, 64, lay))],
+    }) == []
+
+
+def test_mpx125_divergent_hier_plan_fires():
+    m = matcher.match_schedules({
+        0: [coll(0, 0, 0, hier=(2, 4))],
+        1: [coll(1, 0, 0, hier=(4, 2))],
+    })
+    (f,) = m.findings
+    assert f.code == "MPX125"
+    assert "2x4" in f.message and "4x2" in f.message
+    # hier vs flat is also a divergence
+    m = matcher.match_schedules({
+        0: [coll(0, 0, 0, hier=(2, 4))],
+        1: [coll(1, 0, 0, hier=None)],
+    })
+    assert [f.code for f in m.findings] == ["MPX125"]
+    assert "flat" in m.findings[0].message
+
+
+def test_mpx125_negative_agreeing_plans():
+    assert verify({
+        0: [coll(0, 0, 0, hier=(2, 4))],
+        1: [coll(1, 0, 0, hier=(2, 4))],
+    }) == []
+
+
+# ---------------------------------------------------------------------------
+# cross-rank reuses of MPX101 / MPX102 / MPX106
+# ---------------------------------------------------------------------------
+
+
+def test_crossrank_mpx101_unreceived_send():
+    m = matcher.match_schedules({
+        0: [send(0, 0, dst=1)],
+        1: [],
+    })
+    (f,) = m.findings
+    assert f.code == "MPX101" and f.rank == 0
+    assert "never received" in f.message
+
+
+def test_crossrank_mpx102_unsent_recv():
+    m = matcher.match_schedules({
+        0: [],
+        1: [recv(1, 0, src=0)],
+    })
+    (f,) = m.findings
+    assert f.code == "MPX102" and f.rank == 1
+
+
+def test_crossrank_mpx106_signature_mismatch():
+    m = matcher.match_schedules({
+        0: [send(0, 0, dst=1, dtype="float32", nelems=4)],
+        1: [recv(1, 0, src=0, dtype="int32", nelems=4)],
+    })
+    (f,) = m.findings
+    assert f.code == "MPX106"
+    assert "type-signature" in f.message
+    # equal element count, equal dtype: clean
+    assert verify({
+        0: [send(0, 0, dst=1, dtype="float32", nelems=4)],
+        1: [recv(1, 0, src=0, dtype="float32", nelems=4)],
+    }) == []
+
+
+def test_wildcard_recv_matches_any_sender():
+    assert verify({
+        0: [send(0, 0, dst=1)],
+        1: [recv(1, 0, src=None)],
+    }) == []
+    # but an unsatisfiable wildcard still fires MPX102
+    m = matcher.match_schedules({0: [], 1: [recv(1, 0, src=None)]})
+    assert [f.code for f in m.findings] == ["MPX102"]
+
+
+def test_fifo_channel_pairing_is_positional():
+    # two sends, two recvs on one channel: k-th pairs with k-th; a dtype
+    # flip on the SECOND pair only is exactly one MPX106
+    m = matcher.match_schedules({
+        0: [send(0, 0, dst=1, dtype="f32", nelems=4),
+            send(0, 1, dst=1, dtype="i32", nelems=4)],
+        1: [recv(1, 0, src=0, dtype="f32", nelems=4),
+            recv(1, 1, src=0, dtype="f32", nelems=4)],
+    })
+    assert [f.code for f in m.findings] == ["MPX106"]
+
+
+# ---------------------------------------------------------------------------
+# async start/wait progress semantics
+# ---------------------------------------------------------------------------
+
+
+def astart(rank, pos, seq, ck=0, parts=(0, 1)):
+    return S(rank=rank, pos=pos, kind="start", op="allreduce_start",
+             comm_key=ck, seq=seq, participants=parts, span=rank)
+
+
+def await_(rank, pos, seq, ck=0, parts=(0, 1)):
+    return S(rank=rank, pos=pos, kind="wait", op="allreduce_wait",
+             comm_key=ck, seq=seq, participants=parts, span=rank)
+
+
+def test_start_wait_clean_and_overlapping_compute():
+    assert verify({
+        r: [astart(r, 0, 0), await_(r, 1, 0)] for r in (0, 1)
+    }) == []
+    # start is nonblocking: issue, exchange p2p, then wait — clean
+    assert verify({
+        0: [astart(0, 0, 0), send(0, 1, dst=1), await_(0, 2, 0)],
+        1: [astart(1, 0, 0), recv(1, 1, src=0), await_(1, 2, 0)],
+    }) == []
+
+
+def test_wait_blocks_on_unissued_peer_start():
+    # rank 1 never starts: rank 0's wait can never complete (the orphan
+    # is the matcher's finding; no cycle is invented)
+    m = matcher.match_schedules({
+        0: [astart(0, 0, 0), await_(0, 1, 0)],
+        1: [],
+    })
+    assert [f.code for f in m.findings] == ["MPX123"]
+    assert progress.check_progress(m) == []
+
+
+# ---------------------------------------------------------------------------
+# the schedule builder (event stream -> per-rank SchedOps)
+# ---------------------------------------------------------------------------
+
+
+def test_build_schedule_projects_roles():
+    events = [
+        E(0, "allreduce", comm_uid=7, comm_size=2, reduction="sum"),
+        E(1, "send", comm_uid=7, tag=3, pairs=((0, 1),), shape=(4,),
+          dtype="float32"),
+        E(2, "recv", comm_uid=7, tag=3, pairs=((0, 1),), shape=(4,),
+          dtype="float32"),
+    ]
+    s0 = schedule.build_schedule(events, rank=0, world=2)
+    s1 = schedule.build_schedule(events, rank=1, world=2)
+    assert [o.kind for o in s0] == ["coll", "send"]
+    assert [o.kind for o in s1] == ["coll", "recv"]
+    assert s0[1].dst == 1 and s1[1].src == 0 and s1[1].tag == 3
+    assert s0[0].participants == (0, 1)
+    assert verify({0: s0, 1: s1}) == []
+
+
+def test_build_schedule_sendrecv_is_buffered_safe():
+    # one sendrecv event covering the whole ring: every rank gets a send
+    # entry before its recv entry — clean by construction
+    k = 4
+    ring = tuple((i, (i + 1) % k) for i in range(k))
+    events = [E(0, "sendrecv", comm_uid=1, comm_size=k, pairs=ring,
+                shape=(2,), dtype="f32")]
+    scheds = {r: schedule.build_schedule(events, rank=r, world=k)
+              for r in range(k)}
+    assert [o.kind for o in scheds[0]] == ["send", "recv"]
+    assert verify(scheds) == []
+
+
+def test_build_schedule_seq_per_comm_and_span_links():
+    events = [
+        E(0, "allreduce", comm_uid=5, comm_size=2),
+        E(1, "allreduce", comm_uid=9, comm_size=2),
+        E(2, "allreduce_start", comm_uid=5, comm_size=2, span=77),
+        E(3, "allreduce_wait", comm_uid=5, comm_size=2, span=77),
+    ]
+    (c0, c1, st, wt) = schedule.build_schedule(events, rank=0, world=2)
+    assert (c0.comm_key, c0.seq) == (("u", 5), 0)  # stable uid identity
+    assert (c1.comm_key, c1.seq) == (("u", 9), 0)  # own comm, own sequence
+    assert (st.kind, st.seq) == ("start", 1)  # comm 5's second instance
+    assert (wt.kind, wt.seq) == ("wait", 1)   # linked through the span
+    assert st.comm_key == wt.comm_key == ("u", 5)
+
+
+def test_build_schedule_split_groups_scope_membership():
+    groups = ((0, 1), (2, 3))
+    events = [E(0, "allreduce", comm_uid=2, comm_size=2, split=True,
+                groups=groups)]
+    s0 = schedule.build_schedule(events, rank=0, world=4)
+    s3 = schedule.build_schedule(events, rank=3, world=4)
+    assert s0[0].participants == (0, 1)
+    assert s3[0].participants == (2, 3)
+    # group-divergent schedules still verify independently
+    scheds = {r: schedule.build_schedule(events, rank=r, world=4)
+              for r in range(4)}
+    assert verify(scheds) == []
+
+
+def test_build_schedule_wildcard_recv():
+    events = [E(0, "recv", comm_uid=1, tag=2, pairs=None, shape=(4,),
+                dtype="f32")]
+    (op,) = schedule.build_schedule(events, rank=1, world=2)
+    assert op.kind == "recv" and op.src is None and op.tag == 2
+
+
+def test_recv_source_none_adopts_preceding_send_routing():
+    # the reference-compatible pattern: send(partial routing) then
+    # recv() adopting the queued send's pairs — the per-rank stream must
+    # reproduce the region queue's FIFO adoption, NOT record a blocking
+    # wildcard on every rank (which would false-fire MPX101/MPX102)
+    fan_in = ((1, 0), (2, 0), (3, 0))
+    events = [
+        E(0, "send", comm_uid=1, tag=0, pairs=fan_in, shape=(4,),
+          dtype="f32"),
+        E(1, "recv", comm_uid=1, tag=0, pairs=None, shape=(4,),
+          dtype="f32"),
+    ]
+    scheds = {r: schedule.build_schedule(events, rank=r, world=4)
+              for r in range(4)}
+    # rank 0: three recvs (one per adopted pair); ranks 1-3: one send
+    assert [o.kind for o in scheds[0]] == ["recv"] * 3
+    assert {o.src for o in scheds[0]} == {1, 2, 3}
+    for r in (1, 2, 3):
+        assert [o.kind for o in scheds[r]] == ["send"]
+    assert verify(scheds) == []
+    # adoption is FIFO per (comm, tag): a second recv() adopts the
+    # SECOND send, and an explicit-source recv consumes its queue slot
+    ring = ((0, 1), (1, 0))
+    events = [
+        E(0, "send", comm_uid=1, tag=0, pairs=ring, shape=(2,),
+          dtype="f32"),
+        E(1, "send", comm_uid=1, tag=0, pairs=ring, shape=(2,),
+          dtype="f32"),
+        E(2, "recv", comm_uid=1, tag=0, pairs=None, shape=(2,),
+          dtype="f32"),
+        E(3, "recv", comm_uid=1, tag=0, pairs=None, shape=(2,),
+          dtype="f32"),
+    ]
+    scheds = {r: schedule.build_schedule(events, rank=r, world=2)
+              for r in range(2)}
+    assert [o.kind for o in scheds[0]] == ["send", "send", "recv", "recv"]
+    # both sends are already queued when the first recv matches: the
+    # FIFO-ambiguity advisory replays cross-rank (one per rank), exactly
+    # like the single-trace MPX110 — and nothing error-severity fires
+    assert verify(scheds) == ["MPX110", "MPX110"]
+
+
+def test_mpx110_replay_fires_and_clean():
+    # ambiguous: two sends pending on one channel when the recv matches
+    scheds = {
+        0: [send(0, 0, dst=1), send(0, 1, dst=1)],
+        1: [recv(1, 0, src=0), recv(1, 1, src=0)],
+    }
+    m = matcher.match_schedules(scheds)
+    assert m.findings == []
+    fs = progress.check_progress(m)
+    assert [f.code for f in fs] == ["MPX110"]
+    assert fs[0].rank == 1 and "2 sends were pending" in fs[0].message
+    assert fs[0].severity == "advisory"
+    # sequential send/recv/send/recv: never two pending — clean
+    assert verify({
+        0: [send(0, 0, dst=1),
+            S(rank=0, pos=1, kind="coll", op="barrier", comm_key=0, seq=0,
+              participants=(0, 1))],
+        1: [recv(1, 0, src=0),
+            S(rank=1, pos=1, kind="coll", op="barrier", comm_key=0, seq=0,
+              participants=(0, 1))],
+    }) == []
+
+
+def test_comm_key_watermark_alignment():
+    # comms created BEFORE the analysis keep their uid identity: a
+    # rank-divergent program where rank 0 uses only comm B and rank 1
+    # only comm A must NOT match the two collectives as one instance
+    a = [E(0, "allreduce", comm_uid=5, comm_size=2,
+           groups=((0, 1),))]
+    b = [E(0, "allreduce", comm_uid=7, comm_size=2,
+           groups=((0, 1),))]
+    s0 = schedule.build_schedule(b, rank=0, world=2, uid_watermark=100)
+    s1 = schedule.build_schedule(a, rank=1, world=2, uid_watermark=100)
+    assert s0[0].comm_key != s1[0].comm_key
+    codes = verify({0: s0, 1: s1})
+    # each peer orphaned on the comm it never joins, and the mutual
+    # block in collectives on DIFFERENT comms is the interleave MPX120
+    assert codes == ["MPX123", "MPX123", "MPX120"], codes
+    # comms created DURING the trace (uid >= watermark, fresh per
+    # re-trace) align by creation order instead
+    t0 = schedule.build_schedule(
+        [E(0, "allreduce", comm_uid=101, comm_size=2, groups=((0, 1),))],
+        rank=0, world=2, uid_watermark=100)
+    t1 = schedule.build_schedule(
+        [E(0, "allreduce", comm_uid=102, comm_size=2, groups=((0, 1),))],
+        rank=1, world=2, uid_watermark=100)
+    assert t0[0].comm_key == t1[0].comm_key
+    assert verify({0: t0, 1: t1}) == []
+
+
+def test_build_schedule_unpaired_wait_skipped():
+    # an unpaired wait is MPX112's domain; the schedule must not invent
+    # an instance for it
+    events = [E(0, "allreduce_wait", comm_uid=1, comm_size=2, span=5)]
+    assert schedule.build_schedule(events, rank=0, world=2) == []
+
+
+# ---------------------------------------------------------------------------
+# the rank-concretization scope
+# ---------------------------------------------------------------------------
+
+
+def test_concrete_scope_coords_and_ranks():
+    with schedule.scope(("y", "x"), (2, 4), 6):
+        assert schedule.concretizing()
+        assert schedule.concrete_comm_rank(("y", "x")) == 6
+        assert schedule.concrete_comm_rank(("x",)) == 2
+        assert schedule.concrete_comm_rank(("y",)) == 1
+        assert schedule.concrete_comm_rank(("z",)) is None  # unknown axis
+    assert not schedule.concretizing()
+    assert schedule.concrete_comm_rank(("x",)) is None
+
+
+def test_groups_for_axes_partitions():
+    with schedule.scope(("y", "x"), (2, 4), 0):
+        assert schedule.groups_for_axes(("x",)) == ((0, 1, 2, 3),
+                                                    (4, 5, 6, 7))
+        assert schedule.groups_for_axes(("y",)) == ((0, 4), (1, 5),
+                                                    (2, 6), (3, 7))
+        assert schedule.groups_for_axes(("y", "x")) == (tuple(range(8)),)
+    assert schedule.groups_for_axes(("x",)) is None
+
+
+def test_scope_validates():
+    with pytest.raises(ValueError, match="out of range"):
+        schedule.ConcreteScope(("x",), (4,), 4)
+
+
+def test_rank_concrete_is_data_not_structure():
+    with schedule.scope(("i",), (8,), 5):
+        r = schedule.concrete_comm_rank(("i",))
+    # an int for every data use...
+    assert isinstance(r, int) and r == 5
+    assert (r % 2 == 0) is False
+    # ...but tagged, so structural validation still refuses it
+    assert schedule.is_rank_concrete(r)
+    # any derivation strips the tag: rank-derived values are statics
+    assert not schedule.is_rank_concrete(r % 2)
+    assert not schedule.is_rank_concrete(r ^ 1)
+    assert not schedule.is_rank_concrete(int(r))
+    assert not schedule.is_rank_concrete(5)
+
+
+def test_rankspec_refuses_concrete_rank_as_routing():
+    # the per-rank re-trace must refuse exactly what the traced-rank
+    # form refuses: rank-as-routing is MPX104 either way (a bare static
+    # int stays MPX103)
+    rankspec = sys.modules[f"{_ISO_NAME}.parallel.rankspec"]
+    r = schedule.RankConcrete(1)
+    with pytest.raises(TypeError, match=r"\[MPX104\]") as ei:
+        rankspec.normalize_dest(r, 4, what="send")
+    assert ei.value.mpx_code == "MPX104"
+    with pytest.raises(TypeError, match=r"\[MPX103\]"):
+        rankspec.normalize_dest(1, 4, what="send")
+
+
+# ---------------------------------------------------------------------------
+# rank-list normalization + report plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_rank_list():
+    # crossrank imports jax lazily, but its module imports hook (fine
+    # under the isolated loader too)
+    crossrank = importlib.import_module(f"{_ISO_NAME}.analysis.crossrank")
+    assert crossrank.resolve_rank_list("all", 4) == (0, 1, 2, 3)
+    assert crossrank.resolve_rank_list(2, 4) == (0, 1)
+    assert crossrank.resolve_rank_list([3, 1], 4) == (1, 3)
+    with pytest.raises(ValueError):
+        crossrank.resolve_rank_list(5, 4)
+    with pytest.raises(ValueError):
+        crossrank.resolve_rank_list([4], 4)
+    with pytest.raises(ValueError):
+        crossrank.resolve_rank_list([], 4)
+
+
+def test_analyze_ranks_flag_parsing(monkeypatch):
+    config = sys.modules[f"{_ISO_NAME}.utils.config"]
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE_RANKS", raising=False)
+    assert config.analyze_ranks() == "auto"
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", "off")
+    assert config.analyze_ranks() == "off"
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", "8")
+    assert config.analyze_ranks() == 8
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", "zero")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_ANALYZE_RANKS"):
+        config.analyze_ranks()
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", "0")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_ANALYZE_RANKS"):
+        config.analyze_ranks()
+
+
+def test_finding_and_report_to_json():
+    f = report.Finding(code="MPX121", message="cycle", suggestion="break",
+                       op="recv", index=3, rank=1, seq=0)
+    j = f.to_json()
+    assert j["code"] == "MPX121" and j["severity"] == "error"
+    assert j["rank"] == 1 and j["seq"] == 0
+    assert "deadlock" in j["title"]
+    rep = report.Report(findings=(f,), events=(1, 2), meta={"ranks": [0, 1]})
+    payload = rep.to_json()
+    assert payload["ok"] is False and payload["errors"] == 1
+    assert payload["codes"] == {"MPX121": 1}
+    assert payload["events"] == 2
+    assert payload["meta"]["ranks"] == [0, 1]
+    # json-serializable end to end
+    import json
+
+    json.dumps(payload)
+
+
+def test_report_sink_plumbing():
+    hook = importlib.import_module(f"{_ISO_NAME}.analysis.hook")
+    sink = []
+    hook.set_report_sink(sink)
+    try:
+        rep = report.Report(findings=(report.Finding("MPX121", "x"),))
+        hook.sink_report("here", rep)
+        assert sink == [("here", rep)]
+    finally:
+        hook.set_report_sink(None)
+    hook.sink_report("ignored", rep)  # no sink: no-op
+    assert len(sink) == 1
+
+
+def test_run_checkers_skip():
+    checkers = sys.modules[f"{_ISO_NAME}.analysis.checkers"]
+    g = graph.CollectiveGraph(events=[
+        E(0, "send", comm_uid=1, tag=0, dtype="f", shape=(1,)),
+    ])
+    assert [f.code for f in checkers.run_checkers(g)] == ["MPX101"]
+    assert checkers.run_checkers(g, skip=("MPX101",)) == []

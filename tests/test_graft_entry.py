@@ -13,6 +13,15 @@ import sys
 
 import pytest
 
+from envcheck import jax_meets_package_floor, subprocess_import_skip_reason
+
+# every test here spawns a subprocess that imports mpi4jax_tpu (via
+# __graft_entry__); below the package's jax floor that import refuses by
+# design, so the only observable outcome is the version error
+pytestmark = pytest.mark.skipif(
+    not jax_meets_package_floor(), reason=subprocess_import_skip_reason()
+)
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

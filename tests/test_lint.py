@@ -180,6 +180,65 @@ def test_registry_flags_are_wellformed():
             assert flag.choices and flag.default in flag.choices, name
 
 
+def _load_analysis_report():
+    """analysis/report.py under the lint's isolated package (it is
+    dependency-free by contract, so this works on any JAX)."""
+    name = f"{_ISO_NAME}.analysis"
+    if name not in sys.modules:
+        _load_config()  # ensure the root package exists
+        sub = types.ModuleType(name)
+        sub.__path__ = [str(REPO / "mpi4jax_tpu" / "analysis")]
+        sys.modules[name] = sub
+        importlib.import_module(f"{name}.report")
+    return sys.modules[f"{name}.report"]
+
+
+_MPX_RE = re.compile(r"MPX\d{3}")
+
+
+def test_mpx_codes_sync():
+    """MPX-code sync: every ``MPX\\d+`` code raised or annotated anywhere
+    under ``mpi4jax_tpu/`` must be declared in the ``report.CODES``
+    catalog AND appear in the docs/analysis.md checker catalog — and
+    vice versa (a stale catalog after new codes land, or a doc
+    mentioning a code the checkers no longer own, fails here)."""
+    rep = _load_analysis_report()
+    registry = set(rep.CODES)
+    src_codes = set()
+    src_where = {}
+    for path in PKG_SOURCES:
+        if path.name == "report.py" and path.parent.name == "analysis":
+            continue  # the declaration site itself proves nothing
+        for m in _MPX_RE.finditer(path.read_text()):
+            src_codes.add(m.group(0))
+            src_where.setdefault(m.group(0), str(path.relative_to(REPO)))
+    doc_codes = set(_MPX_RE.findall((REPO / "docs" / "analysis.md")
+                                    .read_text()))
+
+    undeclared = sorted(src_codes - registry)
+    assert not undeclared, (
+        "MPX codes referenced in mpi4jax_tpu/ but not declared in "
+        "analysis/report.py CODES: "
+        + ", ".join(f"{c} ({src_where[c]})" for c in undeclared)
+    )
+    unreferenced = sorted(registry - src_codes)
+    assert not unreferenced, (
+        "MPX codes declared in analysis/report.py CODES but never "
+        "raised/annotated anywhere under mpi4jax_tpu/: "
+        + ", ".join(unreferenced)
+    )
+    undocumented = sorted(registry - doc_codes)
+    assert not undocumented, (
+        "MPX codes missing from the docs/analysis.md checker catalog: "
+        + ", ".join(undocumented)
+    )
+    stale = sorted(doc_codes - registry)
+    assert not stale, (
+        "docs/analysis.md mentions MPX codes absent from the "
+        "analysis/report.py catalog (stale docs): " + ", ".join(stale)
+    )
+
+
 def test_docs_list_every_registered_flag():
     """Docs-sync: each declared flag must appear in the docs flag tables
     (docs/usage.md, docs/resilience.md, docs/observability.md,

@@ -18,6 +18,16 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+from envcheck import jax_meets_package_floor, subprocess_import_skip_reason
+
+# the 64-device subprocess imports mpi4jax_tpu; below the package's jax
+# floor that import refuses by design (container-environment-only failure)
+pytestmark = pytest.mark.skipif(
+    not jax_meets_package_floor(), reason=subprocess_import_skip_reason()
+)
+
 _SCRIPT = r"""
 import json, time
 import os
