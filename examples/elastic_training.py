@@ -1,0 +1,339 @@
+"""Elastic data-parallel training: survive rank loss and keep going.
+
+The acceptance drill for the elastic-recovery layer
+(docs/resilience.md "Elastic recovery"): a DP-SGD loop wrapped in
+``mpx.elastic.run`` with a ``ShardStore`` in-memory checkpoint.  When a
+rank dies (or hangs) mid-run, the survivors agree on the failed set,
+revoke the communication epoch, shrink the mesh/comm to "all minus
+failed", restore the last committed state from the surviving shard
+replicas, and finish the step budget on ``k - f`` ranks.
+
+Two modes:
+
+- **single process** (default): all local devices form the world; a
+  simulated :class:`RankFailure` fires at ``--fail-step`` and the mesh
+  shrinks in place —
+
+      python examples/elastic_training.py
+
+- **multi-process drill** (``--launch N``): N worker processes (one CPU
+  device each) over ``jax.distributed``; kill one with the fault
+  injector and the survivors re-bootstrap a smaller world —
+
+      MPI4JAX_TPU_FAULT_SPEC='die:rank=3:op=allreduce:after=5' \\
+        python examples/elastic_training.py --launch 4 --steps 12
+
+  The parent exits 0 iff a surviving majority completed the full step
+  budget.  Swap ``die`` for ``hang`` to drill the watchdog-expiry
+  detection path (the loop claims the expiry handler while it runs).
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+DONE_TAG = "ELASTIC_DONE"
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=12,
+                   help="total training steps to complete (the budget)")
+    p.add_argument("--commit-every", type=int, default=1,
+                   help="commit the state to the ShardStore every N steps")
+    p.add_argument("--fail-step", type=int, default=5,
+                   help="single-process mode: step at which the simulated "
+                        "failure fires (<0 disables)")
+    p.add_argument("--fail-rank", type=int, default=-1,
+                   help="single-process mode: rank to fail (-1 = last)")
+    p.add_argument("--out", default="",
+                   help="write the per-step loss trace as JSON here")
+    # multi-process drill plumbing
+    p.add_argument("--launch", type=int, default=0, metavar="N",
+                   help="launch an N-process world and run the drill")
+    p.add_argument("--process-id", type=int, default=-1,
+                   help=argparse.SUPPRESS)  # worker-internal
+    p.add_argument("--num-processes", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--port-base", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--watchdog", type=float, default=30.0,
+                   help="multi-process drill: watchdog timeout in seconds "
+                        "(the hang-drill detection bound)")
+    p.add_argument("--drill-timeout", type=float, default=540.0,
+                   help="--launch parent: seconds before the drill fails")
+    return p.parse_args(argv)
+
+
+# ---------------------------------------------------------------------------
+# the model + elastic step (shared by both modes)
+# ---------------------------------------------------------------------------
+
+
+def _init_params(dim=16, hidden=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w1": rng.normal(0, dim ** -0.5, (dim, hidden)).astype(np.float32),
+        "b1": np.zeros((hidden,), np.float32),
+        "w2": rng.normal(0, hidden ** -0.5, (hidden, 1)).astype(np.float32),
+        "b2": np.zeros((1,), np.float32),
+    }
+
+
+def _data_for(k, per_rank=32, dim=16, seed=1):
+    """Synthetic regression data with a leading rank axis, derived from
+    the CURRENT world size — after a shrink the survivors re-derive it
+    at k-f (every process computes the same arrays: same seed)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(k, per_rank, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, 1)).astype(np.float32)
+    y = np.tanh(x @ w).astype(np.float32)
+    return x, y
+
+
+def _make_elastic_step(mpx, lr=0.05):
+    """``step_fn(state, step, comm)`` for ``mpx.elastic.run``: builds (and
+    caches) one SPMD program per comm — after a shrink the new comm gets a
+    fresh program traced at the new size (the epoch in the cache key
+    guarantees the old one is unreachable anyway)."""
+    import jax
+    import jax.numpy as jnp
+
+    programs = {}
+
+    def train_step_for(comm):
+        key = (comm.uid, comm.epoch)
+        if key not in programs:
+            size = comm.Get_size()
+
+            @mpx.spmd(comm=comm)
+            def train_step(params, x, y):
+                def loss_fn(p, x, y):
+                    h = jax.nn.relu(x @ p["w1"] + p["b1"])
+                    pred = h @ p["w2"] + p["b2"]
+                    return jnp.mean((pred - y) ** 2)
+
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                red = jax.tree.map(
+                    lambda g: mpx.allreduce(g, op=mpx.SUM, comm=comm)[0],
+                    grads)
+                loss = mpx.allreduce(loss, op=mpx.SUM, comm=comm)[0] / size
+                new = jax.tree.map(lambda p, g: p - lr * (g / size),
+                                   params, red)
+                return mpx.varying((new, loss))
+
+            programs[key] = train_step
+        return programs[key]
+
+    def replicate(tree, k):
+        return jax.tree.map(
+            lambda v: jnp.tile(jnp.asarray(v)[None], (k,) + (1,) * v.ndim),
+            tree)
+
+    losses = []
+
+    def step_fn(state, step, comm):
+        k = comm.Get_size()
+        x, y = _data_for(k)
+        params_g = replicate(state["params"], k)
+        params_g, loss = train_step_for(comm)(params_g, x, y)
+        loss = float(np.asarray(loss)[0])
+        losses.append({"step": step, "world": k, "loss": loss,
+                       "epoch": comm.epoch})
+        print(f"step {step:3d}  world {k}  epoch {comm.epoch}  "
+              f"loss {loss:.6f}", flush=True)
+        # state stays single-copy (replicated invariant: every rank's row
+        # is identical, row 0 is the canonical copy the ShardStore shards)
+        return {"params": jax.tree.map(lambda v: np.asarray(v[0]), params_g)}
+
+    return step_fn, losses
+
+
+# ---------------------------------------------------------------------------
+# single-process mode: simulated failure, in-place mesh shrink
+# ---------------------------------------------------------------------------
+
+
+def run_single(args):
+    import mpi4jax_tpu as mpx
+
+    mesh = mpx.make_world_mesh()
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    k = comm.Get_size()
+    fail_rank = args.fail_rank if args.fail_rank >= 0 else k - 1
+    fail_at = args.fail_step if 0 <= args.fail_step < args.steps else None
+    if fail_at is not None and k < 2:
+        print("single device: nothing to shrink, running clean")
+        fail_at = None
+
+    store = mpx.ShardStore(comm)
+    base_step, losses = _make_elastic_step(mpx)
+
+    def step_fn(state, step, comm):
+        state = base_step(state, step, comm)
+        if fail_at is not None and step == fail_at and comm.epoch == 0:
+            # simulate rank loss AFTER the step's work (a real death
+            # surfaces as an error/expiry inside the next collective; the
+            # recovery path from here on is identical)
+            raise mpx.RankFailure({fail_rank},
+                                  f"simulated loss of rank {fail_rank}")
+        return state
+
+    state = {"params": _init_params()}
+    state = mpx.elastic.run(step_fn, state, store, steps=args.steps,
+                            commit_every=args.commit_every)
+
+    final_world = store.comm.Get_size()
+    expect_world = k - 1 if fail_at is not None else k
+    assert final_world == expect_world, (final_world, expect_world)
+    assert len([r for r in losses if r["step"] == args.steps - 1]) == 1
+    if fail_at is not None:
+        from mpi4jax_tpu.resilience import elastic as el
+
+        assert el.current_epoch() == 1, el.current_epoch()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"losses": losses, "final_world": final_world}, f,
+                      indent=2)
+    print(f"{DONE_TAG} steps={args.steps} world={final_world}", flush=True)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# multi-process drill: --launch parent + worker halves
+# ---------------------------------------------------------------------------
+
+
+def run_worker(args):
+    import jax
+
+    import mpi4jax_tpu as mpx
+
+    mpx.init_distributed(
+        coordinator_address=f"localhost:{args.port_base}",
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert jax.device_count() == args.num_processes
+
+    if args.watchdog > 0:
+        mpx.set_watchdog_timeout(args.watchdog)
+
+    mesh = mpx.make_world_mesh()
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    store = mpx.ShardStore(comm, bootstrap={
+        "host": "localhost",
+        "port_base": args.port_base,
+        "process_id": args.process_id,
+        "num_processes": args.num_processes,
+        "agree_port_base": args.port_base + 100,
+    })
+    step_fn, losses = _make_elastic_step(mpx)
+
+    state = {"params": _init_params()}
+    state = mpx.elastic.run(step_fn, state, store, steps=args.steps,
+                            commit_every=args.commit_every)
+
+    final_world = int(store.comm.Get_size())
+    if args.out:
+        with open(f"{args.out}.p{args.process_id}", "w") as f:
+            json.dump({"losses": losses, "final_world": final_world}, f,
+                      indent=2)
+    print(f"{DONE_TAG} steps={args.steps} world={final_world}", flush=True)
+
+
+def run_launcher(args):
+    """Spawn the N-process world, reap survivors, judge the drill.
+
+    Success = a strict MAJORITY of workers exit 0 AND each of them
+    printed the completion tag with the full step budget.  Workers killed
+    by the fault injector (``die`` exits 13) or hung forever (``hang``,
+    killed here once the survivors finish) are the drill's subjects, not
+    failures of it.
+    """
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port_base = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    n = args.launch
+    workers = []
+    for i in range(n):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--steps", str(args.steps),
+               "--commit-every", str(args.commit_every),
+               "--process-id", str(i), "--num-processes", str(n),
+               "--port-base", str(port_base),
+               "--watchdog", str(args.watchdog)]
+        if args.out:
+            cmd += ["--out", args.out]
+        workers.append(subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+
+    deadline = time.monotonic() + args.drill_timeout
+    outputs = {}
+    while time.monotonic() < deadline:
+        live = [p for p in workers if p.poll() is None]
+        done_ok = [p for p in workers
+                   if p.poll() is not None and p.returncode == 0]
+        if not live:
+            break
+        if len(done_ok) > n // 2:
+            # the surviving majority finished; whoever is still running is
+            # the drill's hung subject — give stragglers a grace period,
+            # then put them down
+            grace = time.monotonic() + 10.0
+            while any(p.poll() is None for p in workers) \
+                    and time.monotonic() < grace:
+                time.sleep(0.2)
+            for p in workers:
+                if p.poll() is None:
+                    p.kill()
+            break
+        time.sleep(0.5)
+    for i, p in enumerate(workers):
+        try:
+            out, _ = p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outputs[i] = out or ""
+        sys.stdout.write(f"--- worker {i} (exit {p.returncode}) ---\n")
+        sys.stdout.write(outputs[i])
+    winners = [i for i, p in enumerate(workers) if p.returncode == 0]
+    completed = [i for i in winners
+                 if f"{DONE_TAG} steps={args.steps}" in outputs[i]]
+    print(f"drill: {len(completed)}/{n} workers completed the "
+          f"{args.steps}-step budget: ranks {completed}", flush=True)
+    if len(completed) > n // 2 and completed == winners:
+        print("DRILL_OK", flush=True)
+        return 0
+    print("DRILL_FAILED", flush=True)
+    return 1
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.launch > 0:
+        return run_launcher(args)
+    if args.process_id >= 0:
+        run_worker(args)
+        return 0
+    run_single(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,9 @@ from .utils import (  # noqa: F401
     has_tpu_support,
 )
 from .resilience import (  # noqa: F401
+    RankFailure,
+    ShardStore,
+    elastic,
     set_check_numerics,
     set_fault_spec,
     set_watchdog_timeout,
@@ -170,6 +173,10 @@ __all__ = [
     "set_watchdog_timeout",
     "set_fault_spec",
     "set_check_numerics",
+    # elastic recovery (docs/resilience.md "Elastic recovery")
+    "elastic",
+    "RankFailure",
+    "ShardStore",
     # trace-time collective verifier (docs/analysis.md)
     "analyze",
     "Report",

@@ -357,6 +357,43 @@ def check_start_wait(graph: CollectiveGraph) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# revoked-epoch collectives (MPX126)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX126")
+def check_epoch_boundary(graph: CollectiveGraph) -> List[Finding]:
+    """A collective issued on a comm whose communication epoch is behind
+    the current one (``graph.meta["epoch"]``): the world shrank after the
+    comm was built — its mesh binding and group tables still describe the
+    pre-failure world, dead ranks included.  Recovery through
+    ``mpx.elastic.run`` (or an explicit ``comm.shrink``) produces
+    current-epoch comms and never fires this; holding a pre-shrink comm
+    across the boundary does."""
+    current = graph.meta.get("epoch")
+    if not current:  # epoch 0 (or no elastic layer): nothing is revoked
+        return []
+    findings: List[Finding] = []
+    for e in graph.events:
+        if e.epoch is None or e.epoch >= current:
+            continue
+        findings.append(Finding(
+            code="MPX126", op=e.op, index=e.index,
+            message=(f"{e.op} on comm {e.comm_uid} was issued in epoch "
+                     f"{current} but the comm was built in epoch "
+                     f"{e.epoch}: the world shrank in between and this "
+                     "comm still addresses the revoked (pre-failure) "
+                     "rank space"),
+            suggestion=("re-enter the training loop through "
+                        "mpx.elastic.run (it rebuilds comms on "
+                        "recovery), or rebuild by hand with "
+                        "comm.shrink(failed, mesh=...) — "
+                        "docs/resilience.md 'Elastic recovery'"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # topology advisory (MPX113)
 # ---------------------------------------------------------------------------
 

@@ -56,6 +56,10 @@ class CollectiveEvent:
     # (hosts, ranks_per_host) of the two-level plan this op lowered with
     # (ops/_hierarchy.annotate_selection), compared across ranks (MPX125)
     hier: Optional[Tuple[int, int]] = None
+    # communication epoch the comm was built in (parallel/comm.py stamp;
+    # resilience/elastic.py revocation) — compared against the CURRENT
+    # epoch in graph.meta by the MPX126 checker
+    epoch: Optional[int] = None
     # static member groups (global ranks, group order) of this op's comm
     # when derivable — comm.groups on a split, or the rank-concretization
     # scope's sub-axes partition during a per-rank schedule trace.  The

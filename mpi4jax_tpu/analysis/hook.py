@@ -81,6 +81,7 @@ class Recorder:
 
 def config_snapshot() -> dict:
     from ..ops._fusion import effective_mode as fusion_mode
+    from ..resilience.elastic import current_epoch
 
     return {
         "collective_algo": config.collective_algo(),
@@ -89,6 +90,7 @@ def config_snapshot() -> dict:
         "topology": config.topology_spec(),
         "fusion": fusion_mode(),
         "fusion_bucket_bytes": config.fusion_bucket_bytes(),
+        "epoch": current_epoch(),
     }
 
 
@@ -168,6 +170,7 @@ def begin_event(opname: str, comm, arrays, token, ana: Optional[dict],
         dtype=str(a0.dtype) if a0 is not None else "",
         shape=tuple(a0.shape) if a0 is not None else (),
         eager=eager,
+        epoch=getattr(comm, "epoch", None),
         groups=static_groups_for(comm),
     )
     if ana:

@@ -179,6 +179,17 @@ CODES = {
             "would pair different groups.  All members must derive the "
             "same (hosts, ranks-per-host) decomposition.",
         ),
+        CodeInfo(
+            "MPX126", "collective on a revoked communication epoch", ERROR,
+            "A collective was issued on a communicator stamped with an "
+            "epoch older than the current one: the world shrank "
+            "(resilience/elastic.py revoked the epoch) but this comm "
+            "was never rebuilt, so its group tables, mesh binding, and "
+            "rank numbering describe the OLD world — dead ranks "
+            "included.  Re-enter through mpx.elastic.run (which rebuilds "
+            "the comm on recovery) or call comm.shrink(failed, "
+            "mesh=...) and re-issue on the result.",
+        ),
     )
 }
 

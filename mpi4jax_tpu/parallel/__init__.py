@@ -12,6 +12,7 @@ from .mesh import (  # noqa: F401
     init_distributed,
     make_world_mesh,
     set_default_mesh,
+    shrink_world_mesh,
 )
 from .rankspec import (  # noqa: F401
     invert_pairs,

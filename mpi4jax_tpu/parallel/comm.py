@@ -75,6 +75,14 @@ class Comm:
                 )
         # Unique id = the p2p matching namespace (Clone isolation).
         self._uid = next(_uid_counter)
+        # Communication epoch this comm belongs to (resilience/elastic.py):
+        # advancing the epoch revokes every comm stamped with an older one —
+        # derived comms (Clone/bind/sub/Split) inherit their parent's stamp,
+        # shrink() re-stamps with the post-revocation epoch.  A collective
+        # dispatched on a stale comm is flagged MPX126 by the verifier.
+        from ..resilience.elastic import current_epoch
+
+        self._epoch = current_epoch()
 
     # -- structure ---------------------------------------------------------
 
@@ -100,10 +108,18 @@ class Comm:
     def uid(self) -> int:
         return self._uid
 
+    @property
+    def epoch(self) -> int:
+        """Communication epoch this comm was built in (elastic recovery:
+        resilience/elastic.py).  0 for the whole life of a job that never
+        shrank."""
+        return self._epoch
+
     def bind(self, mesh: jax.sharding.Mesh) -> "Comm":
         """Return a copy of this comm bound to ``mesh`` (same namespace)."""
         new = Comm(self._axes, mesh=mesh)
         new._uid = self._uid
+        new._epoch = self._epoch
         return new
 
     # -- MPI-style surface -------------------------------------------------
@@ -209,9 +225,38 @@ class Comm:
         all (each HLO op is independent), so cloning only isolates
         send/recv trace-time matching queues.
         """
-        return Comm(self._axes, mesh=self._mesh)
+        new = Comm(self._axes, mesh=self._mesh)
+        new._epoch = self._epoch
+        return new
 
     Dup = Clone
+
+    def shrink(self, failed, *, mesh: jax.sharding.Mesh) -> "Comm":
+        """Rebuild this communicator as "all minus ``failed``" over the
+        post-shrink ``mesh`` — the comm half of elastic recovery
+        (resilience/elastic.py; the analog of ULFM's ``MPI_Comm_shrink``).
+
+        ``failed`` are OLD-world global ranks; survivors are renumbered
+        compactly in ascending old-rank order (``compact_rank_map``).
+        The result is a NEW communicator (fresh matching namespace)
+        stamped with the CURRENT epoch, so programs traced against it
+        cache under post-revocation keys.
+        """
+        from ..resilience.elastic import compact_rank_map
+
+        failed = frozenset(int(r) for r in failed)
+        world = self.world_size()
+        rmap = compact_rank_map(world, failed)  # validates range/survivors
+        expect = len(rmap)
+        got = int(np.prod([mesh.shape[a] for a in self._axes
+                           if a in mesh.shape]))
+        if got != expect:
+            raise ValueError(
+                f"shrink: mesh spans {got} ranks along axes {self._axes} "
+                f"but {expect} of {world} ranks survive — pass the mesh "
+                "shrink_world_mesh built for this failure"
+            )
+        return Comm(self._axes, mesh=mesh)
 
     def sub(self, *axes: str) -> "Comm":
         """Communicator over a subset of this comm's axes.
@@ -226,7 +271,9 @@ class Comm:
         for a in axes:
             if a not in self._axes:
                 raise ValueError(f"axis {a!r} not in comm axes {self._axes}")
-        return Comm(axes, mesh=self._mesh)
+        new = Comm(axes, mesh=self._mesh)
+        new._epoch = self._epoch
+        return new
 
     def Split(self, color, key=None) -> "Comm":
         """Split this communicator — the analog of ``MPI_Comm_split``.
@@ -254,7 +301,9 @@ class Comm:
             remaining = tuple(a for a in self._axes if a != color)
             if not remaining:
                 raise ValueError("Split would leave an empty communicator")
-            return Comm(remaining, mesh=self._mesh)
+            new = Comm(remaining, mesh=self._mesh)
+            new._epoch = self._epoch
+            return new
 
         size = self.Get_size()
         colors = list(color)
@@ -310,6 +359,7 @@ class GroupComm(Comm):
 
     def __init__(self, parent: Comm, groups):
         super().__init__(parent.axes, mesh=parent.mesh)
+        self._epoch = parent.epoch
         seen = [r for g in groups for r in g]
         try:
             world = Comm.Get_size(self)
@@ -417,6 +467,7 @@ class GroupComm(Comm):
     def Clone(self) -> "Comm":
         clone = GroupComm.__new__(GroupComm)
         Comm.__init__(clone, self._axes, mesh=self._mesh)
+        clone._epoch = self._epoch
         clone._groups = self._groups
         clone._gid = self._gid
         clone._lrank = self._lrank
@@ -424,6 +475,29 @@ class GroupComm(Comm):
         return clone
 
     Dup = Clone
+
+    def shrink(self, failed, *, mesh: jax.sharding.Mesh) -> "Comm":
+        """Shrink a color-split comm: drop the failed ranks from every
+        group, renumber survivors compactly (``shrink_groups`` preserves
+        each group's member order), drop groups that lost every member,
+        and rebuild over the post-shrink ``mesh``.  A fresh current-epoch
+        :class:`GroupComm` results — the group-table half of elastic
+        recovery."""
+        from ..resilience.elastic import shrink_groups
+
+        failed = frozenset(int(r) for r in failed)
+        world = self.world_size()
+        new_groups = shrink_groups(self._groups, failed, world)
+        parent = Comm(self._axes, mesh=mesh)
+        expect = world - len(failed)
+        got = parent.world_size()
+        if got != expect:
+            raise ValueError(
+                f"shrink: mesh spans {got} ranks along axes {self._axes} "
+                f"but {expect} of {world} ranks survive — pass the mesh "
+                "shrink_world_mesh built for this failure"
+            )
+        return GroupComm(parent, new_groups)
 
     def bind(self, mesh: jax.sharding.Mesh) -> "Comm":
         """Bind to a mesh, PRESERVING the group structure (the inherited

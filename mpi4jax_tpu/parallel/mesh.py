@@ -33,7 +33,8 @@ def init_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     *,
-    connect_deadline: float = 300.0,
+    connect_deadline: Optional[float] = None,
+    connect_max_attempts: Optional[int] = None,
     connect_base_delay: float = 1.0,
     connect_max_delay: float = 30.0,
     **kwargs,
@@ -49,15 +50,26 @@ def init_distributed(
     process, and on preempted pods transient refusals are the norm —
     a worker that gives up on the first ``ConnectionError`` turns routine
     scheduler jitter into a failed job.  ``connect_deadline`` bounds the
-    total wait (seconds); on expiry a ``RuntimeError`` names the attempt
-    count, elapsed time, and last underlying error.  ``connect_base_delay``
-    and ``connect_max_delay`` shape the backoff (docs/resilience.md).
+    total wait (seconds) and ``connect_max_attempts`` the attempt count;
+    both default to the declared flags ``MPI4JAX_TPU_BOOTSTRAP_DEADLINE``
+    / ``MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS`` (utils/config.py) — the same
+    policy the elastic re-bootstrap reuses after a shrink
+    (resilience/elastic.py).  On expiry a ``RuntimeError`` names the
+    attempt count, elapsed time, and last underlying error.
+    ``connect_base_delay`` and ``connect_max_delay`` shape the backoff
+    (docs/resilience.md).
     """
     global _distributed_initialized
     if _distributed_initialized:
         return
 
     from ..resilience.retry import retry_with_backoff
+    from ..utils import config
+
+    if connect_deadline is None:
+        connect_deadline = config.bootstrap_deadline()
+    if connect_max_attempts is None:
+        connect_max_attempts = config.bootstrap_max_attempts()
 
     def _connect():
         jax.distributed.initialize(
@@ -72,6 +84,7 @@ def init_distributed(
         what="jax.distributed coordinator connection "
              f"({coordinator_address or 'auto-detected'})",
         deadline=connect_deadline,
+        max_attempts=connect_max_attempts or None,
         base_delay=connect_base_delay,
         max_delay=connect_max_delay,
         # a second initialize on an already-initialized backend is a
@@ -116,6 +129,39 @@ def make_world_mesh(
         axis_types=tuple(jax.sharding.AxisType.Auto for _ in shape),
         devices=devices,
     )
+
+
+def shrink_world_mesh(mesh: jax.sharding.Mesh, failed) -> jax.sharding.Mesh:
+    """Rebuild ``mesh`` without the devices of the ``failed`` global ranks
+    (row-major rank order, the same rank space ``Comm.Get_rank`` defines)
+    — the mesh half of an elastic shrink (resilience/elastic.py).
+
+    Only 1-D meshes shrink structurally: removing arbitrary ranks from a
+    Cartesian grid leaves a ragged grid no mesh can express.  Reshape to
+    1-D before an elastic run, or fail whole grid rows and rebuild the
+    grid by hand.
+    """
+    failed = frozenset(int(r) for r in failed)
+    shape = tuple(mesh.shape.values())
+    if len(shape) != 1:
+        raise ValueError(
+            f"shrink_world_mesh: only 1-D meshes can shrink (got shape "
+            f"{dict(mesh.shape)}); arbitrary rank removal leaves a ragged "
+            "grid — run elastic jobs on a 1-D mesh (docs/resilience.md)"
+        )
+    devices = list(mesh.devices.flat)
+    world = len(devices)
+    bad = [r for r in failed if not 0 <= r < world]
+    if bad:
+        raise ValueError(
+            f"shrink_world_mesh: failed ranks {sorted(bad)} out of range "
+            f"for world {world}"
+        )
+    survivors = [d for r, d in enumerate(devices) if r not in failed]
+    if not survivors:
+        raise ValueError("shrink_world_mesh: no surviving devices")
+    (axis,) = mesh.axis_names
+    return make_world_mesh((len(survivors),), (axis,), devices=survivors)
 
 
 def get_default_mesh() -> jax.sharding.Mesh:

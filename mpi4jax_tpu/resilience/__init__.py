@@ -17,13 +17,20 @@ above:
   each collective's inputs/outputs, tied into ``abort_if``;
 - :mod:`.retry` — exponential-backoff (full-jitter) retry with a total
   deadline, used by ``init_distributed``'s coordinator connection;
+- :mod:`.elastic` — the RECOVERY half (ULFM-style shrink-and-resume):
+  communication epochs, failure agreement, the :class:`~.elastic.ShardStore`
+  in-memory sharded checkpoint with k-redundant neighbor replication, and
+  :func:`~.elastic.run`, the training loop that survives rank loss;
 - :mod:`.runtime` — config resolution and the per-op :class:`~.runtime.Plan`
   the dispatch layer consults.  All features default OFF, and when off the
   lowered HLO is byte-identical to an uninstrumented build.
 
-Failure model, spec grammar, and knobs: docs/resilience.md.
+Failure model, spec grammar, recovery protocol, and knobs:
+docs/resilience.md.
 """
 
+from . import elastic  # noqa: F401
+from .elastic import RankFailure, ShardStore  # noqa: F401
 from .faultinject import (  # noqa: F401
     FaultClause,
     canonical_spec,
@@ -39,7 +46,12 @@ from .runtime import (  # noqa: F401
     set_fault_spec,
     set_watchdog_timeout,
 )
-from .watchdog import inflight_snapshot, registry_empty  # noqa: F401
+from .watchdog import (  # noqa: F401
+    drain_registry,
+    inflight_snapshot,
+    registry_empty,
+    set_on_timeout,
+)
 
 __all__ = [
     "FaultClause",
@@ -52,7 +64,12 @@ __all__ = [
     "set_watchdog_timeout",
     "set_fault_spec",
     "set_check_numerics",
+    "set_on_timeout",
     "reset_overrides",
     "inflight_snapshot",
     "registry_empty",
+    "drain_registry",
+    "elastic",
+    "RankFailure",
+    "ShardStore",
 ]

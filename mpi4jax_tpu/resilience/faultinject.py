@@ -1,20 +1,22 @@
 """Deterministic fault injection at the shared op dispatch point.
 
-Production collectives fail in three characteristic ways: a rank goes slow
-(stragglers, preemption), a rank dies (hardware loss, OOM-kill), or a rank
-computes garbage (silent data corruption, bad reduction inputs).  This module
-injects all three *deterministically* from a parsed spec, at the single
-dispatch point every one of the 12 ops flows through (``ops/_base.py
-_run_body``) — so every op is injectable in tests without touching per-op
-code, and a production incident can be rehearsed with one environment
-variable.
+Production collectives fail in four characteristic ways: a rank goes slow
+(stragglers, preemption), a rank dies (hardware loss, OOM-kill), a rank
+*hangs* — alive but stuck forever, the realistic TPU failure mode: the
+process holds its slice, heartbeats keep passing, and only the peers'
+watchdogs can tell — or a rank computes garbage (silent data corruption,
+bad reduction inputs).  This module injects all four *deterministically*
+from a parsed spec, at the single dispatch point every one of the 12 ops
+flows through (``ops/_base.py _run_body``) — so every op is injectable in
+tests without touching per-op code, and a production incident can be
+rehearsed with one environment variable.
 
 Spec grammar (``MPI4JAX_TPU_FAULT_SPEC``, full reference in
 docs/resilience.md)::
 
     spec    := clause (';' clause)*
     clause  := verb (':' arg)*
-    verb    := 'delay' | 'die' | 'corrupt'
+    verb    := 'delay' | 'die' | 'hang' | 'corrupt'
     arg     := 'nan' | 'inf' | key '=' value      # bare modes only for corrupt
     key     := 'rank' | 'op' | 'after' | 'secs'
 
@@ -36,6 +38,9 @@ Semantics:
   every matching call after that.  Default 0 (fire immediately).
 - ``delay`` sleeps ``secs`` (default 1.0) on the host before the collective;
   ``die`` kills the process (``os._exit(13)``), simulating a crashed rank;
+  ``hang`` sleeps forever (the process stays alive but never enters the
+  collective — unlike ``die``, the peers see no error, only silence, so a
+  drill exercises the watchdog-expiry detection path);
   ``corrupt`` overwrites the op's floating-point inputs with NaN (``nan``,
   default) or +Inf (``inf``) on the firing rank only.
 
@@ -55,7 +60,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-_VERBS = ("delay", "die", "corrupt")
+_VERBS = ("delay", "die", "hang", "corrupt")
 _KEYS = ("rank", "op", "after", "secs")
 _MODES = ("nan", "inf")
 
@@ -169,6 +174,18 @@ def canonical_spec(clauses: Tuple[FaultClause, ...]) -> str:
     return ";".join(c.canonical() for c in clauses)
 
 
+# one monitor-poll-sized nap at a time (not one giant sleep): a hung rank
+# in a drill stays interruptible — ``_thread.interrupt_main`` (the elastic
+# recovery's unblock path) and test harness timeouts both land between
+# naps.  Patchable in tests so "forever" can be observed finitely.
+_HANG_NAP_SECS = 1.0
+
+
+def _hang_forever():  # pragma: no cover - exercised via drills/monkeypatch
+    while True:
+        time.sleep(_HANG_NAP_SECS)
+
+
 # ---------------------------------------------------------------------------
 # host-side trigger state
 # ---------------------------------------------------------------------------
@@ -242,6 +259,11 @@ def probe_host(indexed_clauses, mpi_name: str, rank) -> int:
                            f"({clause.canonical()})")
             sys.stderr.flush()
             os._exit(13)
+        elif clause.verb == "hang":
+            _fault_line(r, f"hang injected in {mpi_name} "
+                           f"({clause.canonical()}) — sleeping forever")
+            sys.stderr.flush()
+            _hang_forever()
         else:  # corrupt
             _fault_line(r, f"corrupt:{clause.mode} injected in {mpi_name} "
                            f"({clause.canonical()})")

@@ -33,6 +33,7 @@ def retry_with_backoff(
     *,
     what: str = "operation",
     deadline: float = 300.0,
+    max_attempts: Optional[int] = None,
     base_delay: float = 1.0,
     max_delay: float = 30.0,
     factor: float = 2.0,
@@ -42,10 +43,11 @@ def retry_with_backoff(
     sleep: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
 ):
-    """Call ``fn`` until it succeeds, a non-retryable error escapes, or the
-    total ``deadline`` (seconds) elapses.
+    """Call ``fn`` until it succeeds, a non-retryable error escapes, the
+    total ``deadline`` (seconds) elapses, or ``max_attempts`` calls have
+    failed (``None``/0 = attempts bounded only by the deadline).
 
-    On the deadline, raises ``RuntimeError`` naming ``what``, the attempt
+    On either bound, raises ``RuntimeError`` naming ``what``, the attempt
     count, and the elapsed time, chained from the last underlying error —
     the "clear error at the deadline" a stuck bootstrap owes its operator.
     ``giveup(exc) -> True`` re-raises immediately even for a retryable class
@@ -54,6 +56,8 @@ def retry_with_backoff(
     """
     if deadline <= 0:
         raise ValueError(f"deadline must be positive, got {deadline}")
+    if max_attempts is not None and max_attempts < 0:
+        raise ValueError(f"max_attempts must be >= 0, got {max_attempts}")
     start = clock()
     attempt = 0
     while True:
@@ -64,6 +68,12 @@ def retry_with_backoff(
                 raise
             attempt += 1
             elapsed = clock() - start
+            if max_attempts and attempt >= max_attempts:
+                raise RuntimeError(
+                    f"{what} failed after {attempt} attempt(s) over "
+                    f"{elapsed:.1f}s (max_attempts {max_attempts}); last "
+                    f"error: {type(e).__name__}: {e}"
+                ) from e
             if elapsed >= deadline:
                 raise RuntimeError(
                     f"{what} failed after {attempt} attempt(s) over "

@@ -112,11 +112,26 @@ def effective_check_numerics() -> bool:
 
 def cache_token() -> tuple:
     """Hashable fingerprint of the effective resilience configuration —
-    belongs in every compiled-program cache key that caches op lowerings."""
+    belongs in every compiled-program cache key that caches op lowerings.
+
+    The communication epoch (resilience/elastic.py) rides here: advancing
+    it after a shrink changes this token, which changes both program-cache
+    keys — every executable traced against the revoked world becomes
+    unreachable and the next call re-traces at the new size.  A job that
+    never shrinks carries the constant epoch 0 and its keys match a build
+    without the elastic layer engaged.
+    """
+    from .elastic import current_epoch
+    from .watchdog import _force_fallback
+
     return (
         effective_watchdog_timeout(),
         canonical_spec(effective_fault_clauses()),
         effective_check_numerics(),
+        # the watchdog backend choice is baked into traced arm/disarm
+        # callbacks, so flipping it must retrace too
+        _force_fallback,
+        current_epoch(),
     )
 
 

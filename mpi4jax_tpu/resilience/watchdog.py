@@ -40,6 +40,8 @@ __all__ = [
     "disarm_in_graph",
     "inflight_snapshot",
     "registry_empty",
+    "set_on_timeout",
+    "drain_registry",
 ]
 
 _POLL_INTERVAL = 0.1
@@ -141,11 +143,41 @@ class _Registry:
         with self.lock:
             return not self.entries
 
+    def drain(self) -> int:
+        """Forget every in-flight entry (epoch revocation: arms from
+        collectives of a revoked world must not fire into the recovered
+        job).  Returns the number of entries dropped."""
+        with self.lock:
+            n = sum(len(dq) for dq in self.entries.values())
+            self.entries.clear()
+        return n
+
+    def drain_expired(self) -> int:
+        """Forget only the entries whose timeout has elapsed (a claimed
+        expiry): un-expired arms of unrelated concurrent collectives keep
+        their coverage.  Returns the number of entries dropped."""
+        now = self.clock()
+        dropped = 0
+        with self.lock:
+            for key in list(self.entries):
+                dq = self.entries[key]
+                kept = deque(e for e in dq if now - e[2] <= e[3])
+                dropped += len(dq) - len(kept)
+                if kept:
+                    self.entries[key] = kept
+                else:
+                    del self.entries[key]
+        return dropped
+
     def _monitor(self) -> None:
         while True:
             time.sleep(_POLL_INTERVAL)
             expired = self.check_expired()
             if expired is not None:
+                # the incident is journalled HERE, before the handler
+                # runs: a handler that recovers (or kills) the process
+                # must not be able to lose the expiry record, and a
+                # replacement handler need not re-implement it
                 _telemetry_incident(
                     "watchdog.expiries", "watchdog_expired",
                     expired["rank"],
@@ -153,7 +185,13 @@ class _Registry:
                     f"exceeded {expired['timeout']:g}s",
                 )
                 self.on_timeout(self.snapshot(), expired)
-                return  # only reachable with a non-fatal on_timeout override
+                # only reachable with a non-fatal handler (the default
+                # aborts the process): drop the EXPIRED entries — healthy
+                # concurrent arms keep their coverage — and keep
+                # monitoring; the handler's recovery (e.g. an elastic
+                # shrink, which drains everything via revoke_epoch)
+                # re-arms collectives of the NEW epoch under fresh entries
+                self.drain_expired()
 
 
 _registry = _Registry()
@@ -167,6 +205,56 @@ def registry_empty() -> bool:
 def inflight_snapshot():
     """Current in-flight ops in the Python-fallback registry (diagnostics)."""
     return _registry.snapshot()
+
+
+# when True, arm/disarm skip the native C++ registry even where it is
+# available: the C++ monitor always kills the process on expiry (its
+# handler is not pluggable from Python), so a claimed recovery handler
+# (elastic.run) needs the Python-fallback monitor to be the one watching
+_force_fallback = False
+
+
+def force_python_fallback(enable: bool) -> None:
+    """Route watchdog arm/disarm through the Python-fallback registry
+    even where the native C++ monitor is built.  Elastic recovery sets
+    this for the duration of ``elastic.run`` (the native monitor cannot
+    hand expiries to a Python handler); also useful in tests."""
+    global _force_fallback
+    _force_fallback = bool(enable)
+    # arm sites are baked into traced programs per implementation: retrace
+    from ..utils import config
+
+    config.bump_config_epoch()
+
+
+def native_active() -> bool:
+    """Whether arm/disarm currently use the native C++ registry."""
+    from .. import native
+
+    return native.watchdog_supported() and not _force_fallback
+
+
+def set_on_timeout(handler: Optional[Callable]) -> None:
+    """Replace the expiry handler of the LIVE Python-fallback monitor at
+    runtime (``None`` restores the default dump-and-die handler).
+
+    ``handler(entries, expired)`` receives the full in-flight snapshot
+    plus the expired entry, after the expiry was journalled as a
+    telemetry incident.  A handler that returns (instead of killing the
+    process) keeps the monitor alive: the expired entries are drained and
+    monitoring continues — the hook elastic recovery
+    (``resilience/elastic.py``) claims expiries through, without
+    recreating the registry.  Only the Python-fallback monitor is
+    pluggable; the native C++ monitor always dies loudly (its registry is
+    not visible from Python), so elastic drills force the fallback.
+    """
+    _registry.on_timeout = handler or _default_on_timeout
+
+
+def drain_registry() -> int:
+    """Drop every in-flight entry of the Python-fallback registry (epoch
+    revocation / test isolation); returns the count dropped."""
+    return _registry.drain()
 
 
 # ---------------------------------------------------------------------------
@@ -200,7 +288,7 @@ def arm_in_graph(mpi_name: str, call_id: str, comm, rank, timeout: float):
     else:
         _tcore.meter("watchdog.arms")
     axes = repr(comm.axes)
-    if native.watchdog_supported():
+    if native.watchdog_supported() and not _force_fallback:
         return native.watchdog_arm(mpi_name, call_id, rank, axes, timeout)
 
     import numpy as np
@@ -219,7 +307,7 @@ def disarm_in_graph(mpi_name: str, call_id: str, comm, rank, dep):
     the callback after completion."""
     from .. import native
 
-    if native.watchdog_supported():
+    if native.watchdog_supported() and not _force_fallback:
         return native.watchdog_disarm(call_id, rank, dep)
 
     import numpy as np

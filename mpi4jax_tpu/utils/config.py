@@ -48,6 +48,19 @@ DEFAULT_FUSION_BUCKET_BYTES = 4 << 20
 # and independent compute interleaves with both).
 DEFAULT_OVERLAP_CHUNKS = 2
 
+# bootstrap retry policy defaults (resilience/retry.py semantics): the
+# same policy serves the first `init_distributed` rendezvous AND every
+# elastic re-bootstrap after a shrink (resilience/elastic.py), so both
+# are declared flags instead of constants buried in call sites
+DEFAULT_BOOTSTRAP_DEADLINE = 300.0
+DEFAULT_BOOTSTRAP_MAX_ATTEMPTS = 0  # 0 = bounded by the deadline only
+
+# default shard replication budget for the elastic in-memory checkpoint
+# (resilience/elastic.py ShardStore): each shard lives on redundancy+1
+# ranks, tolerating that many simultaneous rank losses at a memory cost
+# of (redundancy+1)/k of the state per rank
+DEFAULT_ELASTIC_REDUNDANCY = 1
+
 # default ring/butterfly crossover: 1 MiB — below it the butterfly's
 # ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
 # the ring's O(size) vs O(size·log k) byte volume dominates.  Measured per
@@ -86,6 +99,25 @@ FLAGS = {
              "semicolon-separated clauses, e.g. "
              "``delay:rank=1:op=allreduce:after=3:secs=2``.  Empty "
              "disables."),
+        Flag("MPI4JAX_TPU_BOOTSTRAP_DEADLINE", "float",
+             DEFAULT_BOOTSTRAP_DEADLINE,
+             "Total seconds the ``init_distributed`` coordinator "
+             "rendezvous (and every elastic re-bootstrap after a "
+             "shrink) may spend retrying before failing with a clear "
+             "error (resilience/retry.py).  Default 300."),
+        Flag("MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS", "int",
+             DEFAULT_BOOTSTRAP_MAX_ATTEMPTS,
+             "Attempt cap for the bootstrap retry policy; 0 (default) "
+             "bounds retries by the deadline only.  Applies to "
+             "``init_distributed`` and elastic re-bootstrap alike."),
+        Flag("MPI4JAX_TPU_ELASTIC_REDUNDANCY", "int",
+             DEFAULT_ELASTIC_REDUNDANCY,
+             "Replication budget of the elastic in-memory shard "
+             "checkpoint (resilience/elastic.py ShardStore): each state "
+             "shard is copied to this many neighbor ranks beyond its "
+             "owner, so this many SIMULTANEOUS rank losses are "
+             "recoverable.  Memory cost per rank is (redundancy+1)/k of "
+             "the registered state.  Default 1."),
         Flag("MPI4JAX_TPU_CHECK_NUMERICS", "bool", False,
              "Abort (via the ``abort_if`` fail-fast path) when a "
              "collective's inputs or outputs contain NaN/Inf, naming the "
@@ -327,6 +359,38 @@ def fault_spec() -> str:
     docs/resilience.md).
     """
     return (_getenv("MPI4JAX_TPU_FAULT_SPEC") or "").strip()
+
+
+def bootstrap_deadline() -> float:
+    """Total seconds the bootstrap rendezvous may retry
+    (``MPI4JAX_TPU_BOOTSTRAP_DEADLINE``; default 300).  Shared by
+    ``init_distributed`` and the elastic re-bootstrap."""
+    val = parse_env_float("MPI4JAX_TPU_BOOTSTRAP_DEADLINE",
+                          DEFAULT_BOOTSTRAP_DEADLINE)
+    if val is None or val <= 0:
+        raise ValueError(
+            "MPI4JAX_TPU_BOOTSTRAP_DEADLINE must be a positive number of "
+            f"seconds, got {val!r}"
+        )
+    return val
+
+
+def bootstrap_max_attempts() -> int:
+    """Attempt cap of the bootstrap retry policy
+    (``MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS``; 0 = deadline-bounded
+    only)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS", DEFAULT_BOOTSTRAP_MAX_ATTEMPTS
+    )
+
+
+def elastic_redundancy() -> int:
+    """Shard replication budget of the elastic in-memory checkpoint
+    (``MPI4JAX_TPU_ELASTIC_REDUNDANCY``; default 1 — each shard lives on
+    its owner plus one neighbor, tolerating one simultaneous loss)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_ELASTIC_REDUNDANCY", DEFAULT_ELASTIC_REDUNDANCY
+    )
 
 
 def check_numerics() -> bool:

@@ -1,0 +1,445 @@
+"""Traced half of the elastic-recovery suite (docs/resilience.md
+"Elastic recovery"): everything that needs real traces on the 8-device
+virtual CPU mesh.
+
+- the epoch→retrace pin: advancing the communication epoch must MISS
+  both program caches (spmd and eager) so no old-world executable can
+  replay — while the re-traced HLO at an unchanged world stays
+  byte-identical (the epoch lives in cache keys, not in programs);
+- HLO byte-identity with the elastic layer idle (epoch 0);
+- the 8-device shrink test: ``elastic.run`` survives a simulated rank
+  loss, finishes the step budget on 7 devices, and the post-restore
+  losses match a clean 7-device run from the restored state onward —
+  the ISSUE's acceptance equality;
+- ShardStore commit/restore bit-identity through jax state;
+- ``Comm.shrink`` / ``GroupComm.shrink`` semantics + collectives over a
+  shrunk comm;
+- MPX126 (collective on a revoked epoch) positive and negative, through
+  ``mpx.analyze`` and the ambient env=error mode.
+
+The pure protocol half (ownership maps, agreement, packing) runs under
+any JAX in tests/test_elastic_pure.py via the isolated loader.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.parallel.mesh import shrink_world_mesh
+from mpi4jax_tpu.resilience import elastic as el
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state():
+    """Every test starts and ends at epoch 0 with the stock default mesh,
+    an empty pending-failure slot, and cold program caches — an elastic
+    shrink mutates all of those."""
+    el._reset_epoch_for_tests()
+    el.take_pending_failure()
+    mpx.set_default_mesh(None)
+    mpx.clear_caches()
+    yield
+    el._reset_epoch_for_tests()
+    el.take_pending_failure()
+    mpx.set_default_mesh(None)
+    mpx.clear_caches()
+    from mpi4jax_tpu.parallel import region as _region
+
+    _region._default_comm = None
+
+
+def _world_comm():
+    mesh = mpx.make_world_mesh()
+    return mpx.Comm(mesh.axis_names[0], mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# epoch -> cache keys (the revocation pin)
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_advance_retraces_spmd_and_hlo_is_unchanged():
+    comm = _world_comm()
+    traces = []
+
+    @mpx.spmd(comm=comm)
+    def f(x):
+        traces.append(1)
+        res, _ = mpx.allreduce(x, op=mpx.SUM, comm=comm)
+        return res
+
+    x = jnp.ones((8, 4))
+    np.testing.assert_allclose(np.asarray(f(x)), 8.0)
+    np.testing.assert_allclose(np.asarray(f(x)), 8.0)
+    assert len(traces) == 1                      # cached
+
+    el.advance_epoch()                           # revoke
+    np.testing.assert_allclose(np.asarray(f(x)), 8.0)
+    assert len(traces) == 2                      # old program unreachable
+    np.testing.assert_allclose(np.asarray(f(x)), 8.0)
+    assert len(traces) == 2                      # new epoch caches again
+
+
+def test_epoch_advance_misses_the_eager_cache():
+    comm = _world_comm()
+    x = jnp.ones((8, 4))
+    mpx.allreduce(x, op=mpx.SUM, comm=comm)
+    before = mpx.cache_stats()
+    mpx.allreduce(x, op=mpx.SUM, comm=comm)
+    mid = mpx.cache_stats()
+    assert mid["hits"] == before["hits"] + 1
+    el.advance_epoch()
+    mpx.allreduce(x, op=mpx.SUM, comm=comm)
+    after = mpx.cache_stats()
+    assert after["misses"] == mid["misses"] + 1
+
+
+def test_hlo_identical_at_epoch_zero_and_across_epochs():
+    """The epoch is a cache-key-only knob: the lowered HLO with the
+    elastic layer idle (epoch 0) is byte-identical to the HLO re-traced
+    after a revocation at an unchanged world — programs never embed the
+    epoch."""
+    comm = _world_comm()
+
+    @mpx.spmd(comm=comm)
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM, comm=comm)
+        return res
+
+    x = jnp.ones((8, 4))
+    epoch0 = jax.jit(f).lower(x).as_text()
+    el.advance_epoch()
+    epoch1 = jax.jit(f).lower(x).as_text()
+    assert epoch0 == epoch1
+
+
+def test_comm_epoch_stamping_and_inheritance():
+    comm = _world_comm()
+    assert comm.epoch == 0
+    assert comm.Clone().epoch == 0
+    el.advance_epoch()
+    assert comm.epoch == 0                       # stamped at construction
+    fresh = _world_comm()
+    assert fresh.epoch == 1
+    # derived comms inherit the parent's (stale) stamp, not the current
+    assert comm.Clone().epoch == 0
+    split = fresh.Split([0, 0, 0, 0, 1, 1, 1, 1])
+    assert split.epoch == 1
+    assert split.Clone().epoch == 1
+
+
+# ---------------------------------------------------------------------------
+# mesh + comm shrink
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_world_mesh_drops_the_failed_devices():
+    mesh = mpx.make_world_mesh()
+    small = shrink_world_mesh(mesh, {3})
+    assert tuple(small.shape.values()) == (7,)
+    assert small.axis_names == mesh.axis_names
+    devices = list(mesh.devices.flat)
+    assert list(small.devices.flat) == devices[:3] + devices[4:]
+    with pytest.raises(ValueError, match="out of range"):
+        shrink_world_mesh(mesh, {8})
+    grid = mpx.make_world_mesh((2, 4), ("y", "x"))
+    with pytest.raises(ValueError, match="1-D"):
+        shrink_world_mesh(grid, {3})
+
+
+def test_comm_shrink_renumbers_and_collectives_work():
+    comm = _world_comm()
+    el.advance_epoch()
+    small_mesh = shrink_world_mesh(comm.mesh, {3})
+    small = comm.shrink({3}, mesh=small_mesh)
+    assert small.Get_size() == 7
+    assert small.epoch == 1
+    assert small.uid != comm.uid                 # fresh matching namespace
+    out, _ = mpx.allreduce(jnp.ones((7, 2)), op=mpx.SUM, comm=small)
+    np.testing.assert_allclose(np.asarray(out), 7.0)
+    with pytest.raises(ValueError, match="spans"):
+        comm.shrink({3}, mesh=comm.mesh)         # wrong (unshrunk) mesh
+
+
+def test_group_comm_shrink_preserves_partition_structure():
+    comm = _world_comm()
+    split = comm.Split([0, 0, 0, 0, 1, 1, 1, 1])
+    assert split.groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+    small_mesh = shrink_world_mesh(comm.mesh, {2, 5})
+    small = split.shrink({2, 5}, mesh=small_mesh)
+    # survivors renumber compactly: 0,1,3 -> 0,1,2 ; 4,6,7 -> 3,4,5
+    assert small.groups == ((0, 1, 2), (3, 4, 5))
+    assert small.Get_size() == 3                 # uniform group size
+    # per-group allreduce over the shrunk split: each group sums itself
+    vals = jnp.arange(6, dtype=jnp.float32)[:, None]
+    out, _ = mpx.allreduce(vals, op=mpx.SUM, comm=small)
+    np.testing.assert_allclose(
+        np.asarray(out)[:, 0], [3, 3, 3, 12, 12, 12])
+
+
+# ---------------------------------------------------------------------------
+# ShardStore through jax state
+# ---------------------------------------------------------------------------
+
+
+def _jax_state():
+    return {
+        "w": jnp.arange(24, dtype=jnp.float32).reshape(4, 6) / 7.0,
+        "opt": [jnp.ones((3,), jnp.float64), jnp.int32(17)],
+    }
+
+
+def test_shardstore_commit_restore_round_trip_on_device_state():
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    state = _jax_state()
+    store.commit(5, state)
+    assert store.committed_step == 5
+    step, restored = store.restore()
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(restored["w"]))
+    np.testing.assert_array_equal(np.asarray(state["opt"][0]),
+                                  np.asarray(restored["opt"][0]))
+    assert int(restored["opt"][1]) == 17
+    # a single-controller process holds every shard
+    assert store.held_shards() == tuple(range(8))
+
+
+def test_shardstore_restore_after_simulated_loss():
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    state = _jax_state()
+    store.commit(9, state)
+    el.advance_epoch()
+    store.apply_shrink({3})
+    assert store.comm.Get_size() == 7
+    step, restored = store.restore({3})
+    assert step == 9
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(restored["w"]))
+
+
+# ---------------------------------------------------------------------------
+# the 8-device shrink drill + loss-equality acceptance
+# ---------------------------------------------------------------------------
+
+
+def _make_step(comm_free_losses):
+    """A DP-style step over the CURRENT comm: grad allreduce + update,
+    logging (step, world, loss)."""
+    programs = {}
+
+    def step_fn(state, step, comm):
+        key = (comm.uid, comm.epoch)
+        if key not in programs:
+            size = comm.Get_size()
+
+            @mpx.spmd(comm=comm)
+            def train(params, x):
+                def loss_fn(p, x):
+                    return jnp.mean((x @ p) ** 2)
+
+                loss, grad = jax.value_and_grad(loss_fn)(params, x)
+                grad, _ = mpx.allreduce(grad, op=mpx.SUM, comm=comm)
+                loss, _ = mpx.allreduce(loss, op=mpx.SUM, comm=comm)
+                return mpx.varying((params - 0.05 * grad / size,
+                                    loss / size))
+
+            programs[key] = train
+
+        k = comm.Get_size()
+        rng = np.random.default_rng(100 + step)
+        x = jnp.asarray(rng.normal(size=(k, 4, 3)).astype(np.float32))
+        params_g = jnp.tile(jnp.asarray(state["p"])[None], (k, 1, 1))
+        params_g, loss = programs[key](params_g, x)
+        comm_free_losses.append(
+            {"step": step, "world": k, "loss": float(np.asarray(loss)[0])})
+        return {"p": np.asarray(params_g[0])}
+
+    return step_fn
+
+
+def test_elastic_run_survives_shrink_and_matches_clean_small_run():
+    """The acceptance equality: a run that loses rank 3 at step 4 must
+    (a) finish the full budget on 7 ranks at epoch 1, and (b) produce,
+    from the restored step onward, exactly the losses of a CLEAN 7-rank
+    run started from the committed state."""
+    steps, fail_at = 8, 4
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    losses = []
+    base = _make_step(losses)
+
+    committed = {}
+
+    def failing_step(state, step, comm):
+        if step == fail_at and comm.epoch == 0:
+            # the failure strikes BEFORE step fail_at's work: the state
+            # entering this step is exactly the store's last commit
+            committed["state"] = {"p": np.array(state["p"])}
+            raise mpx.RankFailure({3}, "simulated")
+        return base(state, step, comm)
+
+    p0 = np.full((3, 1), 0.5, np.float32)
+    final = mpx.elastic.run(failing_step, {"p": p0}, store, steps=steps)
+
+    assert el.current_epoch() == 1
+    assert store.comm.Get_size() == 7
+    last = [r for r in losses if r["step"] == steps - 1]
+    assert len(last) == 1 and last[0]["world"] == 7
+    # (a) the budget completed: steps fail_at..steps-1 replayed on 7 ranks
+    post = [r for r in losses if r["world"] == 7]
+    assert sorted({r["step"] for r in post}) == list(range(fail_at, steps))
+
+    # (b) replay clean on 7 devices from the committed state
+    el._reset_epoch_for_tests()
+    mpx.set_default_mesh(None)
+    mpx.clear_caches()
+    small_mesh = shrink_world_mesh(mpx.make_world_mesh(), {3})
+    small_comm = mpx.Comm(small_mesh.axis_names[0], mesh=small_mesh)
+    clean_losses = []
+    clean_step = _make_step(clean_losses)
+    state = {"p": committed["state"]["p"]}
+    for s in range(fail_at, steps):
+        state = clean_step(state, s, small_comm)
+
+    post_by_step = {r["step"]: r["loss"] for r in post}
+    clean_by_step = {r["step"]: r["loss"] for r in clean_losses}
+    assert post_by_step.keys() == clean_by_step.keys()
+    for s in post_by_step:
+        np.testing.assert_allclose(post_by_step[s], clean_by_step[s],
+                                   rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(final["p"]), np.asarray(state["p"]),
+                               rtol=1e-6)
+
+
+def test_elastic_run_commits_and_replays_from_commit_boundary():
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    seen = []
+
+    def step_fn(state, step, comm):
+        seen.append((step, comm.Get_size()))
+        if step == 3 and comm.epoch == 0:
+            raise mpx.RankFailure({7}, "simulated")
+        return {"n": state["n"] + 1}
+
+    out = mpx.elastic.run(step_fn, {"n": 0}, store, steps=5, commit_every=2)
+    # commit at 0 and 2; failure at step 3 replays steps 2..4 on 7 ranks
+    assert seen == [(0, 8), (1, 8), (2, 8), (3, 8),
+                    (2, 7), (3, 7), (4, 7)]
+    assert out["n"] == 5
+
+
+# ---------------------------------------------------------------------------
+# MPX126: collectives across a revoked epoch
+# ---------------------------------------------------------------------------
+
+
+def test_mpx126_flags_stale_comm_and_passes_fresh_comm():
+    stale = _world_comm()
+
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM, comm=stale)
+        return res
+
+    # negative: same epoch, clean
+    report = mpx.analyze(f, jnp.ones((8, 2)), comm=stale)
+    assert not [fd for fd in report.findings if fd.code == "MPX126"], (
+        report.render())
+
+    el.advance_epoch()
+    report = mpx.analyze(f, jnp.ones((8, 2)), comm=stale)
+    codes = [fd.code for fd in report.findings]
+    assert "MPX126" in codes, report.render()
+    (finding,) = [fd for fd in report.findings if fd.code == "MPX126"]
+    assert "epoch" in finding.message
+    assert finding.severity == "error"
+
+    # negative after recovery: a freshly-built comm is current-epoch
+    fresh = _world_comm()
+
+    def g(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM, comm=fresh)
+        return res
+
+    report = mpx.analyze(g, jnp.ones((8, 2)), comm=fresh)
+    assert not [fd for fd in report.findings if fd.code == "MPX126"], (
+        report.render())
+
+
+def test_mpx126_fires_through_ambient_error_mode():
+    stale = _world_comm()
+    x = jnp.ones((8, 2))
+    mpx.set_analyze_mode("error")
+    try:
+        out, _ = mpx.allreduce(x, op=mpx.SUM, comm=stale)  # clean at epoch 0
+        np.testing.assert_allclose(np.asarray(out), 8.0)
+        el.advance_epoch()
+        with pytest.raises(mpx.AnalysisError, match="MPX126"):
+            mpx.allreduce(x, op=mpx.SUM, comm=stale)
+    finally:
+        mpx.set_analyze_mode(None)
+
+
+def test_elastic_run_produces_mpx126_clean_recovery():
+    """The whole point of re-entering through elastic.run: the recovered
+    loop's collectives run on CURRENT-epoch comms, so the verifier stays
+    clean across the shrink."""
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+
+    def step_fn(state, step, comm):
+        out, _ = mpx.allreduce(jnp.ones((comm.Get_size(), 2)), op=mpx.SUM,
+                               comm=comm)
+        assert float(np.asarray(out)[0, 0]) == comm.Get_size()
+        if step == 1 and comm.epoch == 0:
+            raise mpx.RankFailure({3}, "simulated")
+        return state
+
+    mpx.set_analyze_mode("error")
+    try:
+        mpx.elastic.run(step_fn, {"x": 1}, store, steps=3)
+    finally:
+        mpx.set_analyze_mode(None)
+    assert el.current_epoch() == 1
+
+
+# ---------------------------------------------------------------------------
+# watchdog claim wiring (traced)
+# ---------------------------------------------------------------------------
+
+
+def test_claimed_watchdog_expiry_recovers_instead_of_killing():
+    """End to end on one host: a watchdog expiry posted by the claimed
+    handler converts into a shrink instead of a process kill (the
+    single-process analog of the hang drill — the collective itself
+    cannot hang here, so the expiry is driven through the registry)."""
+    from mpi4jax_tpu.resilience import watchdog as wd
+
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+
+    def step_fn(state, step, comm):
+        if step == 1 and comm.epoch == 0:
+            # simulate what the monitor thread does on expiry with the
+            # elastic handler claimed: journal, post, interrupt
+            el._claimed_on_timeout(
+                [], {"opname": "MPI_Allreduce", "call_id": "deadbeef",
+                     "rank": 3, "timeout": 1.0, "elapsed": 2.0})
+            raise mpx.RankFailure({3}, "expiry attribution")
+        return state
+
+    out = mpx.elastic.run(step_fn, {"x": 0}, store, steps=3)
+    assert out == {"x": 0}
+    assert el.current_epoch() == 1
+    assert store.comm.Get_size() == 7
+    # the loop restored the default handler + native routing on exit
+    assert wd._registry.on_timeout is wd._default_on_timeout
+    assert not wd._force_fallback

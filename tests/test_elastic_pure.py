@@ -1,0 +1,841 @@
+"""Pure half of the elastic-recovery suite (docs/resilience.md
+"Elastic recovery").
+
+Everything here runs WITHOUT importing mpi4jax_tpu (the isolated loader
+below, mirroring tests/test_resilience.py), so the protocol core is
+verified under any JAX version:
+
+- epoch arithmetic + the resilience cache token carrying it;
+- shard ownership, k-redundant neighbor-replication placement, and the
+  reconstruction plan (including the unrecoverable-loss error);
+- rank compaction and color-split group shrink;
+- failure agreement: the gossip fixpoint on simulated link matrices
+  (agreement within a connected component, suspicion of unreachable
+  peers, split-brain majority arbitration) and the TCP runtime form on
+  localhost;
+- ShardStore commit/reassemble simulated with per-rank stores — kill any
+  `redundancy` ranks and the state returns bit-identical;
+- failure classification (explicit, watchdog-claimed, death-rattle);
+- the `hang` fault verb (parser + probe semantics);
+- pluggable watchdog `on_timeout` + registry drain;
+- `retry_with_backoff(max_attempts=...)` and the bootstrap flags;
+- `elastic.run`'s control flow against a scripted fake store.
+
+The traced half (epoch→retrace cache pin, HLO identity with elastic off,
+the 8-device shrink) is tests/test_elastic.py, which needs jax >= the
+package floor.
+"""
+
+import importlib
+import os
+import pathlib
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_elastic_iso"
+
+
+def _load_isolated():
+    """Load the pure-Python elastic stack under a private package name
+    (bypasses mpi4jax_tpu/__init__.py and its JAX floor; state isolated
+    from any real import in the same process)."""
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "resilience"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in (
+        "utils.config",
+        "resilience.faultinject",
+        "resilience.retry",
+        "resilience.watchdog",
+        "resilience.elastic",
+        "resilience.runtime",
+    ):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+el = ISO.resilience.elastic
+fi = ISO.resilience.faultinject
+wd = ISO.resilience.watchdog
+rt = ISO.resilience.runtime
+retry_mod = ISO.resilience.retry
+config = ISO.utils.config
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    el._reset_epoch_for_tests()
+    el.take_pending_failure()
+    wd.set_on_timeout(None)
+    wd.drain_registry()
+    fi.reset_fault_state()
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in (
+            "MPI4JAX_TPU_BOOTSTRAP_DEADLINE",
+            "MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS",
+            "MPI4JAX_TPU_ELASTIC_REDUNDANCY",
+        )
+    }
+    yield
+    el._reset_epoch_for_tests()
+    el.take_pending_failure()
+    wd.set_on_timeout(None)
+    wd.drain_registry()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# epoch arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_starts_at_zero_and_advances_monotonically():
+    assert el.current_epoch() == 0
+    assert el.elastic_cache_token() == 0
+    assert el.advance_epoch() == 1
+    assert el.advance_epoch() == 2
+    assert el.current_epoch() == 2
+    assert el.elastic_cache_token() == 2
+
+
+def test_advance_epoch_bumps_config_epoch():
+    """Every stamp-memoized configuration consumer must invalidate on a
+    revocation — that is how the epoch reaches the program-cache keys."""
+    before = config.config_epoch()
+    el.advance_epoch()
+    assert config.config_epoch() > before
+
+
+def test_resilience_cache_token_carries_the_epoch():
+    base = rt.cache_token()
+    assert base[-1] == 0
+    el.advance_epoch()
+    bumped = rt.cache_token()
+    assert bumped != base
+    assert bumped[-1] == 1
+    # everything else in the token is untouched by a revocation
+    assert bumped[:-1] == base[:-1]
+
+
+# ---------------------------------------------------------------------------
+# shard ownership + replication placement
+# ---------------------------------------------------------------------------
+
+
+def test_shard_bounds_equal_chunks_with_padding():
+    assert el.shard_bounds(0, 4) == (0, 0)
+    assert el.shard_bounds(100, 4) == (25, 100)
+    assert el.shard_bounds(101, 4) == (26, 104)   # ceil + pad
+    assert el.shard_bounds(3, 8) == (1, 8)
+    with pytest.raises(ValueError, match="at least one rank"):
+        el.shard_bounds(10, 0)
+
+
+def test_replica_ranks_neighbor_placement():
+    assert el.replica_ranks(0, 8, 1) == (0, 1)
+    assert el.replica_ranks(7, 8, 1) == (7, 0)    # wraps
+    assert el.replica_ranks(2, 8, 2) == (2, 3, 4)
+    assert el.replica_ranks(5, 8, 0) == (5,)      # no redundancy: owner only
+    # more copies than ranks degenerates to "everyone"
+    assert el.replica_ranks(1, 3, 7) == (1, 2, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        el.replica_ranks(8, 8, 1)
+    with pytest.raises(ValueError, match="redundancy"):
+        el.replica_ranks(0, 8, -1)
+
+
+def test_shards_held_by_is_the_inverse_of_replica_ranks():
+    for k in (1, 2, 3, 5, 8):
+        for red in (0, 1, 2, k - 1):
+            for r in range(k):
+                held = el.shards_held_by(r, k, red)
+                assert len(held) == min(red, k - 1) + 1
+                for s in held:
+                    assert r in el.replica_ranks(s, k, red)
+            # every shard has exactly redundancy+1 holders
+            counts = {s: 0 for s in range(k)}
+            for r in range(k):
+                for s in el.shards_held_by(r, k, red):
+                    counts[s] += 1
+            assert set(counts.values()) == {min(red, k - 1) + 1}
+
+
+def test_recoverable_tolerates_exactly_the_redundancy_budget():
+    # any single failure is recoverable at redundancy 1
+    for r in range(8):
+        assert el.recoverable({r}, 8, 1)
+    # two ADJACENT failures kill a whole replica set at redundancy 1
+    assert not el.recoverable({3, 4}, 8, 1)      # shard 3's copies: ranks 3,4
+    # two non-adjacent failures are fine
+    assert el.recoverable({1, 5}, 8, 1)
+    # redundancy 2 tolerates any 2 failures
+    for a in range(8):
+        for b in range(8):
+            if a != b:
+                assert el.recoverable({a, b}, 8, 2)
+
+
+def test_reconstruction_plan_names_lowest_surviving_holder():
+    plan = el.reconstruction_plan({3}, 8, 1)
+    assert set(plan) == set(range(8))
+    assert plan[3] == 4          # shard 3's owner died; right neighbor holds it
+    assert plan[2] == 2          # untouched shards use their owner
+    for s, provider in plan.items():
+        assert provider != 3
+        assert provider in el.replica_ranks(s, 8, 1)
+    with pytest.raises(el.RankFailure, match="unrecoverable"):
+        el.reconstruction_plan({3, 4}, 8, 1)
+
+
+# ---------------------------------------------------------------------------
+# rank compaction + group shrink
+# ---------------------------------------------------------------------------
+
+
+def test_compact_rank_map_renumbers_ascending():
+    assert el.compact_rank_map(4, {3}) == {0: 0, 1: 1, 2: 2}
+    assert el.compact_rank_map(4, {0}) == {1: 0, 2: 1, 3: 2}
+    assert el.compact_rank_map(8, {2, 5}) == {
+        0: 0, 1: 1, 3: 2, 4: 3, 6: 4, 7: 5,
+    }
+    with pytest.raises(ValueError, match="out of range"):
+        el.compact_rank_map(4, {4})
+    with pytest.raises(el.RankFailure, match="no survivors"):
+        el.compact_rank_map(2, {0, 1})
+
+
+def test_shrink_groups_drops_dead_and_preserves_order():
+    groups = ((0, 2, 4, 6), (1, 3, 5, 7))
+    assert el.shrink_groups(groups, {3}, 8) == ((0, 2, 3, 5), (1, 4, 6))
+    # a group losing every member disappears
+    assert el.shrink_groups(((0, 1), (2, 3)), {2, 3}, 4) == ((0, 1),)
+    # key-ordered (non-ascending) member order survives the renumbering
+    assert el.shrink_groups(((2, 0, 1),), {1}, 3) == ((1, 0),)
+
+
+# ---------------------------------------------------------------------------
+# failure agreement
+# ---------------------------------------------------------------------------
+
+
+def _links(world, down=(), cut=()):
+    """Full link matrix minus every link touching ``down`` ranks and the
+    explicit ``cut`` pairs."""
+    m = [[i != j for j in range(world)] for i in range(world)]
+    for r in down:
+        for j in range(world):
+            m[r][j] = m[j][r] = False
+    for a, b in cut:
+        m[a][b] = m[b][a] = False
+    return m
+
+
+def test_gossip_agreement_converges_on_the_union():
+    # ranks 0 and 1 each suspect a different dead rank; everyone agrees
+    # on the union, and the dead are suspected by everyone via dead links
+    agreed = el.gossip_agreement(
+        {0: {6}, 1: {7}}, _links(8, down=(6, 7)))
+    for r in range(6):
+        assert agreed[r] == frozenset({6, 7}), r
+
+
+def test_gossip_agreement_suspects_unreachable_peers_without_hints():
+    # nobody *observed* anything, but rank 5's links are all down
+    agreed = el.gossip_agreement({}, _links(8, down=(5,)))
+    for r in range(8):
+        if r != 5:
+            assert agreed[r] == frozenset({5})
+
+
+def test_gossip_agreement_partition_disagrees_and_majority_arbitrates():
+    # cut the world into {0,1,2,3,4} and {5,6,7}: each side suspects the
+    # other wholesale
+    cut = [(a, b) for a in range(5) for b in range(5, 8)]
+    agreed = el.gossip_agreement({}, _links(8, cut=cut))
+    for r in range(5):
+        assert agreed[r] == frozenset({5, 6, 7})
+    for r in range(5, 8):
+        assert agreed[r] == frozenset({0, 1, 2, 3, 4})
+    # the majority side continues; the minority must abort
+    assert el.majority_survives(agreed[0], 8)
+    assert not el.majority_survives(agreed[5], 8)
+    # exact half is NOT a majority (4 of 8 survive)
+    assert not el.majority_survives({0, 1, 2, 3}, 8)
+
+
+def test_exchange_suspects_tcp_converges_across_survivors():
+    """The runtime agreement on localhost: 3 survivors of a 4-rank world
+    (rank 3 dead, its port never listening) with DIFFERENT local
+    suspicions all converge on {3}."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        base = s.getsockname()[1]
+    # find a base with 4 free consecutive ports (the probe above freed one)
+    world = 4
+    suspects = {0: {3}, 1: set(), 2: set()}
+    results = {}
+
+    def worker(rank):
+        results[rank] = el.exchange_suspects(
+            rank, world, suspects[rank], "localhost", base,
+            timeout=5.0,
+        )
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == {r: frozenset({3}) for r in range(3)}, results
+
+
+# ---------------------------------------------------------------------------
+# state packing + ShardStore simulation
+# ---------------------------------------------------------------------------
+
+
+def _state():
+    return {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                   "b": np.ones((5,), np.float64)},
+        "opt": [np.arange(7, dtype=np.int32), np.float32(2.5)],
+        "step_scale": np.bool_(True),
+    }
+
+
+def _assert_state_equal(a, b):
+    assert set(a) == set(b)
+    np.testing.assert_array_equal(a["params"]["w"], b["params"]["w"])
+    np.testing.assert_array_equal(a["params"]["b"], b["params"]["b"])
+    np.testing.assert_array_equal(a["opt"][0], b["opt"][0])
+    np.testing.assert_array_equal(a["opt"][1], b["opt"][1])
+    np.testing.assert_array_equal(a["step_scale"], b["step_scale"])
+    assert np.asarray(b["params"]["w"]).dtype == np.float32
+    assert np.asarray(b["params"]["b"]).dtype == np.float64
+    assert np.asarray(b["opt"][0]).dtype == np.int32
+
+
+def test_pack_unpack_leaves_round_trip_mixed_dtypes():
+    leaves = [np.arange(5, dtype=np.float32),
+              np.arange(6, dtype=np.int64).reshape(2, 3),
+              np.asarray(True)]
+    buf, meta = el.pack_leaves(leaves)
+    assert buf.dtype == np.uint8
+    assert buf.nbytes == sum(m[2] for m in meta)
+    out = el.unpack_leaves(buf, meta)
+    for a, b in zip(leaves, out):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype and a.shape == b.shape
+    # empty state packs to an empty buffer
+    buf0, meta0 = el.pack_leaves([])
+    assert buf0.nbytes == 0 and meta0 == []
+
+
+def _per_rank_stores(k, redundancy, step, state):
+    stores = {}
+    for r in range(k):
+
+        class _FixedComm:
+            def world_size(self, _k=k):
+                return _k
+
+        store = el.ShardStore(_FixedComm(), redundancy=redundancy, rank=r)
+        store.commit(step, state)
+        stores[r] = store
+    return stores
+
+
+def test_shardstore_per_rank_holdings_match_the_placement():
+    stores = _per_rank_stores(8, 1, 3, _state())
+    for r, store in stores.items():
+        rec = store._committed
+        assert rec["step"] == 3 and rec["k"] == 8
+        assert tuple(sorted(rec["shards"])) == el.shards_held_by(r, 8, 1)
+        for s, payload in rec["shards"].items():
+            assert len(payload) == rec["shard"]
+
+
+@pytest.mark.parametrize("k,redundancy,failed", [
+    (8, 1, {3}),
+    (8, 1, {0}),
+    (8, 1, {7}),
+    (8, 2, {3, 4}),      # adjacent double loss needs redundancy 2
+    (8, 2, {0, 7}),      # wrap-adjacent double loss
+    (4, 1, {2}),
+    (3, 2, {0, 1}),
+    (5, 1, set()),       # no failure: trivial reassembly
+])
+def test_shardstore_reassembles_bit_identical_after_losses(
+        k, redundancy, failed):
+    state = _state()
+    stores = _per_rank_stores(k, redundancy, 11, state)
+    step, restored = el.reassemble_from_stores(
+        {r: s for r, s in stores.items() if r not in failed}
+        | {r: stores[r] for r in failed},  # full dict; failed arg filters
+        failed,
+    )
+    assert step == 11
+    _assert_state_equal(state, restored)
+
+
+def test_shardstore_reassembly_fails_loudly_past_the_budget():
+    stores = _per_rank_stores(8, 1, 5, _state())
+    with pytest.raises(el.RankFailure, match="unrecoverable"):
+        el.reassemble_from_stores(stores, {3, 4})
+
+
+def test_shardstore_redundancy_default_comes_from_the_flag():
+    class _C:
+        def world_size(self):
+            return 4
+
+    assert el.ShardStore(_C()).redundancy == 1
+    os.environ["MPI4JAX_TPU_ELASTIC_REDUNDANCY"] = "2"
+    assert el.ShardStore(_C()).redundancy == 2
+    with pytest.raises(ValueError):
+        el.ShardStore(_C(), redundancy=-1)
+
+
+def test_shardstore_restore_requires_a_commit():
+    class _C:
+        def world_size(self):
+            return 4
+
+    store = el.ShardStore(_C(), redundancy=1, rank=0)
+    with pytest.raises(RuntimeError, match="nothing committed"):
+        store.restore()
+
+
+# ---------------------------------------------------------------------------
+# failure classification
+# ---------------------------------------------------------------------------
+
+
+def test_classify_failure_passthrough_and_markers():
+    rf = el.RankFailure({3}, "peer death")
+    assert el.classify_failure(rf) is rf
+    assert el.classify_failure(RuntimeError("heartbeat deadline exceeded"))
+    assert el.classify_failure(OSError("connection reset by peer"))
+    assert el.classify_failure(ValueError("heartbeat")) is None  # wrong type
+    assert el.classify_failure(RuntimeError("shape mismatch")) is None
+
+
+def test_classify_failure_adopts_the_watchdog_claim():
+    el._post_failure(el.RankFailure((), "watchdog expiry: MPI_Allreduce"))
+    rf = el.classify_failure(KeyboardInterrupt())
+    assert rf is not None and "watchdog expiry" in rf.detail
+    # the pending slot drained: an ordinary error afterwards propagates
+    assert el.classify_failure(RuntimeError("shape mismatch")) is None
+
+
+def test_rank_failure_message_names_the_suspects():
+    assert "unknown" in str(el.RankFailure())
+    assert "[2, 5]" in str(el.RankFailure({5, 2}))
+
+
+# ---------------------------------------------------------------------------
+# hang fault verb
+# ---------------------------------------------------------------------------
+
+
+def test_hang_spec_parses_and_round_trips():
+    (c,) = fi.parse_fault_spec("hang:rank=3:op=allreduce:after=5")
+    assert (c.verb, c.rank, c.op, c.after) == ("hang", 3, "allreduce", 5)
+    canon = fi.canonical_spec((c,))
+    assert canon == "hang:rank=3:op=allreduce:after=5"
+    assert fi.parse_fault_spec(canon) == (c,)
+    # bare hang: every rank, every op, immediately
+    (c,) = fi.parse_fault_spec("hang")
+    assert (c.rank, c.op, c.after) == (None, None, 0)
+    assert c.matches_op("barrier")
+
+
+def test_hang_spec_rejects_delay_only_args():
+    with pytest.raises(ValueError, match="secs"):
+        fi.parse_fault_spec("hang:secs=2")
+    with pytest.raises(ValueError, match="bare field"):
+        fi.parse_fault_spec("hang:nan")
+
+
+def test_hang_probe_blocks_until_interrupted(monkeypatch):
+    """The hang probe sleeps in bounded naps (so drills stay
+    interruptible); after the ``after`` window it never returns on the
+    firing rank, and other ranks run clean."""
+    naps = []
+
+    def fake_hang():
+        naps.append(True)
+        raise _Escaped
+
+    class _Escaped(Exception):
+        pass
+
+    monkeypatch.setattr(fi, "_hang_forever", fake_hang)
+    (c,) = fi.parse_fault_spec("hang:rank=1:after=1")
+    indexed = ((0, c),)
+    assert fi.probe_host(indexed, "MPI_Allreduce", 0) == 0   # wrong rank
+    assert fi.probe_host(indexed, "MPI_Allreduce", 1) == 0   # clean window
+    with pytest.raises(_Escaped):
+        fi.probe_host(indexed, "MPI_Allreduce", 1)           # hangs
+    assert naps == [True]
+
+
+def test_hang_nap_is_bounded():
+    """The real hang loop sleeps in ``_HANG_NAP_SECS`` slices, not one
+    giant sleep — the property that keeps interrupt_main effective."""
+    assert 0 < fi._HANG_NAP_SECS <= 5.0
+
+
+# ---------------------------------------------------------------------------
+# pluggable watchdog handler + drain
+# ---------------------------------------------------------------------------
+
+
+def test_set_on_timeout_swaps_and_restores_the_live_handler():
+    assert wd._registry.on_timeout is wd._default_on_timeout
+    marker = lambda entries, expired: None  # noqa: E731
+    wd.set_on_timeout(marker)
+    assert wd._registry.on_timeout is marker
+    wd.set_on_timeout(None)
+    assert wd._registry.on_timeout is wd._default_on_timeout
+
+
+def test_monitor_survives_a_nonfatal_handler_and_keeps_watching():
+    """A claiming handler (elastic recovery) returns instead of killing;
+    the monitor must drain the claimed entries and catch a LATER expiry
+    with the same thread."""
+    fired = []
+    reg = wd._Registry(on_timeout=lambda entries, expired: fired.append(
+        expired["call_id"]))
+    reg.arm("MPI_Allreduce", "aaaa0001", 0, "('i',)", timeout=0.1)
+    deadline = time.monotonic() + 5.0
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert fired == ["aaaa0001"]
+    deadline = time.monotonic() + 5.0
+    while not reg.empty() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert reg.empty()           # claimed entries drained
+    # the SAME monitor catches the next epoch's expiry
+    reg.arm("MPI_Bcast", "aaaa0002", 0, "('i',)", timeout=0.1)
+    deadline = time.monotonic() + 5.0
+    while len(fired) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert fired == ["aaaa0001", "aaaa0002"]
+
+
+def test_monitor_claim_drains_only_expired_entries():
+    """A claimed expiry must not wipe the un-expired arms of unrelated
+    concurrent collectives — they keep their watchdog coverage."""
+    now = [100.0]
+    reg = wd._Registry(on_timeout=lambda e, x: None, clock=lambda: now[0])
+    reg.arm("MPI_Allreduce", "dddd0001", 0, "('i',)", timeout=1.0)
+    reg.arm("MPI_Bcast", "dddd0002", 0, "('i',)", timeout=900.0)
+    now[0] += 2.0                               # only the allreduce expired
+    assert reg.check_expired()["opname"] == "MPI_Allreduce"
+    assert reg.drain_expired() == 1
+    snap = reg.snapshot()
+    assert [e["opname"] for e in snap] == ["MPI_Bcast"]
+    assert reg.check_expired() is None          # survivor not expired
+
+
+def test_exchange_suspects_returns_the_self_verdict():
+    """A rank whose peers declared it failed must SEE itself in its own
+    agreement result (so _recover can abort it) — the verdict is not
+    stripped on the way out."""
+    import json
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        base = s.getsockname()[1]
+
+    # fake rank 1: accept rank 0's sends, and tell rank 0 that rank 0 is
+    # the failed one
+    def fake_peer():
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("localhost", base + 1))
+        srv.listen(2)
+        srv.settimeout(10.0)
+        msg = json.dumps({"from": 1, "suspects": [0]}).encode()
+        try:
+            with socket.create_connection(("localhost", base + 0),
+                                          timeout=10.0) as c:
+                c.sendall(len(msg).to_bytes(8, "big") + msg)
+            for _ in range(2):
+                try:
+                    conn, _ = srv.accept()
+                    conn.close()
+                except socket.timeout:
+                    break
+        finally:
+            srv.close()
+
+    t = threading.Thread(target=fake_peer, daemon=True)
+    result = {}
+
+    def me():
+        result["agreed"] = el.exchange_suspects(
+            0, 2, (), "localhost", base, timeout=5.0)
+
+    m = threading.Thread(target=me, daemon=True)
+    m.start()
+    time.sleep(0.3)           # my server is up before the peer connects
+    t.start()
+    m.join(timeout=30)
+    t.join(timeout=30)
+    assert 0 in result["agreed"], result
+
+
+def test_drain_registry_counts_and_clears():
+    wd._registry.arm("MPI_Allreduce", "bbbb0001", 0, "('i',)", timeout=900)
+    wd._registry.arm("MPI_Allreduce", "bbbb0001", 1, "('i',)", timeout=900)
+    assert not wd.registry_empty()
+    assert wd.drain_registry() == 2
+    assert wd.registry_empty()
+    assert wd.drain_registry() == 0
+
+
+# ---------------------------------------------------------------------------
+# retry max_attempts + bootstrap flags
+# ---------------------------------------------------------------------------
+
+
+class _Flaky:
+    def __init__(self, refusals):
+        self.left = refusals
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.left > 0:
+            self.left -= 1
+            raise ConnectionError(f"refused ({self.calls})")
+        return "ok"
+
+
+def test_retry_max_attempts_caps_before_the_deadline():
+    fn = _Flaky(10)
+    with pytest.raises(RuntimeError, match="max_attempts 3") as ei:
+        retry_mod.retry_with_backoff(
+            fn, what="rendezvous", deadline=1e9, max_attempts=3,
+            jitter=False, sleep=lambda s: None, clock=lambda: 0.0,
+        )
+    assert fn.calls == 3
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+def test_retry_max_attempts_none_or_zero_is_unlimited():
+    now = [0.0]
+
+    def sleep(s):
+        now[0] += s
+
+    for cap in (None, 0):
+        fn = _Flaky(4)
+        out = retry_mod.retry_with_backoff(
+            fn, deadline=300.0, max_attempts=cap, jitter=False,
+            sleep=sleep, clock=lambda: now[0],
+        )
+        assert out == "ok" and fn.calls == 5
+    with pytest.raises(ValueError, match="max_attempts"):
+        retry_mod.retry_with_backoff(lambda: None, max_attempts=-1)
+
+
+def test_bootstrap_flags_parse_and_validate():
+    assert config.bootstrap_deadline() == 300.0
+    assert config.bootstrap_max_attempts() == 0
+    os.environ["MPI4JAX_TPU_BOOTSTRAP_DEADLINE"] = "12.5"
+    os.environ["MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS"] = "7"
+    assert config.bootstrap_deadline() == 12.5
+    assert config.bootstrap_max_attempts() == 7
+    os.environ["MPI4JAX_TPU_BOOTSTRAP_DEADLINE"] = "0"
+    with pytest.raises(ValueError, match="BOOTSTRAP_DEADLINE"):
+        config.bootstrap_deadline()
+    os.environ["MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS"] = "-1"
+    with pytest.raises(ValueError, match="BOOTSTRAP_MAX_ATTEMPTS"):
+        config.bootstrap_max_attempts()
+    os.environ["MPI4JAX_TPU_ELASTIC_REDUNDANCY"] = "nope"
+    with pytest.raises(ValueError, match="ELASTIC_REDUNDANCY"):
+        config.elastic_redundancy()
+
+
+# ---------------------------------------------------------------------------
+# elastic.run control flow (scripted fake store: no jax, no mesh)
+# ---------------------------------------------------------------------------
+
+
+class _FakeComm:
+    def __init__(self, size):
+        self._size = size
+
+    def world_size(self):
+        return self._size
+
+
+class _FakeStore:
+    """Scripted ShardStore double: world of 4, shrink drops the failed
+    ranks, restore replays the committed (step, state)."""
+
+    def __init__(self, world=4):
+        self.redundancy = 1
+        self.bootstrap = {}
+        self.comm = _FakeComm(world)
+        self.commits = []
+        self._committed = None
+        self.shrunk_with = None
+
+    @property
+    def committed_step(self):
+        return self._committed and self._committed[0]
+
+    def commit(self, step, state):
+        self._committed = (step, state)
+        self.commits.append(step)
+
+    def multiprocess(self):
+        return False
+
+    def apply_shrink(self, failed):
+        self.shrunk_with = frozenset(failed)
+        self.comm = _FakeComm(self.comm.world_size() - len(self.shrunk_with))
+
+    def restore(self, failed=()):
+        return self._committed
+
+
+def test_run_happy_path_commits_on_schedule():
+    store = _FakeStore()
+    steps_seen = []
+
+    def step_fn(state, step, comm):
+        steps_seen.append((step, comm.world_size()))
+        return state + 1
+
+    out = el.run(step_fn, 0, store, steps=6, commit_every=2)
+    assert out == 6
+    assert steps_seen == [(s, 4) for s in range(6)]
+    # initial commit at 0, then every 2 steps
+    assert store.commits == [0, 2, 4, 6]
+
+
+def test_run_recovers_from_an_explicit_rank_failure():
+    store = _FakeStore()
+    calls = {"fails": 0}
+
+    def step_fn(state, step, comm):
+        if step == 3 and calls["fails"] == 0:
+            calls["fails"] += 1
+            raise el.RankFailure({3}, "simulated death")
+        return state + 1
+
+    out = el.run(step_fn, 0, store, steps=5, commit_every=1)
+    # failure at step 3 replays from committed step 3: total = 5 steps of
+    # +1 from the restored value 3
+    assert out == 5
+    assert store.shrunk_with == frozenset({3})
+    assert store.comm.world_size() == 3
+    assert el.current_epoch() == 1           # exactly one revocation
+
+
+def test_run_refuses_empty_agreed_failure():
+    store = _FakeStore()
+
+    def step_fn(state, step, comm):
+        raise el.RankFailure((), "suspects unknown, no agreement channel")
+
+    with pytest.raises(el.RankFailure, match="empty failed set"):
+        el.run(step_fn, 0, store, steps=2)
+
+
+def test_run_refuses_minority_partition():
+    store = _FakeStore()
+
+    def step_fn(state, step, comm):
+        raise el.RankFailure({0, 1, 2}, "three of four died")
+
+    with pytest.raises(el.RankFailure, match="majority"):
+        el.run(step_fn, 0, store, steps=2)
+    assert el.current_epoch() == 0           # no revocation on refusal
+
+
+def test_run_propagates_ordinary_errors():
+    store = _FakeStore()
+
+    def step_fn(state, step, comm):
+        raise ValueError("a plain bug")
+
+    with pytest.raises(ValueError, match="plain bug"):
+        el.run(step_fn, 0, store, steps=2)
+
+
+def test_run_claims_and_restores_the_watchdog_handler():
+    store = _FakeStore()
+    seen = []
+
+    def step_fn(state, step, comm):
+        seen.append(wd._registry.on_timeout)
+        return state
+
+    el.run(step_fn, 0, store, steps=1)
+    assert seen == [el._claimed_on_timeout]
+    assert wd._registry.on_timeout is wd._default_on_timeout
+    el.run(step_fn, 0, store, steps=1, claim_watchdog=False)
+    assert seen[-1] is wd._default_on_timeout
+
+
+def test_run_recovery_from_watchdog_claim_pending():
+    """A pending failure posted by the claimed handler converts the
+    interrupting exception into a recovery."""
+    store = _FakeStore()
+    fired = {"n": 0}
+
+    def step_fn(state, step, comm):
+        if step == 1 and fired["n"] == 0:
+            fired["n"] += 1
+            el._post_failure(el.RankFailure({2}, "watchdog expiry"))
+            raise KeyboardInterrupt
+        return state + 1
+
+    out = el.run(step_fn, 0, store, steps=3)
+    assert out == 3
+    assert store.shrunk_with == frozenset({2})
+
+
+def test_revoke_epoch_drains_watchdog_and_advances():
+    wd._registry.arm("MPI_Allreduce", "cccc0001", 0, "('i',)", timeout=900)
+    new = el.revoke_epoch({3}, rank=0, world=4)
+    assert new == 1 and el.current_epoch() == 1
+    assert wd.registry_empty()
+
+
+def test_run_validates_arguments():
+    store = _FakeStore()
+    with pytest.raises(ValueError, match="steps"):
+        el.run(lambda s, i, c: s, 0, store, steps=-1)
+    with pytest.raises(ValueError, match="commit_every"):
+        el.run(lambda s, i, c: s, 0, store, steps=1, commit_every=0)

@@ -126,11 +126,15 @@ def _clean_resilience_state():
     [
         "delay:rank=1:op=allreduce:after=3:secs=2",
         "die:rank=0:op=barrier:after=1",
+        "hang:rank=3:op=allreduce:after=5",
+        "hang",
         "corrupt:nan:rank=2:op=allreduce",
         "corrupt:inf:op=bcast",
         "delay:secs=0.5",
         "die",
-        "delay:rank=1:op=allreduce:after=3:secs=2;die:rank=0:op=barrier:after=1;corrupt:nan:rank=2:op=allreduce",
+        "delay:rank=1:op=allreduce:after=3:secs=2;"
+        "die:rank=0:op=barrier:after=1;hang:rank=3:op=allreduce;"
+        "corrupt:nan:rank=2:op=allreduce",
     ],
 )
 def test_fault_spec_round_trips(spec):
@@ -166,6 +170,8 @@ def test_fault_spec_field_semantics():
         "delay:rank=one",              # non-integer rank
         "delay:secs=fast",             # non-float secs
         "die:secs=2",                  # secs on a non-delay verb
+        "hang:secs=2",                 # hang is forever; secs is delay-only
+        "hang:nan",                    # bare mode on a non-corrupt verb
         "delay:rank=1:rank=2",         # duplicate key
         "delay:after=-1",              # negative after
         "delay:secs=-0.5",             # negative secs
