@@ -1,12 +1,13 @@
 """Elastic data-parallel training: survive rank loss and keep going.
 
 The acceptance drill for the elastic-recovery layer
-(docs/resilience.md "Elastic recovery"): a DP-SGD loop wrapped in
-``mpx.elastic.run`` with a ``ShardStore`` in-memory checkpoint.  When a
-rank dies (or hangs) mid-run, the survivors agree on the failed set,
-revoke the communication epoch, shrink the mesh/comm to "all minus
-failed", restore the last committed state from the surviving shard
-replicas, and finish the step budget on ``k - f`` ranks.
+(docs/resilience.md "Elastic recovery" / "Grow and graceful drain"): a
+DP-SGD loop wrapped in ``mpx.elastic.run`` with a ``ShardStore``
+in-memory checkpoint.  When a rank dies (or hangs) mid-run, the
+survivors agree on the failed set, revoke the communication epoch,
+shrink the mesh/comm to "all minus failed", restore the last committed
+state from the surviving shard replicas, and finish the step budget on
+``k - f`` ranks.
 
 Two modes:
 
@@ -26,6 +27,26 @@ Two modes:
   The parent exits 0 iff a surviving majority completed the full step
   budget.  Swap ``die`` for ``hang`` to drill the watchdog-expiry
   detection path (the loop claims the expiry handler while it runs).
+
+Elastic extensions (this file is also their CI drill):
+
+- ``--grow``: after the fault injector kills a rank, the launcher
+  spawns a REPLACEMENT process (``mpx.elastic.join_and_run``) that
+  contacts the shrunken world's coordinator, is admitted at a commit
+  boundary, receives the committed state through the cold-join restore,
+  and helps finish the budget at the original world size — the 4→3→4
+  loop.  Requires ``MPI4JAX_TPU_ELASTIC_GROW=1`` in the environment.
+- ``--grid RxC``: run on a Cartesian (R, C) mesh.  Combined with a
+  ``preempt`` fault clause and ``MPI4JAX_TPU_ELASTIC_FAIL_UNIT=row``,
+  this is the graceful-preemption drill: the preempted rank's whole
+  grid row drains out at a step boundary (one forced commit, one
+  ``drain`` incident, zero watchdog expiries) and the remaining rows
+  finish the budget —
+
+      MPI4JAX_TPU_ELASTIC_FAIL_UNIT=row \\
+      MPI4JAX_TPU_FAULT_SPEC='preempt:rank=3:after=4' \\
+        python examples/elastic_training.py --launch 4 --grid 2x2 \\
+          --steps 12 --expect-world 2
 """
 
 import argparse
@@ -42,6 +63,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 DONE_TAG = "ELASTIC_DONE"
+DRAINED_TAG = "ELASTIC_DRAINED"
 
 
 def _parse_args(argv=None):
@@ -60,12 +82,24 @@ def _parse_args(argv=None):
     # multi-process drill plumbing
     p.add_argument("--launch", type=int, default=0, metavar="N",
                    help="launch an N-process world and run the drill")
+    p.add_argument("--grow", action="store_true",
+                   help="--launch parent: spawn a replacement worker "
+                        "(join_and_run) for each rank the fault injector "
+                        "kills — the shrink-then-grow drill (needs "
+                        "MPI4JAX_TPU_ELASTIC_GROW=1)")
+    p.add_argument("--grid", default="",
+                   help="Cartesian mesh shape 'RxC' (default: 1-D world)")
+    p.add_argument("--expect-world", type=int, default=0,
+                   help="--launch parent: expected FINAL world size "
+                        "(default: launch size minus fault subjects)")
     p.add_argument("--process-id", type=int, default=-1,
                    help=argparse.SUPPRESS)  # worker-internal
     p.add_argument("--num-processes", type=int, default=0,
                    help=argparse.SUPPRESS)
     p.add_argument("--port-base", type=int, default=0,
                    help=argparse.SUPPRESS)
+    p.add_argument("--join", action="store_true",
+                   help=argparse.SUPPRESS)  # replacement-worker-internal
     p.add_argument("--watchdog", type=float, default=30.0,
                    help="multi-process drill: watchdog timeout in seconds "
                         "(the hang-drill detection bound)")
@@ -213,6 +247,38 @@ def run_single(args):
 # ---------------------------------------------------------------------------
 
 
+def _parse_grid(spec):
+    if not spec:
+        return None
+    r, _, c = spec.lower().partition("x")
+    return int(r), int(c)
+
+
+def _make_mesh_comm(mpx, grid):
+    if grid is None:
+        mesh = mpx.make_world_mesh()
+    else:
+        mesh = mpx.make_world_mesh(grid, ("y", "x"))
+    comm = mpx.Comm(tuple(mesh.axis_names), mesh=mesh)
+    return mesh, comm
+
+
+def _finish_worker(args, store, losses, pid):
+    final_world = int(store.comm.Get_size())
+    if args.out:
+        with open(f"{args.out}.p{pid}", "w") as f:
+            json.dump({"losses": losses, "final_world": final_world,
+                       "drained": bool(store.drained)}, f, indent=2)
+    if store.drained:
+        # shrunk out by a planned drain (the preempted rank, or a
+        # row-mate on a Cartesian drain): a graceful exit, not a
+        # completion — the survivors own the rest of the budget
+        print(f"{DRAINED_TAG} world={final_world}", flush=True)
+    else:
+        print(f"{DONE_TAG} steps={args.steps} world={final_world}",
+              flush=True)
+
+
 def run_worker(args):
     import jax
 
@@ -228,8 +294,7 @@ def run_worker(args):
     if args.watchdog > 0:
         mpx.set_watchdog_timeout(args.watchdog)
 
-    mesh = mpx.make_world_mesh()
-    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    _, comm = _make_mesh_comm(mpx, _parse_grid(args.grid))
     store = mpx.ShardStore(comm, bootstrap={
         "host": "localhost",
         "port_base": args.port_base,
@@ -242,23 +307,44 @@ def run_worker(args):
     state = {"params": _init_params()}
     state = mpx.elastic.run(step_fn, state, store, steps=args.steps,
                             commit_every=args.commit_every)
+    _finish_worker(args, store, losses, args.process_id)
 
-    final_world = int(store.comm.Get_size())
-    if args.out:
-        with open(f"{args.out}.p{args.process_id}", "w") as f:
-            json.dump({"losses": losses, "final_world": final_world}, f,
-                      indent=2)
-    print(f"{DONE_TAG} steps={args.steps} world={final_world}", flush=True)
+
+def run_joiner(args):
+    """A replacement worker: contact the running (shrunken) world's
+    coordinator, get admitted at a commit boundary, receive the
+    committed state through the cold-join restore, and help finish the
+    budget (docs/resilience.md "Grow and graceful drain")."""
+    import mpi4jax_tpu as mpx
+
+    if args.watchdog > 0:
+        mpx.set_watchdog_timeout(args.watchdog)
+
+    store = mpx.ShardStore(None, bootstrap={
+        "host": "localhost",
+        "port_base": args.port_base,
+        "agree_port_base": args.port_base + 100,
+    })
+    step_fn, losses = _make_elastic_step(mpx)
+    mpx.elastic.join_and_run(step_fn, store, steps=args.steps,
+                             commit_every=args.commit_every,
+                             join_timeout=args.drill_timeout)
+    _finish_worker(args, store, losses,
+                   f"j{store.bootstrap['process_id']}")
 
 
 def run_launcher(args):
     """Spawn the N-process world, reap survivors, judge the drill.
 
-    Success = a strict MAJORITY of workers exit 0 AND each of them
-    printed the completion tag with the full step budget.  Workers killed
-    by the fault injector (``die`` exits 13) or hung forever (``hang``,
-    killed here once the survivors finish) are the drill's subjects, not
-    failures of it.
+    Success = the expected number of workers (``--expect-world``, or a
+    strict MAJORITY by default) exit 0 with the completion tag and the
+    full step budget, and every OTHER exit-0 worker was gracefully
+    drained (the ``preempt`` drill's leavers print the drained tag).
+    Workers killed by the fault injector (``die`` exits 13) or hung
+    forever (``hang``, killed here once the survivors finish) are the
+    drill's subjects, not failures of it.  With ``--grow``, each killed
+    worker is replaced by a joiner (``join_and_run``) that must ALSO
+    complete — the shrink-then-grow loop.
     """
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -267,34 +353,58 @@ def run_launcher(args):
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["JAX_PLATFORMS"] = "cpu"
+    if args.grow:
+        env["MPI4JAX_TPU_ELASTIC_GROW"] = "1"
     n = args.launch
-    workers = []
-    for i in range(n):
-        cmd = [sys.executable, os.path.abspath(__file__),
-               "--steps", str(args.steps),
+
+    def common_flags():
+        cmd = ["--steps", str(args.steps),
                "--commit-every", str(args.commit_every),
-               "--process-id", str(i), "--num-processes", str(n),
                "--port-base", str(port_base),
-               "--watchdog", str(args.watchdog)]
+               "--watchdog", str(args.watchdog),
+               "--drill-timeout", str(args.drill_timeout)]
+        if args.grid:
+            cmd += ["--grid", args.grid]
         if args.out:
             cmd += ["--out", args.out]
-        workers.append(subprocess.Popen(
-            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
+        return cmd
+
+    def spawn(extra, name):
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)] + common_flags()
+            + extra,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        proc._drill_name = name
+        return proc
+
+    workers = [
+        spawn(["--process-id", str(i), "--num-processes", str(n)], f"r{i}")
+        for i in range(n)
+    ]
+    # a joiner cannot start with its replacement target still alive (the
+    # fault has not fired yet): spawned on first observed subject death
+    spawned = 0
+    target = args.expect_world if args.expect_world > 0 else n // 2 + 1
 
     deadline = time.monotonic() + args.drill_timeout
-    outputs = {}
     while time.monotonic() < deadline:
+        subjects = [p for p in workers
+                    if p.poll() is not None and p.returncode != 0]
+        if args.grow and len(subjects) > spawned:
+            for _ in range(len(subjects) - spawned):
+                workers.append(spawn(["--join"], f"j{spawned}"))
+                spawned += 1
         live = [p for p in workers if p.poll() is None]
         done_ok = [p for p in workers
                    if p.poll() is not None and p.returncode == 0]
         if not live:
             break
-        if len(done_ok) > n // 2:
-            # the surviving majority finished; whoever is still running is
-            # the drill's hung subject — give stragglers a grace period,
-            # then put them down
-            grace = time.monotonic() + 10.0
+        if len(done_ok) >= target:
+            # the expected completions are in; whoever is still running
+            # is the drill's hung subject — give stragglers a grace
+            # period, then put them down
+            grace = time.monotonic() + 20.0
             while any(p.poll() is None for p in workers) \
                     and time.monotonic() < grace:
                 time.sleep(0.2)
@@ -303,21 +413,31 @@ def run_launcher(args):
                     p.kill()
             break
         time.sleep(0.5)
-    for i, p in enumerate(workers):
+    outputs = {}
+    for p in workers:
+        name = p._drill_name
         try:
             out, _ = p.communicate(timeout=30)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
-        outputs[i] = out or ""
-        sys.stdout.write(f"--- worker {i} (exit {p.returncode}) ---\n")
-        sys.stdout.write(outputs[i])
-    winners = [i for i, p in enumerate(workers) if p.returncode == 0]
-    completed = [i for i in winners
-                 if f"{DONE_TAG} steps={args.steps}" in outputs[i]]
-    print(f"drill: {len(completed)}/{n} workers completed the "
-          f"{args.steps}-step budget: ranks {completed}", flush=True)
-    if len(completed) > n // 2 and completed == winners:
+        outputs[name] = (p.returncode, out or "")
+        sys.stdout.write(f"--- worker {name} (exit {p.returncode}) ---\n")
+        sys.stdout.write(outputs[name][1])
+    winners = [nm for nm, (rc, _) in outputs.items() if rc == 0]
+    completed = [nm for nm in winners
+                 if f"{DONE_TAG} steps={args.steps}" in outputs[nm][1]]
+    drained = [nm for nm in winners if DRAINED_TAG in outputs[nm][1]]
+    print(f"drill: {len(completed)} worker(s) completed the "
+          f"{args.steps}-step budget ({completed}), {len(drained)} "
+          f"drained gracefully ({drained})", flush=True)
+    ok = len(completed) >= target
+    # every exit-0 worker must be accounted for: a completion or a
+    # graceful drain — an exit-0 worker with neither tag went wrong
+    ok = ok and sorted(winners) == sorted(set(completed) | set(drained))
+    if args.expect_world > 0:
+        ok = ok and len(completed) == args.expect_world
+    if ok:
         print("DRILL_OK", flush=True)
         return 0
     print("DRILL_FAILED", flush=True)
@@ -328,6 +448,9 @@ def main(argv=None):
     args = _parse_args(argv)
     if args.launch > 0:
         return run_launcher(args)
+    if args.join:
+        run_joiner(args)
+        return 0
     if args.process_id >= 0:
         run_worker(args)
         return 0

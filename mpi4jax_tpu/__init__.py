@@ -79,6 +79,8 @@ from .resilience import (  # noqa: F401
     RankFailure,
     ShardStore,
     elastic,
+    install_preemption_handler,
+    request_drain,
     set_check_numerics,
     set_fault_spec,
     set_watchdog_timeout,
@@ -177,6 +179,8 @@ __all__ = [
     "elastic",
     "RankFailure",
     "ShardStore",
+    "request_drain",
+    "install_preemption_handler",
     # trace-time collective verifier (docs/analysis.md)
     "analyze",
     "Report",

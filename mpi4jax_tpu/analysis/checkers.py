@@ -394,6 +394,39 @@ def check_epoch_boundary(graph: CollectiveGraph) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# drained-comm collectives (MPX127)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX127")
+def check_drained_comm(graph: CollectiveGraph) -> List[Finding]:
+    """A collective issued on a comm whose world executed a planned
+    drain past its leave boundary (``resilience/elastic.py`` graceful
+    drain): the drained ranks left ON PURPOSE — the comm's group tables
+    still include them, so the collective would wait on peers that
+    committed, said goodbye, and exited.  A comm merely *scheduled* to
+    drain (boundary not reached) is clean: collectives remain legal
+    through the boundary — that is what makes the drain graceful."""
+    findings: List[Finding] = []
+    for e in graph.events:
+        if not e.drained:
+            continue
+        findings.append(Finding(
+            code="MPX127", op=e.op, index=e.index,
+            message=(f"{e.op} on comm {e.comm_uid} was issued after the "
+                     "comm's leave boundary: its world executed a "
+                     "planned drain and the departed ranks will never "
+                     "enter this collective"),
+            suggestion=("use the comm mpx.elastic.run hands the step "
+                        "function after the drain boundary (it is "
+                        "rebuilt without the drained ranks), or rebuild "
+                        "by hand with comm.shrink(drained, mesh=...) — "
+                        "docs/resilience.md 'Grow and graceful drain'"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # topology advisory (MPX113)
 # ---------------------------------------------------------------------------
 

@@ -60,6 +60,12 @@ class CollectiveEvent:
     # resilience/elastic.py revocation) — compared against the CURRENT
     # epoch in graph.meta by the MPX126 checker
     epoch: Optional[int] = None
+    # True when the comm's world executed a planned drain and this
+    # collective was issued AFTER the leave boundary (resilience/
+    # elastic.py drained-comm registry) — flagged MPX127.  A comm merely
+    # *scheduled* to drain (boundary not yet reached) records False:
+    # collectives remain legal through the boundary.
+    drained: bool = False
     # static member groups (global ranks, group order) of this op's comm
     # when derivable — comm.groups on a split, or the rank-concretization
     # scope's sub-axes partition during a per-rank schedule trace.  The

@@ -171,6 +171,7 @@ def begin_event(opname: str, comm, arrays, token, ana: Optional[dict],
         shape=tuple(a0.shape) if a0 is not None else (),
         eager=eager,
         epoch=getattr(comm, "epoch", None),
+        drained=bool(getattr(comm, "drained", False)),
         groups=static_groups_for(comm),
     )
     if ana:

@@ -180,6 +180,18 @@ CODES = {
             "same (hosts, ranks-per-host) decomposition.",
         ),
         CodeInfo(
+            "MPX127", "collective on a drained communicator", ERROR,
+            "A collective was issued on a communicator whose world "
+            "executed a planned drain past its leave boundary "
+            "(resilience/elastic.py graceful drain): the departed ranks "
+            "committed their state and exited on purpose, but this "
+            "comm's group tables still include them, so the collective "
+            "would block on peers that will never arrive.  Collectives "
+            "are legal on a draining comm THROUGH the boundary; after "
+            "it, use the rebuilt comm mpx.elastic.run provides (or "
+            "comm.shrink the drained ranks out by hand).",
+        ),
+        CodeInfo(
             "MPX126", "collective on a revoked communication epoch", ERROR,
             "A collective was issued on a communicator stamped with an "
             "epoch older than the current one: the world shrank "

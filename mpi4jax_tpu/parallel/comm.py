@@ -115,6 +115,17 @@ class Comm:
         shrank."""
         return self._epoch
 
+    @property
+    def drained(self) -> bool:
+        """True once this comm's world executed a planned drain past its
+        leave boundary (resilience/elastic.py graceful drain): its rank
+        space includes ranks that left on purpose, so issuing a
+        collective on it is flagged MPX127 by the verifier.  A comm
+        merely *scheduled* to drain stays False through the boundary."""
+        from ..resilience.elastic import comm_drained
+
+        return comm_drained(self._uid)
+
     def bind(self, mesh: jax.sharding.Mesh) -> "Comm":
         """Return a copy of this comm bound to ``mesh`` (same namespace)."""
         new = Comm(self._axes, mesh=mesh)

@@ -131,37 +131,79 @@ def make_world_mesh(
     )
 
 
-def shrink_world_mesh(mesh: jax.sharding.Mesh, failed) -> jax.sharding.Mesh:
+def shrink_world_mesh(
+    mesh: jax.sharding.Mesh, failed, fail_unit: str = "rank"
+) -> jax.sharding.Mesh:
     """Rebuild ``mesh`` without the devices of the ``failed`` global ranks
     (row-major rank order, the same rank space ``Comm.Get_rank`` defines)
     — the mesh half of an elastic shrink (resilience/elastic.py).
 
-    Only 1-D meshes shrink structurally: removing arbitrary ranks from a
-    Cartesian grid leaves a ragged grid no mesh can express.  Reshape to
-    1-D before an elastic run, or fail whole grid rows and rebuild the
-    grid by hand.
+    ``fail_unit`` picks the shrink granularity
+    (``MPI4JAX_TPU_ELASTIC_FAIL_UNIT``):
+
+    - ``"rank"`` (default): remove exactly the failed ranks.  1-D meshes
+      only — removing arbitrary ranks from a Cartesian grid leaves a
+      ragged grid no mesh can express.
+    - ``"row"`` / ``"col"``: remove every WHOLE grid row (first axis) or
+      column (second axis) containing a failed rank, so 2-D
+      (tensor x data) meshes shrink structurally.  On a 1-D mesh a row
+      IS a rank, so both degrade to ``"rank"``.
+
+    The caller passes the *expanded* failed set (``elastic
+    .expand_fail_unit`` — the same set ``compact_rank_map`` renumbers
+    with); this function validates the expansion covers whole rows or
+    columns.
     """
-    failed = frozenset(int(r) for r in failed)
+    from ..resilience.elastic import expand_fail_unit
+
     shape = tuple(mesh.shape.values())
-    if len(shape) != 1:
-        raise ValueError(
-            f"shrink_world_mesh: only 1-D meshes can shrink (got shape "
-            f"{dict(mesh.shape)}); arbitrary rank removal leaves a ragged "
-            "grid — run elastic jobs on a 1-D mesh (docs/resilience.md)"
-        )
+    failed = expand_fail_unit(failed, shape, fail_unit)
     devices = list(mesh.devices.flat)
     world = len(devices)
-    bad = [r for r in failed if not 0 <= r < world]
-    if bad:
+    if len(shape) > 1 and fail_unit == "rank":
         raise ValueError(
-            f"shrink_world_mesh: failed ranks {sorted(bad)} out of range "
-            f"for world {world}"
+            f"shrink_world_mesh: only 1-D meshes can shrink by rank (got "
+            f"shape {dict(mesh.shape)}); arbitrary rank removal leaves a "
+            "ragged grid — shrink whole grid rows/columns instead "
+            "(fail_unit='row'|'col', MPI4JAX_TPU_ELASTIC_FAIL_UNIT; "
+            "docs/resilience.md)"
         )
     survivors = [d for r, d in enumerate(devices) if r not in failed]
     if not survivors:
         raise ValueError("shrink_world_mesh: no surviving devices")
+    from ..resilience.elastic import shrunken_shape
+
+    new_shape = shrunken_shape(shape, failed, fail_unit)
+    assert int(np.prod(new_shape)) == len(survivors), (new_shape, world)
+    return make_world_mesh(new_shape, tuple(mesh.axis_names),
+                           devices=survivors)
+
+
+def grow_world_mesh(mesh: jax.sharding.Mesh, added: int) -> jax.sharding.Mesh:
+    """Rebuild ``mesh`` with ``added`` more devices appended — the
+    single-controller mesh half of an elastic *grow* (a simulated join:
+    the devices still exist on the controller, only the mesh shrank).
+    1-D meshes only; replacement devices are taken from ``jax.devices()``
+    in order, skipping those already in the mesh."""
+    shape = tuple(mesh.shape.values())
+    if len(shape) != 1:
+        raise ValueError(
+            f"grow_world_mesh: only 1-D meshes can grow (got shape "
+            f"{dict(mesh.shape)}) — docs/resilience.md"
+        )
+    if added < 1:
+        raise ValueError(f"grow_world_mesh: added must be >= 1, got {added}")
+    current = list(mesh.devices.flat)
+    have = {d.id for d in current}
+    spare = [d for d in jax.devices() if d.id not in have]
+    if len(spare) < added:
+        raise ValueError(
+            f"grow_world_mesh: {added} replacement device(s) requested "
+            f"but only {len(spare)} available outside the mesh"
+        )
+    devices = current + spare[:added]
     (axis,) = mesh.axis_names
-    return make_world_mesh((len(survivors),), (axis,), devices=survivors)
+    return make_world_mesh((len(devices),), (axis,), devices=devices)
 
 
 def get_default_mesh() -> jax.sharding.Mesh:

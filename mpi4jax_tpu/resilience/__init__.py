@@ -30,7 +30,12 @@ docs/resilience.md.
 """
 
 from . import elastic  # noqa: F401
-from .elastic import RankFailure, ShardStore  # noqa: F401
+from .elastic import (  # noqa: F401
+    RankFailure,
+    ShardStore,
+    install_preemption_handler,
+    request_drain,
+)
 from .faultinject import (  # noqa: F401
     FaultClause,
     canonical_spec,
@@ -72,4 +77,6 @@ __all__ = [
     "elastic",
     "RankFailure",
     "ShardStore",
+    "request_drain",
+    "install_preemption_handler",
 ]

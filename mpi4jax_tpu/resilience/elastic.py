@@ -60,11 +60,15 @@ __all__ = [
     "RankFailure",
     "ShardStore",
     "run",
+    "join_and_run",
     "current_epoch",
     "advance_epoch",
+    "epoch_history",
     "elastic_cache_token",
     "compact_rank_map",
     "shrink_groups",
+    "expand_fail_unit",
+    "shrunken_shape",
     "replica_ranks",
     "shards_held_by",
     "recoverable",
@@ -77,6 +81,16 @@ __all__ = [
     "exchange_suspects",
     "classify_failure",
     "take_pending_failure",
+    "request_drain",
+    "take_pending_drain",
+    "install_preemption_handler",
+    "post_simulated_join",
+    "request_join",
+    "coordinator_port",
+    "join_port",
+    "control_port",
+    "mark_comm_draining",
+    "comm_drained",
     "pack_leaves",
     "unpack_leaves",
 ]
@@ -117,6 +131,10 @@ class RankFailure(RuntimeError):
 
 _epoch_lock = threading.Lock()
 _epoch = 0
+# one record per epoch advance: {"epoch", "world", "cause", "detail"} —
+# the audit trail telemetry.report() renders for churn runs ("epoch,
+# world size, cause"), kept host-side so it survives every re-trace
+_epoch_history: List[dict] = []
 
 
 def current_epoch() -> int:
@@ -124,31 +142,85 @@ def current_epoch() -> int:
     return _epoch
 
 
-def advance_epoch() -> int:
+def advance_epoch(*, world: Optional[int] = None, cause: str = "revoke",
+                  detail: str = "") -> int:
     """Revoke the current epoch: bump the counter and invalidate every
     stamp-memoized configuration consumer (the program caches fold the
     epoch in via ``resilience.cache_token``, so every old-world
-    executable re-traces).  Returns the new epoch."""
+    executable re-traces).  ``world``/``cause``/``detail`` describe the
+    boundary for :func:`epoch_history` — an epoch now carries a world
+    *delta*, not just removals: ``cause`` is ``"failure"``, ``"drain"``,
+    or ``"join"`` for elastic boundaries (``"revoke"`` for bare
+    revocations).  Returns the new epoch."""
     global _epoch
     with _epoch_lock:
         _epoch += 1
         new = _epoch
+        _epoch_history.append({
+            "epoch": new,
+            "world": world,
+            "cause": cause,
+            "detail": detail,
+        })
     config.bump_config_epoch()
     return new
+
+
+def epoch_history() -> List[dict]:
+    """One record per epoch advance (epoch, post-boundary world size,
+    cause, detail) — the audit trail of a churning run, rendered by
+    ``telemetry.report()`` and embedded in telemetry snapshots."""
+    with _epoch_lock:
+        return [dict(r) for r in _epoch_history]
+
+
+def _set_epoch(n: int) -> None:
+    """Adopt an externally-agreed epoch (a joiner admitted into epoch
+    ``n`` must trace under the same cache keys as the world it joins).
+    Never moves backwards."""
+    global _epoch
+    with _epoch_lock:
+        if n < _epoch:
+            raise ValueError(
+                f"cannot move the epoch backwards ({_epoch} -> {n})")
+        if n > _epoch:
+            _epoch = n
+            _epoch_history.append({
+                "epoch": n, "world": None, "cause": "adopt", "detail": "",
+            })
+    config.bump_config_epoch()
 
 
 def _reset_epoch_for_tests() -> None:
     global _epoch
     with _epoch_lock:
         _epoch = 0
+        del _epoch_history[:]
+    with _drain_lock:
+        _pending_drain.clear()
+        _peer_drain.clear()
+        _draining_comms.clear()
+        _drained_comms.clear()
+    with _join_lock:
+        del _pending_joins[:]
     config.bump_config_epoch()
 
 
-def elastic_cache_token() -> int:
-    """The epoch, as folded into every compiled-program cache key.  With
-    elastic never engaged this is the constant 0 and the keys (and HLO)
-    are identical to a build without the elastic layer."""
-    return _epoch
+def elastic_cache_token():
+    """The elastic contribution to every compiled-program cache key: the
+    epoch plus the declared elastic knobs (grow, fail unit, drain grace,
+    port span).  With elastic never engaged and every knob at its
+    default this is the constant 0 — byte-identical keys (and HLO) to a
+    build without the elastic layer, the PR 1-8 contract."""
+    grow = config.elastic_grow()
+    unit = config.elastic_fail_unit()
+    grace = config.drain_grace_s()
+    span = config.elastic_port_span()
+    if (not grow and unit == "rank"
+            and grace == config.DEFAULT_DRAIN_GRACE_S
+            and span == config.DEFAULT_ELASTIC_PORT_SPAN):
+        return _epoch
+    return (_epoch, grow, unit, grace, span)
 
 
 # ---------------------------------------------------------------------------
@@ -248,7 +320,12 @@ def shrink_groups(groups, failed: Iterable[int], world: int):
     """Rebuild a color-split comm's group tables as "all minus failed":
     drop the failed ranks, renumber survivors via :func:`compact_rank_map`
     (preserving each group's order), drop groups that lost every member.
-    Returns the new group tuple in the new (compacted) rank space."""
+    Returns the new group tuple in the new (compacted) rank space.
+
+    Generalizes unchanged to the 2-D renumbering: a Cartesian row/column
+    shrink passes the *expanded* failed set (:func:`expand_fail_unit`),
+    and because whole rows/columns are removed the row-major compaction
+    IS the new grid's row-major numbering."""
     rmap = compact_rank_map(world, failed)
     out = []
     for members in groups:
@@ -256,6 +333,127 @@ def shrink_groups(groups, failed: Iterable[int], world: int):
         if kept:
             out.append(kept)
     return tuple(out)
+
+
+def expand_fail_unit(failed: Iterable[int], shape, fail_unit: str):
+    """Expand a failed-rank set to the declared shrink granularity.
+
+    ``shape`` is the mesh's dimension tuple (row-major rank order);
+    ``fail_unit`` is ``"rank"`` / ``"row"`` / ``"col"``
+    (``MPI4JAX_TPU_ELASTIC_FAIL_UNIT``).  ``"row"`` returns every rank
+    sharing a first-axis index with a failed rank, ``"col"`` every rank
+    sharing a second-axis index — the whole-grid-line removal that keeps
+    a Cartesian mesh rectangular.  On a 1-D mesh a row is a rank, so
+    every unit degrades to ``"rank"``.  Pure (no jax): the renumbering
+    tests drive it directly."""
+    shape = tuple(int(n) for n in shape)
+    world = 1
+    for n in shape:
+        world *= n
+    failed = frozenset(int(r) for r in failed)
+    bad = [r for r in failed if not 0 <= r < world]
+    if bad:
+        raise ValueError(
+            f"failed ranks {sorted(bad)} out of range for world {world}")
+    if fail_unit not in ("rank", "row", "col"):
+        raise ValueError(
+            f"fail_unit must be 'rank', 'row', or 'col', got {fail_unit!r}")
+    if fail_unit == "rank" or len(shape) == 1 or not failed:
+        return failed
+    if len(shape) != 2:
+        raise ValueError(
+            f"fail_unit={fail_unit!r} supports 1-D and 2-D meshes, got "
+            f"shape {shape}"
+        )
+    rows, cols = shape
+    if fail_unit == "row":
+        dead_rows = {r // cols for r in failed}
+        return frozenset(
+            i * cols + j for i in dead_rows for j in range(cols))
+    dead_cols = {r % cols for r in failed}
+    return frozenset(
+        i * cols + j for i in range(rows) for j in dead_cols)
+
+
+def shrunken_shape(shape, expanded_failed: Iterable[int], fail_unit: str):
+    """The mesh shape after removing ``expanded_failed`` (an
+    :func:`expand_fail_unit` result) at ``fail_unit`` granularity —
+    whole rows/columns drop off the matching dimension; rank-unit
+    removal flattens a 1-D shape."""
+    shape = tuple(int(n) for n in shape)
+    dead = frozenset(int(r) for r in expanded_failed)
+    if len(shape) == 1 or fail_unit == "rank":
+        world = 1
+        for n in shape:
+            world *= n
+        return (world - len(dead),)
+    rows, cols = shape
+    if fail_unit == "row":
+        dead_rows = {r // cols for r in dead}
+        return (rows - len(dead_rows), cols)
+    dead_cols = {r % cols for r in dead}
+    return (rows, cols - len(dead_cols))
+
+
+# ---------------------------------------------------------------------------
+# per-epoch rendezvous ports (pure math)
+# ---------------------------------------------------------------------------
+#
+# Every elastic rendezvous derives its port from the epoch so revoked-world
+# sockets can never collide with the recovered world's — but the naive
+# ``port_base + epoch`` walks out of the ephemeral range after enough
+# churn.  All port derivation therefore wraps within a declared window of
+# ``span`` ports (``MPI4JAX_TPU_ELASTIC_PORT_SPAN``):
+#
+#   [port_base,          port_base +   span)   jax.distributed coordinator
+#   [port_base +   span, port_base + 2*span)   join listener (rank 0)
+#   [port_base + 2*span, port_base + 4*span)   per-rank control listeners
+#                                              (two alternating epoch banks,
+#                                              so consecutive epochs never
+#                                              contend for a port)
+#
+# A wrap collision (epoch e vs e+span) lands on a socket the revoked world
+# closed span epochs ago; the residual TIME_WAIT case is absorbed by the
+# bootstrap retry policy that already wraps every bind/connect.
+
+
+def wrapped_epoch(epoch: int, span: Optional[int] = None) -> int:
+    """``epoch % span`` with the span from the declared flag."""
+    span = config.elastic_port_span() if span is None else int(span)
+    if span < 1:
+        raise ValueError(f"port span must be >= 1, got {span}")
+    return int(epoch) % span
+
+
+def coordinator_port(port_base: int, epoch: int,
+                     span: Optional[int] = None) -> int:
+    """The jax.distributed coordinator port for ``epoch`` — what a
+    replacement process contacts (``port_base + epoch``, wrapped within
+    the declared window)."""
+    return int(port_base) + wrapped_epoch(epoch, span)
+
+
+def join_port(port_base: int, epoch: int, span: Optional[int] = None) -> int:
+    """The coordinator's join-listener port for ``epoch`` (its own
+    span-wide bank above the coordinator window, so a joiner can scan
+    the whole window without ever poking a jax.distributed socket)."""
+    span = config.elastic_port_span() if span is None else int(span)
+    return int(port_base) + span + wrapped_epoch(epoch, span)
+
+
+def control_port(port_base: int, rank: int, epoch: int,
+                 span: Optional[int] = None) -> int:
+    """Rank ``rank``'s control-listener port in ``epoch`` (drain notices
+    and acks).  Two alternating epoch banks: epoch e and e+1 use
+    disjoint ports, so a process rebinding after a shrink can never race
+    the previous world's listener for the same port."""
+    span = config.elastic_port_span() if span is None else int(span)
+    if not 0 <= int(rank) < span:
+        raise ValueError(
+            f"control_port: rank {rank} outside the span window {span} "
+            "(raise MPI4JAX_TPU_ELASTIC_PORT_SPAN above the world size)")
+    bank = int(epoch) % 2
+    return int(port_base) + 2 * span + bank * span + int(rank)
 
 
 # ---------------------------------------------------------------------------
@@ -471,6 +669,360 @@ def take_pending_failure() -> Optional[RankFailure]:
     return rf
 
 
+# ---------------------------------------------------------------------------
+# graceful drain: announced departures instead of detected deaths
+# ---------------------------------------------------------------------------
+#
+# A preemption notice (SIGTERM, the scheduler's eviction warning, or the
+# ``preempt`` fault verb) marks this rank as *leaving*.  The elastic loop
+# picks the mark up at its next step boundary, forces an early
+# ``store.commit``, notifies every peer (with acks, so nobody can race
+# past the leave boundary), and executes a PLANNED shrink: no watchdog
+# expiry, no gossip agreement round, exactly one ``drain`` incident —
+# an announced eviction costs one commit interval instead of a detection
+# timeout.
+
+_drain_lock = threading.Lock()
+_pending_drain: dict = {}       # this process wants to leave (or a
+#                                 simulated rank does): {"rank", "grace"}
+_peer_drain: dict = {}          # a peer announced its departure:
+#                                 {"rank", "boundary"}
+_draining_comms: Dict[int, int] = {}   # comm uid -> scheduled leave boundary
+_drained_comms: Dict[int, int] = {}    # comm uid -> passed leave boundary
+
+
+def request_drain(grace: Optional[float] = None, *,
+                  rank: Optional[int] = None) -> None:
+    """Mark a rank as *leaving* (idempotent).  ``rank=None`` means the
+    calling process (the SIGTERM / ``preempt`` path); a concrete rank is
+    the single-controller simulation form.  The elastic loop executes
+    the drain at its next step boundary; ``grace`` bounds the peer-ack
+    wait (default ``MPI4JAX_TPU_DRAIN_GRACE_S``)."""
+    with _drain_lock:
+        if not _pending_drain:
+            _pending_drain.update({
+                "rank": None if rank is None else int(rank),
+                "grace": grace,
+            })
+
+
+def take_pending_drain() -> Optional[dict]:
+    """Pop the pending drain request, if any."""
+    with _drain_lock:
+        if not _pending_drain:
+            return None
+        out = dict(_pending_drain)
+        _pending_drain.clear()
+    return out
+
+
+def _post_peer_drain(rank: int, boundary: int) -> None:
+    with _drain_lock:
+        if not _peer_drain:
+            _peer_drain.update({"rank": int(rank),
+                                "boundary": int(boundary)})
+
+
+def peek_peer_drain() -> Optional[dict]:
+    with _drain_lock:
+        return dict(_peer_drain) if _peer_drain else None
+
+
+def take_peer_drain() -> Optional[dict]:
+    with _drain_lock:
+        if not _peer_drain:
+            return None
+        out = dict(_peer_drain)
+        _peer_drain.clear()
+    return out
+
+
+def install_preemption_handler(grace: Optional[float] = None, *,
+                               signum=None):
+    """Install a SIGTERM handler that posts a drain request (the
+    graceful-preemption entry: schedulers announce evictions with
+    SIGTERM minutes before the kill).  Returns the previous handler (pass
+    it to ``signal.signal`` to restore), or ``None`` when handlers
+    cannot be installed here (non-main thread / unsupported platform) —
+    the elastic loop degrades to the failure path in that case."""
+    import signal as _signal
+
+    signum = _signal.SIGTERM if signum is None else signum
+
+    def _on_term(_signo, _frame):
+        _meter("elastic.preempt_notices")
+        request_drain(grace)
+
+    try:
+        return _signal.signal(signum, _on_term)
+    except (ValueError, OSError):   # not the main thread, or no signals
+        return None
+
+
+def mark_comm_draining(comm_or_uid, boundary: int) -> None:
+    """Record that ``comm``'s world has a scheduled leave boundary.
+    Collectives remain legal through the boundary; once
+    :func:`seal_drained_comm` runs the comm is *drained* and any further
+    collective on it is flagged MPX127 by the verifier."""
+    uid = getattr(comm_or_uid, "uid", comm_or_uid)
+    with _drain_lock:
+        _draining_comms[int(uid)] = int(boundary)
+
+
+def seal_drained_comm(comm_or_uid) -> None:
+    """The leave boundary passed: collectives on this comm are now
+    errors (MPX127) — its world executed its planned shrink."""
+    uid = int(getattr(comm_or_uid, "uid", comm_or_uid))
+    with _drain_lock:
+        boundary = _draining_comms.pop(uid, 0)
+        _drained_comms[uid] = boundary
+
+
+def comm_draining(comm_or_uid) -> Optional[int]:
+    """The scheduled leave boundary of a draining comm, or ``None``."""
+    uid = int(getattr(comm_or_uid, "uid", comm_or_uid))
+    with _drain_lock:
+        return _draining_comms.get(uid)
+
+
+def comm_drained(comm_or_uid) -> bool:
+    """True once the comm's leave boundary passed (MPX127 territory)."""
+    uid = int(getattr(comm_or_uid, "uid", comm_or_uid))
+    with _drain_lock:
+        return uid in _drained_comms
+
+
+# ---------------------------------------------------------------------------
+# grow: admit replacement ranks at an epoch boundary
+# ---------------------------------------------------------------------------
+#
+# A replacement process contacts the CURRENT coordinator (the join
+# listener derived from ``port_base`` + the wrapped epoch — it scans the
+# declared window, since it cannot know how many epochs passed while it
+# was being scheduled), parks on the open connection, and is admitted at
+# the survivors' next commit boundary: the world advances one epoch with
+# a positive delta, every process re-bootstraps at world+J, and the
+# committed state is streamed to the joiner through the one-allreduce
+# restore path's cold-join branch (the joiner contributes zeros and
+# receives everything).
+
+_join_lock = threading.Lock()
+_pending_joins: List[dict] = []   # [{"conn": socket|None, "info": dict}]
+
+
+def post_simulated_join(count: int = 1) -> None:
+    """Queue ``count`` simulated joiners (single-controller drills: the
+    replacement "process" is a device the mesh shrank away, re-admitted
+    by ``ShardStore.apply_grow``)."""
+    with _join_lock:
+        for _ in range(int(count)):
+            _pending_joins.append({"conn": None, "info": {"simulated": True}})
+
+
+def pending_join_count() -> int:
+    with _join_lock:
+        return len(_pending_joins)
+
+
+def _take_pending_joins() -> List[dict]:
+    with _join_lock:
+        out, _pending_joins[:] = list(_pending_joins), []
+    return out
+
+
+class _JoinServer:
+    """The coordinator's join listener (rank 0 only, one epoch at a
+    time): accepts ``{"kind": "join"}`` hellos, parks each connection in
+    the pending-join queue, and answers a scanning joiner's probe so it
+    can find the live epoch without guessing."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, int(port)
+        self._stop = threading.Event()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, self.port))
+        self._srv.listen(8)
+        self._srv.settimeout(0.2)
+        self._thread = threading.Thread(
+            target=self._serve, name="mpi4jax_tpu-join", daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                try:
+                    conn.settimeout(10.0)
+                    header = _recv_all(conn, 8)
+                    if len(header) < 8:
+                        conn.close()
+                        continue
+                    n = int.from_bytes(header, "big")
+                    payload = json.loads(_recv_all(conn, n).decode())
+                    if payload.get("kind") != "join":
+                        conn.close()
+                        continue
+                    # park the connection: the admit message goes out at
+                    # the next commit boundary (run loop, rank 0)
+                    conn.settimeout(None)
+                    with _join_lock:
+                        _pending_joins.append(
+                            {"conn": conn, "info": payload})
+                except (OSError, ValueError, KeyError):
+                    conn.close()
+        finally:
+            self._srv.close()
+
+    def stop(self):
+        self._stop.set()
+
+
+def _send_json(conn, payload: dict) -> None:
+    msg = json.dumps(payload).encode()
+    conn.sendall(len(msg).to_bytes(8, "big") + msg)
+
+
+def _recv_json(conn) -> dict:
+    header = _recv_all(conn, 8)
+    if len(header) < 8:
+        raise OSError("connection closed before header")
+    n = int.from_bytes(header, "big")
+    return json.loads(_recv_all(conn, n).decode())
+
+
+def request_join(host: str, port_base: int, *, timeout: float = 300.0,
+                 scan_interval: float = 0.5) -> dict:
+    """The replacement process's half of the join protocol: scan the
+    declared port window for the live epoch's join listener, send a join
+    hello, and block until the coordinator admits us at a commit
+    boundary.  Returns the admit message ({"epoch", "process_id",
+    "num_processes", "step", "commit", "mesh_shape", "axes"}).  Raises
+    ``RuntimeError`` when no coordinator answers within ``timeout``."""
+    span = config.elastic_port_span()
+    deadline = time.monotonic() + timeout
+    hello = {"kind": "join", "host": socket.gethostname()}
+    while time.monotonic() < deadline:
+        for e in range(span):
+            port = join_port(port_base, e, span)
+            try:
+                conn = socket.create_connection((host, port), timeout=0.3)
+            except OSError:
+                continue
+            try:
+                conn.settimeout(max(1.0, deadline - time.monotonic()))
+                _send_json(conn, hello)
+                admit = _recv_json(conn)     # parks until the boundary
+                if admit.get("kind") == "admit":
+                    return admit
+            except OSError:
+                pass
+            finally:
+                conn.close()
+        time.sleep(scan_interval)
+    raise RuntimeError(
+        f"request_join: no coordinator admitted us within {timeout:g}s "
+        f"(scanned ports {join_port(port_base, 0, span)}.."
+        f"{join_port(port_base, span - 1, span)}; is the running world's "
+        "MPI4JAX_TPU_ELASTIC_GROW on?)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# control plane: drain notices between peers
+# ---------------------------------------------------------------------------
+
+
+class _ControlServer:
+    """Per-rank control listener (one epoch at a time): receives drain
+    notices from a departing peer, posts them for the run loop, and acks
+    immediately — the ack is what lets the leaver prove every peer knows
+    the leave boundary BEFORE anyone steps toward it."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, int(port)
+        self._stop = threading.Event()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, self.port))
+        self._srv.listen(8)
+        self._srv.settimeout(0.2)
+        self._thread = threading.Thread(
+            target=self._serve, name="mpi4jax_tpu-control", daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                with conn:
+                    try:
+                        conn.settimeout(5.0)
+                        payload = _recv_json(conn)
+                        if payload.get("kind") == "drain":
+                            _post_peer_drain(payload["rank"],
+                                             payload["boundary"])
+                            _send_json(conn, {"kind": "ack"})
+                    except (OSError, ValueError, KeyError):
+                        continue
+        finally:
+            self._srv.close()
+
+    def stop(self):
+        self._stop.set()
+
+
+def notify_drain(host: str, port_base: int, my_rank: int, world: int,
+                 boundary: int, *, epoch: Optional[int] = None,
+                 grace: Optional[float] = None) -> List[int]:
+    """Send the drain notice to every peer's control port and collect
+    acks (bounded by ``grace``).  Returns the ranks that did NOT ack —
+    they may be dead, which the ordinary failure path will discover; the
+    drain proceeds regardless (an eviction deadline does not wait)."""
+    epoch = current_epoch() if epoch is None else epoch
+    grace = config.drain_grace_s() if grace is None else float(grace)
+    notice = {"kind": "drain", "rank": int(my_rank),
+              "boundary": int(boundary)}
+    unacked = []
+    deadline = time.monotonic() + grace
+    for peer in range(world):
+        if peer == my_rank:
+            continue
+        acked = False
+        try:
+            port = control_port(port_base, peer, epoch)
+        except ValueError:
+            # a rank beyond the declared span has no control listener
+            # (raise MPI4JAX_TPU_ELASTIC_PORT_SPAN above the world
+            # size): report it unacked, never crash the drain path
+            unacked.append(peer)
+            continue
+        while time.monotonic() < deadline and not acked:
+            try:
+                with socket.create_connection(
+                    (host, port),
+                    timeout=max(0.1, deadline - time.monotonic()),
+                ) as c:
+                    c.settimeout(max(0.1, deadline - time.monotonic()))
+                    _send_json(c, notice)
+                    acked = _recv_json(c).get("kind") == "ack"
+            except OSError:
+                time.sleep(0.05)
+        if not acked:
+            unacked.append(peer)
+    return unacked
+
+
 def _claimed_on_timeout(entries, expired) -> None:
     """The elastic watchdog handler (installed by :func:`run` via
     ``resilience.set_on_timeout``): instead of killing the process, post
@@ -552,19 +1104,12 @@ def classify_failure(exc: BaseException) -> Optional[RankFailure]:
 # ---------------------------------------------------------------------------
 
 
-def _flatten_state(state):
-    """``(leaves, treedef)`` — jax.tree when importable, else a minimal
-    deterministic flattener over dict/list/tuple nests (sorted dict keys,
-    jax's rule) so the pure tests run without jax.  ``treedef`` is only
-    ever passed back to the matching unflattener."""
-    try:
-        import jax
-
-        leaves, treedef = jax.tree.flatten(state)
-        return leaves, ("jax", treedef)
-    except ImportError:
-        pass
-
+def _pure_spec(state):
+    """``(spec, leaves)`` from the minimal deterministic flattener over
+    dict/list/tuple nests (sorted dict keys, jax's rule).  The spec is
+    JSON-able nested tuples — the structural description the join
+    protocol ships to a cold joiner, which has the committed bytes but
+    never saw the state object."""
     leaves = []
 
     def build(node):
@@ -577,7 +1122,32 @@ def _flatten_state(state):
         leaves.append(node)
         return ("*",)
 
-    return leaves, ("pure", build(state))
+    return build(state), leaves
+
+
+def _spec_from_json(obj):
+    """Rebuild a :func:`_pure_spec` spec from its JSON round trip (JSON
+    turns every tuple into a list)."""
+    if isinstance(obj, list):
+        return tuple(_spec_from_json(v) for v in obj)
+    return obj
+
+
+def _flatten_state(state):
+    """``(leaves, treedef)`` — jax.tree when importable, else the pure
+    flattener (sorted dict keys, jax's rule) so the pure tests run
+    without jax.  ``treedef`` is only ever passed back to the matching
+    unflattener."""
+    try:
+        import jax
+
+        leaves, treedef = jax.tree.flatten(state)
+        return leaves, ("jax", treedef)
+    except ImportError:
+        pass
+
+    spec, leaves = _pure_spec(state)
+    return leaves, ("pure", spec)
 
 
 def _unflatten_state(treedef, leaves):
@@ -638,12 +1208,12 @@ def unpack_leaves(buf, meta):
 # ---------------------------------------------------------------------------
 
 
-def _meter(name: str) -> None:
+def _meter(name: str, n: int = 1) -> None:
     try:
         from ..telemetry import core as _tcore
     except ImportError:
         return
-    _tcore.meter(name)
+    _tcore.meter(name, n)
 
 
 def _incident(meter: str, name: str, rank: int, detail: str) -> None:
@@ -699,6 +1269,10 @@ class ShardStore:
         self.bootstrap = dict(bootstrap or {})
         self._committed: Optional[dict] = None
         self._lock = threading.Lock()
+        # set by the elastic loop when THIS rank is shrunk out by a
+        # planned drain (the announcer, or a row-mate on a Cartesian
+        # drain): run() then returned the last committed state early
+        self.drained = False
 
     # -- world plumbing ----------------------------------------------------
 
@@ -762,6 +1336,14 @@ class ShardStore:
             s: bytes(buf[s * shard:(s + 1) * shard])
             for s in self.held_shards(k)
         }
+        # the structural twin a cold joiner can unflatten with: the pure
+        # spec matches jax.tree's structure on dict/list/tuple nests
+        # (sorted dict keys).  Only computed when the grow path can use
+        # it (the describe/adopt protocol), and validated STRICTLY —
+        # re-flattening the pure reconstruction must reproduce the jax
+        # treedef, so a custom pytree node can never ship a
+        # coincidentally-leaf-count-equal wrong structure to a joiner.
+        spec = self._validated_pure_spec(state, leaves, treedef, meta)
         record = {
             "step": int(step),
             "epoch": current_epoch(),
@@ -770,11 +1352,34 @@ class ShardStore:
             "nbytes": int(len(meta) and sum(m[2] for m in meta)),
             "meta": meta,
             "treedef": treedef,
+            "pure_spec": spec,
             "shards": shards,
         }
         with self._lock:
             self._committed = record
         _meter("elastic.commits")
+
+    @staticmethod
+    def _validated_pure_spec(state, leaves, treedef, meta):
+        """The JSON-able structural spec for the cold-join description,
+        or ``None`` when it cannot faithfully describe ``state``.  Costs
+        a tree walk per commit, so it only runs when the grow path that
+        consumes it is enabled."""
+        if not config.elastic_grow():
+            return None
+        spec, pure_leaves = _pure_spec(state)
+        if len(pure_leaves) != len(meta):
+            return None
+        if treedef[0] == "jax":
+            try:
+                import jax
+
+                rebuilt = _unflatten_state(("pure", spec), list(leaves))
+                if jax.tree.flatten(rebuilt)[1] != treedef[1]:
+                    return None
+            except Exception:
+                return None
+        return spec
 
     @property
     def committed_step(self) -> Optional[int]:
@@ -794,7 +1399,65 @@ class ShardStore:
             )
         return rec
 
-    def restore(self, failed: Iterable[int] = ()):
+    def can_describe_commit(self) -> bool:
+        """Whether the last commit carries a validated structural spec —
+        the admission gate: a world whose state cannot be described must
+        not admit joiners (the coordinator refuses BEFORE any epoch
+        moves, so the refusal is symmetric across ranks)."""
+        with self._lock:
+            rec = self._committed
+        return bool(rec) and rec.get("pure_spec") is not None
+
+    def describe_commit(self) -> dict:
+        """JSON-able description of the last commit — everything a cold
+        joiner needs to reconstruct the state from the restore exchange's
+        bytes (step, shard geometry, per-leaf meta, structural spec) and
+        NOTHING else (no shard payloads; those flow through the
+        one-allreduce cold-join branch).  Requires a JSON-able state
+        structure (dict/list/tuple nests — the pure spec must match the
+        jax leaf order, which custom pytree nodes break)."""
+        rec = self._require_commit()
+        if rec["pure_spec"] is None:
+            raise RuntimeError(
+                "describe_commit: the committed state's structure is not "
+                "JSON-able (custom pytree nodes?) — cold joins need "
+                "dict/list/tuple state nests (docs/resilience.md)"
+            )
+        return {
+            "step": rec["step"],
+            "epoch": rec["epoch"],
+            "k": rec["k"],
+            "shard": rec["shard"],
+            "nbytes": rec["nbytes"],
+            "meta": [[list(shape), dtype, nbytes]
+                     for shape, dtype, nbytes in rec["meta"]],
+            "pure_spec": rec["pure_spec"],
+        }
+
+    def adopt_commit(self, desc: dict) -> None:
+        """The cold joiner's half of :func:`describe_commit`: install a
+        commit record with the described geometry and NO shards, so the
+        next :meth:`restore` (``force_exchange=True``) contributes zeros
+        and receives everything."""
+        spec = _spec_from_json(desc["pure_spec"])
+        record = {
+            "step": int(desc["step"]),
+            "epoch": int(desc["epoch"]),
+            "k": int(desc["k"]),
+            "shard": int(desc["shard"]),
+            "nbytes": int(desc["nbytes"]),
+            "meta": [(tuple(shape), str(dtype), int(nbytes))
+                     for shape, dtype, nbytes in desc["meta"]],
+            "treedef": ("pure", spec),
+            "pure_spec": spec,
+            "shards": {},
+            "cold": True,
+        }
+        with self._lock:
+            self._committed = record
+
+    def restore(self, failed: Iterable[int] = (), *,
+                force_exchange: bool = False):
         """Reassemble the last committed state after losing ``failed``
         (old-world global ranks) and return ``(step, state)``.
 
@@ -804,15 +1467,29 @@ class ShardStore:
         it the provider of, and ONE ``SUM`` allreduce over the *current*
         (post-shrink) comm reassembles the full buffer on every rank —
         the exchange runs over the new world, never the revoked one.
+
+        ``force_exchange=True`` runs the allreduce even when local
+        reassembly would suffice — the cold-join branch: after a grow,
+        EVERY rank of the new world (the joiner included) must issue the
+        same collective; the joiner's adopted commit holds no shards, so
+        it contributes zeros and receives everything.
         """
         import numpy as np
 
         rec = self._require_commit()
         dead = frozenset(failed)
         k, shard = rec["k"], rec["shard"]
-        plan = reconstruction_plan(dead, k, self.redundancy)
         have = set(rec["shards"])
-        need_remote = any(s not in have for s in range(k))
+        need_remote = force_exchange or any(s not in have
+                                            for s in range(k))
+        # the reconstruction plan (and its feasibility check) only
+        # matters when shards must move: a process holding every shard —
+        # single-controller meshes always do — reassembles locally even
+        # when a whole contiguous replica block died (row-shrink)
+        plan = (reconstruction_plan(dead, k, self.redundancy)
+                if need_remote else {})
+        if rec.get("cold"):
+            _meter("elastic.cold_restores")
 
         if not need_remote:
             buf = np.concatenate(
@@ -827,6 +1504,23 @@ class ShardStore:
         state = _unflatten_state(rec["treedef"], leaves)
         _meter("elastic.restores")
         return rec["step"], state
+
+    def exchange_contribution(self, rec: dict, plan: Dict[int, int]):
+        """This process's flat contribution to the restore exchange: the
+        shards it is the designated provider of, placed at their offsets,
+        zeros elsewhere.  Factored out so the pure tests can pin the
+        one-contributor-per-shard invariant (summing every process's
+        contribution — the cold joiner's all-zeros included — must
+        reproduce the full committed buffer bit-identically)."""
+        import numpy as np
+
+        k, shard = rec["k"], rec["shard"]
+        contrib = np.zeros((k * shard,), np.uint8)
+        for s, provider in plan.items():
+            if s in rec["shards"] and self._provides(provider, rec):
+                contrib[s * shard:(s + 1) * shard] = np.frombuffer(
+                    rec["shards"][s], np.uint8)
+        return contrib
 
     def _exchange_shards(self, rec: dict, plan: Dict[int, int]):
         """One SUM allreduce over the current (post-shrink) comm moves
@@ -843,16 +1537,7 @@ class ShardStore:
         locals_ = set(
             r for r in self.local_ranks() if r < int(comm.world_size())
         )
-        # providers are named in OLD ranks; this process provides the
-        # shards whose provider it held before the shrink
-        provided = {
-            s for s, provider in plan.items()
-            if s in rec["shards"] and self._provides(provider, rec)
-        }
-        contrib = np.zeros((k * shard,), np.uint8)
-        for s in provided:
-            contrib[s * shard:(s + 1) * shard] = np.frombuffer(
-                rec["shards"][s], np.uint8)
+        contrib = self.exchange_contribution(rec, plan)
         size = int(comm.world_size())
         glob = np.zeros((size, k * shard), np.uint8)
         for r in locals_:
@@ -874,22 +1559,28 @@ class ShardStore:
 
     # -- failure handling entry points used by run() -----------------------
 
-    def apply_shrink(self, failed: Iterable[int]) -> Dict[int, int]:
+    def apply_shrink(self, failed: Iterable[int],
+                     fail_unit: str = "rank") -> Dict[int, int]:
         """Rebuild the mesh and this store's comm as "all minus failed"
         and record the old->new rank map on the last commit (the restore
         exchange resolves providers through it).  Single-controller path:
         the surviving devices of the bound mesh form the new world.
-        Returns the rank map."""
+        ``fail_unit`` widens the removal to whole grid rows/columns on
+        Cartesian meshes (``failed`` may name individual ranks; the
+        expansion happens here).  Returns the rank map."""
         from ..parallel.mesh import set_default_mesh, shrink_world_mesh
         from ..parallel import region as _region
 
-        dead = frozenset(failed)
         comm = self.comm
         if comm.mesh is None:
             raise RuntimeError("elastic shrink needs a comm bound to a mesh")
+        shape = tuple(comm.mesh.shape.values())
+        dead = expand_fail_unit(failed, shape, fail_unit)
+        if len(shape) > 1 and fail_unit in ("row", "col"):
+            _meter("elastic.row_shrinks")
         world = int(comm.world_size())
         rank_map = compact_rank_map(world, dead)
-        new_mesh = shrink_world_mesh(comm.mesh, dead)
+        new_mesh = shrink_world_mesh(comm.mesh, dead, fail_unit)
         self._comm = comm.shrink(dead, mesh=new_mesh)
         set_default_mesh(new_mesh)
         _region._default_comm = None
@@ -900,21 +1591,30 @@ class ShardStore:
             self._rank = rank_map[self._rank]
         return rank_map
 
-    def rebootstrap(self, failed: Iterable[int]) -> Dict[int, int]:
-        """Multi-process shrink: tear down the old distributed world and
-        re-initialize jax.distributed over the survivors (compacted
-        process ids; the lowest surviving old rank hosts the new
-        coordinator on ``port_base + epoch`` — a fresh port per epoch so
-        TIME_WAIT sockets from the revoked world cannot collide).
-        Requires ``bootstrap`` = {"host", "port_base", "process_id",
-        "num_processes"} (one device per process).  Returns the old->new
-        rank map."""
-        import jax
+    def apply_grow(self, added: int) -> None:
+        """Single-controller grow: rebuild the mesh with ``added``
+        replacement devices appended (new ranks ``k..k+added-1``), bind a
+        fresh current-epoch comm, and record the identity rank map on the
+        last commit — existing ranks keep their numbers on a grow, so the
+        restore exchange's providers are unchanged."""
+        from ..parallel.mesh import grow_world_mesh, set_default_mesh
+        from ..parallel import region as _region
 
-        from ..parallel.mesh import make_world_mesh, set_default_mesh
-        from ..parallel import mesh as _mesh_mod, region as _region
-        from .retry import retry_with_backoff
+        comm = self.comm
+        if comm.mesh is None:
+            raise RuntimeError("elastic grow needs a comm bound to a mesh")
+        from ..parallel.comm import Comm
 
+        new_mesh = grow_world_mesh(comm.mesh, added)
+        self._comm = Comm(comm.axes, mesh=new_mesh)
+        set_default_mesh(new_mesh)
+        _region._default_comm = None
+        with self._lock:
+            if self._committed is not None:
+                k = self._committed["k"]
+                self._committed["rank_map"] = {r: r for r in range(k)}
+
+    def _require_bootstrap(self) -> dict:
         bs = self.bootstrap
         for key in ("host", "port_base", "process_id", "num_processes"):
             if key not in bs:
@@ -923,15 +1623,21 @@ class ShardStore:
                     "{'host', 'port_base', 'process_id', 'num_processes'})"
                     f"; missing {key!r}"
                 )
-        dead = frozenset(failed)
-        world = int(bs["num_processes"])
-        rank_map = compact_rank_map(world, dead)
-        me_old = int(bs["process_id"])
-        if me_old in dead or me_old not in rank_map:
-            raise RankFailure(dead, "this rank was declared failed")
-        me_new = rank_map[me_old]
-        new_world = len(rank_map)
-        coord = f"{bs['host']}:{int(bs['port_base']) + current_epoch()}"
+        return bs
+
+    def _reinit_distributed(self, new_world: int, me_new: int) -> None:
+        """Tear down the revoked distributed world and re-initialize
+        jax.distributed at the current epoch's coordinator port (wrapped
+        within the declared span window); bind collisions from a wrapped
+        port are absorbed by the bootstrap retry policy."""
+        import jax
+
+        from ..parallel import mesh as _mesh_mod
+        from .retry import retry_with_backoff
+
+        bs = self.bootstrap
+        port = coordinator_port(int(bs["port_base"]), current_epoch())
+        coord = f"{bs['host']}:{port}"
 
         try:
             jax.distributed.shutdown()
@@ -962,14 +1668,47 @@ class ShardStore:
         bs["process_id"] = me_new
         bs["num_processes"] = new_world
 
-        # preserve the old world's axis name: Comm.shrink validates the
-        # new mesh along the COMM's axes, and the elastic contract is a
-        # 1-D mesh (apply_shrink's shrink_world_mesh keeps the name too)
+    def rebootstrap(self, failed: Iterable[int],
+                    fail_unit: str = "rank") -> Dict[int, int]:
+        """Multi-process shrink: tear down the old distributed world and
+        re-initialize jax.distributed over the survivors (compacted
+        process ids; the lowest surviving old rank hosts the new
+        coordinator on the epoch's wrapped port).  ``fail_unit`` widens
+        the removal to whole grid rows/columns and the rebuilt mesh
+        keeps the Cartesian shape minus the dead rows.  Requires
+        ``bootstrap`` = {"host", "port_base", "process_id",
+        "num_processes"} (one device per process).  Returns the old->new
+        rank map."""
+        from ..parallel.mesh import make_world_mesh, set_default_mesh
+        from ..parallel import region as _region
+
+        bs = self._require_bootstrap()
         old_mesh = self.comm.mesh
         old_axes = (tuple(old_mesh.axis_names)
                     if old_mesh is not None else None)
-        if old_axes is not None and len(old_axes) == 1:
-            new_mesh = make_world_mesh((new_world,), old_axes)
+        old_shape = (tuple(old_mesh.shape.values())
+                     if old_mesh is not None
+                     else (int(bs["num_processes"]),))
+        dead = expand_fail_unit(failed, old_shape, fail_unit)
+        if len(old_shape) > 1 and fail_unit in ("row", "col"):
+            _meter("elastic.row_shrinks")
+        world = int(bs["num_processes"])
+        rank_map = compact_rank_map(world, dead)
+        me_old = int(bs["process_id"])
+        if me_old in dead or me_old not in rank_map:
+            raise RankFailure(dead, "this rank was declared failed")
+        me_new = rank_map[me_old]
+        new_world = len(rank_map)
+        self._reinit_distributed(new_world, me_new)
+
+        # preserve the old world's axes: Comm.shrink validates the new
+        # mesh along the COMM's axes, and a row/column shrink keeps the
+        # Cartesian structure (fewer rows, same columns, or vice versa)
+        new_shape = shrunken_shape(old_shape, dead,
+                                   fail_unit if len(old_shape) > 1
+                                   else "rank")
+        if old_axes is not None:
+            new_mesh = make_world_mesh(new_shape, old_axes)
         else:
             new_mesh = make_world_mesh()
         set_default_mesh(new_mesh)
@@ -981,6 +1720,38 @@ class ShardStore:
         if self._rank is not None:
             self._rank = rank_map.get(self._rank, self._rank)
         return rank_map
+
+    def rebootstrap_grow(self, added: int) -> None:
+        """Multi-process grow: re-initialize jax.distributed at
+        ``world + added`` processes (existing ranks keep their ids — a
+        grow never renumbers; the joiners take ``world..world+added-1``),
+        rebuild the 1-D world mesh, and record the identity rank map on
+        the last commit so the cold-join restore's providers are the
+        unchanged old ranks."""
+        from ..parallel.comm import Comm
+        from ..parallel.mesh import make_world_mesh, set_default_mesh
+        from ..parallel import region as _region
+
+        bs = self._require_bootstrap()
+        old_mesh = self.comm.mesh
+        old_axes = (tuple(old_mesh.axis_names)
+                    if old_mesh is not None else None)
+        if old_axes is not None and len(old_axes) != 1:
+            raise RuntimeError(
+                "elastic grow needs a 1-D mesh (joiners append to the "
+                "end of the rank line; docs/resilience.md)")
+        world = int(bs["num_processes"])
+        new_world = world + int(added)
+        self._reinit_distributed(new_world, int(bs["process_id"]))
+        new_mesh = make_world_mesh(
+            (new_world,), old_axes if old_axes is not None else None)
+        set_default_mesh(new_mesh)
+        self._comm = Comm(self.comm.axes, mesh=new_mesh)
+        _region._default_comm = None
+        with self._lock:
+            if self._committed is not None:
+                k = self._committed["k"]
+                self._committed["rank_map"] = {r: r for r in range(k)}
 
     def multiprocess(self) -> bool:
         return bool(self.bootstrap)
@@ -1020,11 +1791,15 @@ def reassemble_from_stores(stores: Dict[int, "ShardStore"],
 
 
 def revoke_epoch(failed: Iterable[int], *, rank: int = 0,
-                 world: Optional[int] = None) -> int:
-    """Revoke the current comm epoch after the failed set is agreed:
+                 world: Optional[int] = None, added: int = 0,
+                 cause: str = "failure") -> int:
+    """Revoke the current comm epoch at an elastic boundary.  The
+    boundary carries a world *delta* — ranks removed (a failure or a
+    drain) and/or ranks added (a join):
 
     - advance the epoch (every compiled-program cache key folds it in,
-      so old-world executables re-trace rather than replay);
+      so old-world executables re-trace rather than replay), recording
+      the delta in :func:`epoch_history`;
     - drain the watchdog's in-flight registry (arms from collectives of
       the revoked world must not kill the recovered job);
     - drop the eager compiled-program cache (entries pin revoked meshes);
@@ -1034,7 +1809,18 @@ def revoke_epoch(failed: Iterable[int], *, rank: int = 0,
     """
     from . import watchdog as _wd
 
-    new_epoch = advance_epoch()
+    dead = sorted(frozenset(failed))
+    new_world = (world - len(dead) + int(added)) if world else None
+    if cause == "join":
+        detail = (f"admitted {added} replacement rank(s)"
+                  + (f" -> world {new_world}" if new_world else ""))
+    elif cause == "drain":
+        detail = (f"drained rank(s) {dead}"
+                  + (f" of {world}" if world else ""))
+    else:
+        detail = (f"shrank out rank(s) {dead}"
+                  + (f" of {world}" if world else ""))
+    new_epoch = advance_epoch(world=new_world, cause=cause, detail=detail)
     _wd.drain_registry()
     # drop the eager program cache (entries pin revoked meshes) — via
     # sys.modules so the isolated pure-test loader, which never loads the
@@ -1044,11 +1830,9 @@ def revoke_epoch(failed: Iterable[int], *, rank: int = 0,
     ops = sys.modules.get(__package__.rsplit(".", 1)[0] + ".ops")
     if ops is not None:
         ops.clear_caches()
-    dead = sorted(frozenset(failed))
     _incident(
         "elastic.epoch_changes", "epoch_change", rank,
-        f"epoch {new_epoch - 1} -> {new_epoch}: shrank out rank(s) "
-        f"{dead}" + (f" of {world}" if world else ""),
+        f"epoch {new_epoch - 1} -> {new_epoch}: {detail}",
     )
     return new_epoch
 
@@ -1060,22 +1844,43 @@ def revoke_epoch(failed: Iterable[int], *, rank: int = 0,
 
 def run(step_fn, state, store: ShardStore, *, steps: int,
         start_step: int = 0, commit_every: int = 1,
-        claim_watchdog: bool = True):
+        claim_watchdog: bool = True, drain_on_sigterm: bool = True):
     """Run ``state = step_fn(state, step, comm)`` for ``steps`` steps,
-    surviving rank loss: on a :class:`RankFailure` (raised by the step,
-    posted by the claimed watchdog, or classified from a distributed
-    death rattle) the loop commits the failure with the surviving peers,
-    revokes the epoch, shrinks the world, restores the last committed
-    state, and continues on ``k - f`` ranks from the committed step.
+    surviving rank loss AND world churn:
 
-    ``step_fn`` takes the CURRENT comm — after a shrink it is a new
-    (smaller, new-epoch) comm and the step re-traces at the new size.
+    - on a :class:`RankFailure` (raised by the step, posted by the
+      claimed watchdog, or classified from a distributed death rattle)
+      the loop commits the failure with the surviving peers, revokes the
+      epoch, shrinks the world (by rank, or by whole grid row/column
+      under ``MPI4JAX_TPU_ELASTIC_FAIL_UNIT``), restores the last
+      committed state, and continues on ``k - f`` ranks from the
+      committed step;
+    - on a drain request (:func:`request_drain` — a SIGTERM, the
+      ``preempt`` fault verb, or a simulated rank) the loop forces an
+      early commit at the next step boundary and executes a PLANNED
+      shrink: peers are notified with acks, no watchdog expiry fires, no
+      gossip round runs, and exactly one ``drain`` incident is
+      journalled.  A rank shrunk away (the leaver, or a row-mate on a
+      Cartesian drain) returns its last state with ``store.drained``
+      set;
+    - with ``MPI4JAX_TPU_ELASTIC_GROW`` on, replacement processes that
+      contacted the coordinator (:func:`request_join` /
+      :func:`join_and_run`) are admitted at the next commit boundary:
+      the epoch advances with a positive world delta, every process
+      re-bootstraps at ``k + j``, and the committed state streams to the
+      joiners through the cold-join restore.
+
+    ``step_fn`` takes the CURRENT comm — after any boundary it is a new
+    (resized, new-epoch) comm and the step re-traces at the new size.
     ``commit_every`` bounds the recovery replay window; the initial
     state is committed before step ``start_step`` so a first-step
     failure is recoverable.  ``claim_watchdog=True`` installs the
     elastic expiry handler (``resilience.set_on_timeout``) for the
     duration of the loop, so an expiry becomes a recovery instead of a
     process kill — the detection path a hung (not dead) peer needs.
+    ``drain_on_sigterm=True`` additionally installs a SIGTERM handler
+    that converts scheduler preemption notices into drain requests
+    (main thread only; silently skipped elsewhere).
     """
     from . import watchdog as _wd
 
@@ -1097,7 +1902,15 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
         # Python-fallback registry for the duration of the loop
         _wd.force_python_fallback(True)
         claimed = True
+    prev_sigterm = None
+    servers: dict = {}
     try:
+        # setup that can fail (socket binds, bootstrap-dict access) runs
+        # INSIDE the try: the finally below must restore the claimed
+        # watchdog handler and the SIGTERM handler even when setup dies
+        if drain_on_sigterm and store.multiprocess():
+            prev_sigterm = install_preemption_handler()
+        _restart_elastic_servers(servers, store)
         if store.committed_step is None:
             store.commit(start_step, state)
         step = start_step
@@ -1106,18 +1919,36 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
                 state = step_fn(state, step, store.comm)
                 _block_on(state)
                 step += 1
+                committed = False
                 if (step - start_step) % commit_every == 0 or step == steps:
                     store.commit(step, state)
+                    committed = True
+                outcome = _boundary_actions(
+                    store, step, steps, state, committed,
+                    start_step, commit_every, servers)
+                if outcome is not None:
+                    kind, step, state = outcome
+                    if kind == "leave":
+                        return state
             except BaseException as exc:  # noqa: B036 - KeyboardInterrupt too
                 rf = classify_failure(exc)
                 if rf is None:
                     raise
                 step, state = _recover(rf, store)
+                _restart_elastic_servers(servers, store)
         return state
     finally:
+        _stop_elastic_servers(servers)
         if claimed:
             _wd.set_on_timeout(prev_handler)
             _wd.force_python_fallback(prev_fallback)
+        if prev_sigterm is not None:
+            import signal as _signal
+
+            try:
+                _signal.signal(_signal.SIGTERM, prev_sigterm)
+            except (ValueError, OSError):
+                pass
 
 
 def _block_on(state) -> None:
@@ -1132,9 +1963,307 @@ def _block_on(state) -> None:
         pass
 
 
+# ---------------------------------------------------------------------------
+# boundary control: planned reconfiguration between steps
+# ---------------------------------------------------------------------------
+
+
+def _restart_elastic_servers(servers: dict, store: ShardStore) -> None:
+    """(Re)bind the epoch-scoped listeners: every multi-process rank runs
+    a control listener (drain notices); the coordinator (rank 0)
+    additionally runs the join listener when the grow flag is on.  Bind
+    failures degrade silently — the listeners are conveniences of the
+    PLANNED paths; the failure path never needs them."""
+    _stop_elastic_servers(servers)
+    if not store.multiprocess():
+        return
+    bs = store.bootstrap
+    try:
+        host, pb = bs["host"], int(bs["port_base"])
+        me = int(bs["process_id"])
+    except (KeyError, TypeError, ValueError):
+        return  # joiner-style partial bootstrap: no listeners yet
+    epoch = current_epoch()
+    try:
+        servers["control"] = _ControlServer(
+            host, control_port(pb, me, epoch))
+    except (OSError, ValueError):
+        servers["control"] = None
+    if me == 0 and config.elastic_grow():
+        try:
+            servers["join"] = _JoinServer(host, join_port(pb, epoch))
+        except OSError:
+            servers["join"] = None
+
+
+def _stop_elastic_servers(servers: dict) -> None:
+    for srv in servers.values():
+        if srv is not None:
+            srv.stop()
+    servers.clear()
+
+
+def _boundary_actions(store: ShardStore, step: int, steps: int, state,
+                      committed: bool, start_step: int, commit_every: int,
+                      servers: dict):
+    """Planned world changes at a step boundary, in priority order:
+    execute a scheduled drain (ours or a peer's), then admit pending
+    joiners (commit boundaries only).  Returns ``None`` (nothing to do),
+    ``("continue", step, state)`` (world changed, keep looping), or
+    ``("leave", step, state)`` (this rank was drained out)."""
+    mine = take_pending_drain()
+    if mine is not None and store.multiprocess():
+        bs = store.bootstrap
+        my_rank = int(bs["process_id"])
+        leaver = my_rank if mine["rank"] is None else int(mine["rank"])
+        if leaver == my_rank:
+            # announce our departure: boundary = NEXT step boundary, acks
+            # collected before anyone steps toward it, so no peer can
+            # race past the boundary into a collective we never enter
+            boundary = step + 1
+            notify_drain(bs["host"], int(bs["port_base"]), my_rank,
+                         int(bs["num_processes"]), boundary,
+                         grace=mine["grace"])
+            mark_comm_draining(store.comm, boundary)
+            _post_peer_drain(my_rank, boundary)
+            mine = None
+        else:
+            mine = {"rank": leaver, "grace": mine["grace"]}
+    if mine is not None:
+        # single-controller simulated drain (or an explicit-rank drain):
+        # executes at THIS boundary
+        if mine["rank"] is None:
+            raise RuntimeError(
+                "request_drain() without a rank needs a multi-process "
+                "world (a single controller cannot leave its own job); "
+                "pass rank= to drain a simulated rank"
+            )
+        return _execute_drain(store, step, state, committed,
+                              int(mine["rank"]), servers)
+    peer = peek_peer_drain()
+    if peer is not None and step >= int(peer["boundary"]):
+        take_peer_drain()
+        return _execute_drain(store, step, state, committed,
+                              int(peer["rank"]), servers)
+    if committed and step < steps:
+        joins = _poll_joins(store)
+        # never admit at a boundary with a drain already scheduled: the
+        # joiner would miss the (already-delivered) drain notice and
+        # desynchronize at the leave boundary.  Every old rank sees the
+        # same pending notice here — the leaver collects acks BEFORE it
+        # enters the poll allreduce — so the deferral is symmetric; the
+        # joiners stay parked and are admitted at the next boundary.
+        if joins and peek_peer_drain() is None:
+            return _execute_grow(store, step, state, committed, joins,
+                                 servers)
+    return None
+
+
+def _execute_drain(store: ShardStore, step: int, state, committed: bool,
+                   leaver: int, servers: dict):
+    """The planned shrink at the leave boundary: force the early commit,
+    widen the removal to the declared fail unit, and either exit (this
+    rank is leaving) or rebuild the world without the leavers.  No
+    agreement round (the departure is announced, not suspected), no
+    restore (every survivor's state is live), no majority guard (a
+    planned drain cannot split-brain), exactly one ``drain`` incident
+    per process."""
+    from . import watchdog as _wd
+
+    with _wd.suspend_expiries():
+        if not committed:
+            store.commit(step, state)
+        comm = store.comm
+        mesh = getattr(comm, "mesh", None)
+        mesh_shape = (tuple(mesh.shape.values()) if mesh is not None
+                      else (int(comm.world_size()),))
+        unit = config.elastic_fail_unit()
+        removed = expand_fail_unit({leaver}, mesh_shape, unit)
+        world = int(store.bootstrap.get("num_processes")
+                    or comm.world_size())
+        me = (int(store.bootstrap["process_id"])
+              if store.multiprocess() else None)
+        _meter("elastic.drains")
+        _incident(
+            "elastic.drain_incidents", "drain", me if me is not None else 0,
+            f"rank {leaver} drained at step {step} (removed "
+            f"{sorted(removed)} of {world}, fail_unit={unit})",
+        )
+        seal_drained_comm(comm)
+        if me is not None and me in removed:
+            # we are leaving (the announcer, or a row-mate shrunk out
+            # with it): the state as of the forced commit is the result
+            store.drained = True
+            return "leave", step, state
+        revoke_epoch(removed, rank=me if me is not None else 0,
+                     world=world, cause="drain")
+        if store.multiprocess():
+            store.rebootstrap(removed, unit)
+        else:
+            store.apply_shrink(removed, unit)
+        _restart_elastic_servers(servers, store)
+    return "continue", step, state
+
+
+def _poll_joins(store: ShardStore) -> int:
+    """How many joiners to admit at this boundary.  Single controller:
+    the simulated-join queue.  Multi-process (grow flag on): one tiny
+    SUM allreduce of the coordinator's pending count, so every rank
+    learns the same delta at the same boundary."""
+    if not store.multiprocess():
+        return pending_join_count()
+    if not config.elastic_grow():
+        return 0
+    import numpy as np
+
+    from ..ops import SUM, allreduce
+
+    comm = store.comm
+    size = int(comm.world_size())
+    me = int(store.bootstrap["process_id"])
+    pending = pending_join_count()
+    if pending and me == 0 and not store.can_describe_commit():
+        # the committed state cannot be described to a joiner (custom
+        # pytree nodes — docs/resilience.md): refuse admission HERE,
+        # before any epoch moves, so every rank symmetrically sees 0
+        # and the job keeps training instead of dying mid-admission
+        _meter("elastic.joins_refused")
+        pending = 0
+    counts = np.zeros((size, 1), np.int32)
+    counts[me, 0] = pending
+    out, _ = allreduce(counts, op=SUM, comm=comm)
+    return int(np.asarray(out)[0, 0])
+
+
+def _execute_grow(store: ShardStore, step: int, state, committed: bool,
+                  joins: int, servers: dict):
+    """Admit ``joins`` replacement ranks at this commit boundary: advance
+    the epoch with a positive world delta, send each parked joiner its
+    admit message (identity, new world, commit geometry), re-bootstrap at
+    ``k + joins``, and run the cold-join restore so every rank — joiners
+    included — leaves the boundary with the committed state."""
+    from . import watchdog as _wd
+
+    with _wd.suspend_expiries():
+        if not committed:
+            store.commit(step, state)
+        comm = store.comm
+        world = int(store.bootstrap.get("num_processes")
+                    or comm.world_size())
+        me = (int(store.bootstrap["process_id"])
+              if store.multiprocess() else 0)
+        _meter("elastic.joins", joins)
+        _incident(
+            "elastic.join_incidents", "join", me,
+            f"admitting {joins} replacement rank(s) at step "
+            f"{store.committed_step}: world {world} -> {world + joins}",
+        )
+        revoke_epoch((), rank=me, world=world, added=joins, cause="join")
+        if store.multiprocess():
+            if me == 0:
+                pending = _take_pending_joins()
+                # a joiner that arrived after the poll stays parked for
+                # the NEXT boundary (the polled count is what every rank
+                # agreed to admit)
+                if len(pending) > joins:
+                    with _join_lock:
+                        _pending_joins[0:0] = pending[joins:]
+                desc = store.describe_commit()
+                for i, j in enumerate(pending[:joins]):
+                    admit = {
+                        "kind": "admit",
+                        "epoch": current_epoch(),
+                        "process_id": world + i,
+                        "num_processes": world + joins,
+                        "step": store.committed_step,
+                        "commit": desc,
+                        "axes": list(comm.axes),
+                    }
+                    conn = j.get("conn")
+                    if conn is not None:
+                        try:
+                            _send_json(conn, admit)
+                        except OSError:
+                            pass
+                        finally:
+                            conn.close()
+            store.rebootstrap_grow(joins)
+            new_step, new_state = store.restore(force_exchange=True)
+        else:
+            _take_pending_joins()
+            store.apply_grow(joins)
+            new_step, new_state = store.restore()
+        _restart_elastic_servers(servers, store)
+        _meter("elastic.resumes")
+    return "continue", new_step, new_state
+
+
+def join_and_run(step_fn, store: ShardStore, *, steps: int,
+                 commit_every: int = 1, claim_watchdog: bool = True,
+                 join_timeout: float = 300.0):
+    """The replacement process's entry point: contact the running
+    world's coordinator (scanning the declared port window for the live
+    epoch), wait to be admitted at a commit boundary, adopt the admitted
+    epoch and identity, receive the committed state through the
+    cold-join restore (we contribute zeros, the survivors' shards sum to
+    everything), and re-enter :func:`run` at the committed step.
+    Returns the final state, exactly as :func:`run` does."""
+    import jax
+
+    from ..parallel.comm import Comm
+    from ..parallel.mesh import make_world_mesh, set_default_mesh
+    from ..parallel import mesh as _mesh_mod, region as _region
+    from .retry import retry_with_backoff
+
+    bs = store.bootstrap
+    for key in ("host", "port_base"):
+        if key not in bs:
+            raise RuntimeError(
+                "join_and_run needs ShardStore(bootstrap={'host', "
+                f"'port_base'}}); missing {key!r}"
+            )
+    admit = request_join(bs["host"], int(bs["port_base"]),
+                         timeout=join_timeout)
+    _set_epoch(int(admit["epoch"]))
+    bs["process_id"] = int(admit["process_id"])
+    bs["num_processes"] = int(admit["num_processes"])
+    port = coordinator_port(int(bs["port_base"]), current_epoch())
+    retry_with_backoff(
+        lambda: jax.distributed.initialize(
+            coordinator_address=f"{bs['host']}:{port}",
+            num_processes=int(bs["num_processes"]),
+            process_id=int(bs["process_id"]),
+        ),
+        what=f"cold join (epoch {current_epoch()}, coordinator "
+             f"{bs['host']}:{port})",
+        deadline=config.bootstrap_deadline(),
+        max_attempts=config.bootstrap_max_attempts() or None,
+    )
+    _mesh_mod._distributed_initialized = True
+    axes = tuple(admit.get("axes") or ()) or None
+    mesh = make_world_mesh((int(bs["num_processes"]),), axes)
+    set_default_mesh(mesh)
+    _region._default_comm = None
+    store._comm = Comm(tuple(mesh.axis_names), mesh=mesh)
+    store.adopt_commit(admit["commit"])
+    _incident(
+        "elastic.join_incidents", "join", int(bs["process_id"]),
+        f"cold-joined epoch {current_epoch()} as rank "
+        f"{bs['process_id']} of {bs['num_processes']} at step "
+        f"{admit['step']}",
+    )
+    step, state = store.restore(force_exchange=True)
+    _meter("elastic.resumes")
+    return run(step_fn, state, store, steps=steps, start_step=step,
+               commit_every=commit_every, claim_watchdog=claim_watchdog)
+
+
 def _recover(rf: RankFailure, store: ShardStore):
     """The shrink-and-resume sequence: agree -> revoke -> shrink ->
-    restore.  Returns ``(committed_step, state)``."""
+    restore.  The agreed failed set is widened to the declared fail unit
+    (``MPI4JAX_TPU_ELASTIC_FAIL_UNIT``) before the shrink, so Cartesian
+    grids lose whole rows/columns and stay rectangular.  Returns
+    ``(committed_step, state)``."""
     _meter("elastic.failures_detected")
     comm = store.comm
     world = int(store.bootstrap.get("num_processes") or comm.world_size())
@@ -1145,7 +2274,8 @@ def _recover(rf: RankFailure, store: ShardStore):
         failed = exchange_suspects(
             my_rank, world, rf.suspects, bs["host"],
             int(bs.get("agree_port_base",
-                       int(bs["port_base"]) + 1000)) + 17 * current_epoch(),
+                       int(bs["port_base"]) + 1000))
+            + 17 * wrapped_epoch(current_epoch()),
             timeout=float(bs.get("agree_timeout", 20.0)),
         )
         if my_rank in failed:
@@ -1162,6 +2292,9 @@ def _recover(rf: RankFailure, store: ShardStore):
                 "suspects were not confirmed and no peer is unreachable — "
                 "refusing to shrink a healthy world"
         ) from rf
+    # the split-brain guard judges the ranks that actually FAILED — the
+    # fail-unit expansion below removes healthy row-mates by policy, not
+    # by partition, so it does not weigh against the majority
     if not majority_survives(failed, world):
         raise RankFailure(
             failed,
@@ -1169,14 +2302,28 @@ def _recover(rf: RankFailure, store: ShardStore):
             "the majority threshold (split-brain guard): aborting instead "
             "of training a divergent minority partition",
         ) from rf
-    # raises RankFailure when a shard lost its whole replica set
-    reconstruction_plan(failed, world, store.redundancy)
-
-    revoke_epoch(failed, rank=my_rank, world=world)
+    unit = config.elastic_fail_unit()
+    mesh = getattr(comm, "mesh", None)
+    mesh_shape = (tuple(mesh.shape.values()) if mesh is not None
+                  else (world,))
+    removed = expand_fail_unit(failed, mesh_shape, unit)
+    if store.multiprocess() and my_rank in removed:
+        raise RankFailure(
+            removed,
+            f"this rank's grid {unit} contains failed rank(s) "
+            f"{sorted(failed)} — shrunk out with them (fail_unit={unit})",
+        ) from rf
     if store.multiprocess():
-        store.rebootstrap(failed)
+        # raises RankFailure when a shard lost its whole replica set —
+        # only meaningful when shards must move between processes (a
+        # single controller holds every shard and restores locally)
+        reconstruction_plan(removed, world, store.redundancy)
+
+    revoke_epoch(removed, rank=my_rank, world=world)
+    if store.multiprocess():
+        store.rebootstrap(removed, unit)
     else:
-        store.apply_shrink(failed)
-    step, state = store.restore(failed)
+        store.apply_shrink(removed, unit)
+    step, state = store.restore(removed)
     _meter("elastic.resumes")
     return step, state

@@ -11,14 +11,21 @@ flows through (``ops/_base.py _run_body``) — so every op is injectable in
 tests without touching per-op code, and a production incident can be
 rehearsed with one environment variable.
 
+A fifth verb, ``preempt``, rehearses the *announced* eviction (spot/
+preemptible capacity): instead of killing or stalling the rank it posts
+a SIGTERM-style drain notice (``resilience/elastic.request_drain``), so
+the elastic loop executes a graceful drain at its next step boundary —
+the one failure mode that should cost a commit interval, not a
+detection timeout.
+
 Spec grammar (``MPI4JAX_TPU_FAULT_SPEC``, full reference in
 docs/resilience.md)::
 
     spec    := clause (';' clause)*
     clause  := verb (':' arg)*
-    verb    := 'delay' | 'die' | 'hang' | 'corrupt'
+    verb    := 'delay' | 'die' | 'hang' | 'corrupt' | 'preempt'
     arg     := 'nan' | 'inf' | key '=' value      # bare modes only for corrupt
-    key     := 'rank' | 'op' | 'after' | 'secs'
+    key     := 'rank' | 'op' | 'after' | 'secs' | 'grace'
 
 Examples::
 
@@ -26,6 +33,9 @@ Examples::
                                                # allreduce after its 3rd
     die:rank=0:op=barrier:after=1              # rank 0 exits in its 2nd barrier
     corrupt:nan:rank=2:op=allreduce            # rank 2 feeds NaN inputs
+    preempt:rank=3:after=4:grace=2             # rank 3 gets a drain notice in
+                                               # its 5th collective (2s ack
+                                               # grace)
 
 Semantics:
 
@@ -41,6 +51,9 @@ Semantics:
   ``hang`` sleeps forever (the process stays alive but never enters the
   collective — unlike ``die``, the peers see no error, only silence, so a
   drill exercises the watchdog-expiry detection path);
+  ``preempt`` posts a drain notice (``grace`` seconds of peer-ack budget,
+  default the ``MPI4JAX_TPU_DRAIN_GRACE_S`` flag) and lets the collective
+  proceed — the rank leaves gracefully at its next step boundary;
   ``corrupt`` overwrites the op's floating-point inputs with NaN (``nan``,
   default) or +Inf (``inf``) on the firing rank only.
 
@@ -60,14 +73,15 @@ import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-_VERBS = ("delay", "die", "hang", "corrupt")
-_KEYS = ("rank", "op", "after", "secs")
+_VERBS = ("delay", "die", "hang", "corrupt", "preempt")
+_KEYS = ("rank", "op", "after", "secs", "grace")
 _MODES = ("nan", "inf")
 
 _GRAMMAR = (
     "expected 'verb[:arg]*' clauses joined by ';', verb in "
     f"{_VERBS}, args 'key=value' with key in {_KEYS} (plus a bare "
-    f"mode in {_MODES} for corrupt) — e.g. "
+    f"mode in {_MODES} for corrupt; 'secs' only for delay, 'grace' "
+    "only for preempt) — e.g. "
     "'delay:rank=1:op=allreduce:after=3:secs=2'"
 )
 
@@ -82,6 +96,7 @@ class FaultClause:
     op: Optional[str] = None    # lowercase dispatch op name; None = all ops
     after: int = 0
     secs: float = 1.0           # delay only
+    grace: Optional[float] = None  # preempt only: peer-ack budget seconds
 
     def matches_op(self, opname: str) -> bool:
         return self.op is None or self.op == opname
@@ -99,6 +114,8 @@ class FaultClause:
             parts.append(f"after={self.after}")
         if self.verb == "delay":
             parts.append(f"secs={self.secs:g}")
+        if self.verb == "preempt" and self.grace is not None:
+            parts.append(f"grace={self.grace:g}")
         return ":".join(parts)
 
 
@@ -137,6 +154,8 @@ def _parse_clause(text: str) -> FaultClause:
                 kw["after"] = int(value)
             elif key == "secs":
                 kw["secs"] = float(value)
+            elif key == "grace":
+                kw["grace"] = float(value)
             else:
                 kw["op"] = value.lower()
         except ValueError as e:
@@ -147,12 +166,18 @@ def _parse_clause(text: str) -> FaultClause:
         raise ValueError(
             f"fault spec clause {text!r}: 'secs' only applies to delay"
         )
+    if verb != "preempt" and "grace" in kw:
+        raise ValueError(
+            f"fault spec clause {text!r}: 'grace' only applies to preempt"
+        )
     if verb == "corrupt" and mode is None:
         mode = "nan"
     if kw.get("after", 0) < 0:
         raise ValueError(f"fault spec clause {text!r}: after must be >= 0")
     if kw.get("secs", 1.0) < 0:
         raise ValueError(f"fault spec clause {text!r}: secs must be >= 0")
+    if kw.get("grace") is not None and kw["grace"] <= 0:
+        raise ValueError(f"fault spec clause {text!r}: grace must be > 0")
     return FaultClause(verb=verb, mode=mode, **kw)
 
 
@@ -264,6 +289,16 @@ def probe_host(indexed_clauses, mpi_name: str, rank) -> int:
                            f"({clause.canonical()}) — sleeping forever")
             sys.stderr.flush()
             _hang_forever()
+        elif clause.verb == "preempt":
+            _fault_line(r, f"preempt notice injected in {mpi_name} "
+                           f"({clause.canonical()}) — drain at next "
+                           "step boundary")
+            # the SIGTERM-style path: post the drain and let the
+            # collective proceed; the elastic loop executes the planned
+            # shrink at its next step boundary (resilience/elastic.py)
+            from .elastic import request_drain
+
+            request_drain(clause.grace, rank=r)
         else:  # corrupt
             _fault_line(r, f"corrupt:{clause.mode} injected in {mpi_name} "
                            f"({clause.canonical()})")

@@ -114,14 +114,17 @@ def cache_token() -> tuple:
     """Hashable fingerprint of the effective resilience configuration —
     belongs in every compiled-program cache key that caches op lowerings.
 
-    The communication epoch (resilience/elastic.py) rides here: advancing
-    it after a shrink changes this token, which changes both program-cache
-    keys — every executable traced against the revoked world becomes
+    The elastic token (resilience/elastic.py) rides here: the
+    communication epoch plus the declared elastic knobs (grow, fail
+    unit, drain grace, port span).  Advancing the epoch after a shrink
+    or a grow changes this token, which changes both program-cache keys
+    — every executable traced against the revoked world becomes
     unreachable and the next call re-traces at the new size.  A job that
-    never shrinks carries the constant epoch 0 and its keys match a build
-    without the elastic layer engaged.
+    never churns, with every elastic knob at its default, carries the
+    constant 0 and its keys match a build without the elastic layer
+    engaged.
     """
-    from .elastic import current_epoch
+    from .elastic import elastic_cache_token
     from .watchdog import _force_fallback
 
     return (
@@ -131,7 +134,7 @@ def cache_token() -> tuple:
         # the watchdog backend choice is baked into traced arm/disarm
         # callbacks, so flipping it must retrace too
         _force_fallback,
-        current_epoch(),
+        elastic_cache_token(),
     )
 
 

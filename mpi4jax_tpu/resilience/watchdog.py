@@ -42,9 +42,41 @@ __all__ = [
     "registry_empty",
     "set_on_timeout",
     "drain_registry",
+    "suspend_expiries",
 ]
 
 _POLL_INTERVAL = 0.1
+
+# nesting depth of suspend_expiries() windows: while > 0 the monitor
+# keeps tracking in-flight ops but treats none as expired.  Planned
+# elastic reconfigurations (grow admission, graceful drain) hold the
+# window open across their re-bootstrap + restore exchange — seconds of
+# legitimate cross-rank skew that must not read as a hang.
+_suspend_lock = threading.Lock()
+_suspended = 0
+
+
+class suspend_expiries:
+    """Context manager: no watchdog expiry fires while any window is
+    open (arms and disarms still track normally, so coverage resumes the
+    moment the window closes)."""
+
+    def __enter__(self):
+        global _suspended
+        with _suspend_lock:
+            _suspended += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _suspended
+        with _suspend_lock:
+            _suspended = max(0, _suspended - 1)
+        return False
+
+
+def expiries_suspended() -> bool:
+    with _suspend_lock:
+        return _suspended > 0
 
 
 def _telemetry_incident(meter_name, name, rank, detail=""):
@@ -133,7 +165,11 @@ class _Registry:
             ]
 
     def check_expired(self):
-        """One monitor scan; returns the expired snapshot entry or None."""
+        """One monitor scan; returns the expired snapshot entry or None
+        (always None inside a ``suspend_expiries`` window — planned
+        elastic reconfiguration, not a hang)."""
+        if expiries_suspended():
+            return None
         for e in self.snapshot():
             if e["elapsed"] > e["timeout"]:
                 return e

@@ -374,6 +374,18 @@ def snapshot(include_events: bool = False) -> dict:
         "ops": ops,
         "meters": meters,
     }
+    # the elastic epoch audit trail (epoch, world size, cause) rides
+    # every snapshot so report() can render a churn run's history;
+    # guarded — the resilience package is optional under the isolated
+    # test loaders, and a never-churned job contributes nothing
+    try:
+        from ..resilience import elastic as _elastic
+    except ImportError:
+        pass
+    else:
+        history = _elastic.epoch_history()
+        if history:
+            snap["epochs"] = history
     if include_events:
         snap["events"] = journal.snapshot_events()
     return snap

@@ -173,6 +173,22 @@ def render(snaps: List[dict]) -> str:
         lines.append("meters:")
         for name in sorted(total_meters):
             lines.append(f"  {name:<40} {total_meters[name]:>10}")
+    epochs = {}
+    for snap in snaps:
+        for rec in snap.get("epochs", ()):
+            epochs.setdefault(int(rec["epoch"]), rec)
+    if epochs:
+        # the elastic audit trail: every world change with its cause, so
+        # a churn run is auditable post-hoc (docs/resilience.md)
+        lines.append("")
+        lines.append("epoch history:")
+        for e in sorted(epochs):
+            rec = epochs[e]
+            world = rec.get("world")
+            lines.append(
+                f"  epoch {e:>3}  world {world if world is not None else '?':>4}"
+                f"  {rec.get('cause', '?'):<8} {rec.get('detail', '')}"
+            )
     if events:
         lines.append("")
         lines.append(merge.render_skew(skews))

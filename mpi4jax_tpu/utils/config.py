@@ -34,6 +34,7 @@ ANALYZE_MODES = ("off", "warn", "error")
 COLLECTIVE_ALGOS = ("auto", "butterfly", "ring", "hier")
 TELEMETRY_MODES = ("off", "counters", "events")
 FUSION_MODES = ("off", "auto", "force")
+ELASTIC_FAIL_UNITS = ("rank", "row", "col")
 
 # default fusion bucket: 4 MiB — large enough that a typical optimizer
 # step's small gradient leaves coalesce into a handful of collectives,
@@ -60,6 +61,21 @@ DEFAULT_BOOTSTRAP_MAX_ATTEMPTS = 0  # 0 = bounded by the deadline only
 # ranks, tolerating that many simultaneous rank losses at a memory cost
 # of (redundancy+1)/k of the state per rank
 DEFAULT_ELASTIC_REDUNDANCY = 1
+
+# default port window for the per-epoch elastic rendezvous ports: the
+# coordinator of epoch e listens on port_base + (e % span), so a job
+# that churns through hundreds of epochs stays inside a declared
+# span-wide window instead of walking out of the ephemeral port range.
+# 64 keeps the wrapped ports identical to the unwrapped pre-span scheme
+# for the first 64 epochs while bounding the footprint at 4*span ports
+# (coordinator / join / two control banks — resilience/elastic.py).
+DEFAULT_ELASTIC_PORT_SPAN = 64
+
+# default seconds a draining (preempted) rank waits for its peers to
+# acknowledge the drain notice before it proceeds to the leave boundary
+# (resilience/elastic.py request_drain): long enough for a localhost or
+# DCN round trip under load, far below any eviction deadline
+DEFAULT_DRAIN_GRACE_S = 5.0
 
 # default ring/butterfly crossover: 1 MiB — below it the butterfly's
 # ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
@@ -118,6 +134,37 @@ FLAGS = {
              "owner, so this many SIMULTANEOUS rank losses are "
              "recoverable.  Memory cost per rank is (redundancy+1)/k of "
              "the registered state.  Default 1."),
+        Flag("MPI4JAX_TPU_ELASTIC_GROW", "bool", False,
+             "Elastic grow (resilience/elastic.py): accept replacement "
+             "ranks back into the world.  The current coordinator "
+             "listens for join requests and ``mpx.elastic.run`` admits "
+             "joiners at commit boundaries (epoch advance + cold-join "
+             "state restore).  Off (default) keeps the run loop free of "
+             "the per-boundary join poll and the lowered HLO "
+             "byte-identical to a build without the grow path."),
+        Flag("MPI4JAX_TPU_DRAIN_GRACE_S", "float", DEFAULT_DRAIN_GRACE_S,
+             "Graceful-drain notice window in seconds "
+             "(resilience/elastic.py request_drain): how long a leaving "
+             "rank waits for every peer to acknowledge its drain notice "
+             "before stepping to the leave boundary.  Also the default "
+             "grace of the ``preempt`` fault verb.  Default 5."),
+        Flag("MPI4JAX_TPU_ELASTIC_FAIL_UNIT", "choice", "rank",
+             "Granularity of an elastic shrink "
+             "(parallel/mesh.shrink_world_mesh): ``rank`` (default) "
+             "removes exactly the failed ranks and requires a 1-D mesh; "
+             "``row``/``col`` remove every WHOLE grid row/column that "
+             "contains a failed rank, so Cartesian (tensor x data) "
+             "meshes shrink structurally instead of erroring "
+             "(docs/resilience.md 'Grow and graceful drain').",
+             choices=ELASTIC_FAIL_UNITS),
+        Flag("MPI4JAX_TPU_ELASTIC_PORT_SPAN", "int",
+             DEFAULT_ELASTIC_PORT_SPAN,
+             "Width of the per-epoch elastic port window: epoch e's "
+             "coordinator (and join/control listeners) derive their "
+             "ports from ``port_base + (e % span)`` instead of the "
+             "unbounded ``port_base + e``, so long-churning jobs never "
+             "walk out of the ephemeral range (bind collisions are "
+             "absorbed by the bootstrap retry policy).  Default 64."),
         Flag("MPI4JAX_TPU_CHECK_NUMERICS", "bool", False,
              "Abort (via the ``abort_if`` fail-fast path) when a "
              "collective's inputs or outputs contain NaN/Inf, naming the "
@@ -390,6 +437,42 @@ def elastic_redundancy() -> int:
     its owner plus one neighbor, tolerating one simultaneous loss)."""
     return _parse_env_positive_int(
         "MPI4JAX_TPU_ELASTIC_REDUNDANCY", DEFAULT_ELASTIC_REDUNDANCY
+    )
+
+
+def elastic_grow() -> bool:
+    """Whether the elastic loop admits replacement ranks
+    (``MPI4JAX_TPU_ELASTIC_GROW``; default off — see
+    resilience/elastic.py and docs/resilience.md)."""
+    return parse_env_bool("MPI4JAX_TPU_ELASTIC_GROW", False)
+
+
+def drain_grace_s() -> float:
+    """Graceful-drain notice window in seconds
+    (``MPI4JAX_TPU_DRAIN_GRACE_S``; default 5)."""
+    val = parse_env_float("MPI4JAX_TPU_DRAIN_GRACE_S",
+                          DEFAULT_DRAIN_GRACE_S)
+    if val is None or val <= 0:
+        raise ValueError(
+            "MPI4JAX_TPU_DRAIN_GRACE_S must be a positive number of "
+            f"seconds, got {val!r}"
+        )
+    return val
+
+
+def elastic_fail_unit() -> str:
+    """Granularity of an elastic shrink
+    (``MPI4JAX_TPU_ELASTIC_FAIL_UNIT``): ``rank`` (default) / ``row`` /
+    ``col`` — see parallel/mesh.shrink_world_mesh."""
+    return _parse_env_choice("MPI4JAX_TPU_ELASTIC_FAIL_UNIT")
+
+
+def elastic_port_span() -> int:
+    """Width of the per-epoch elastic port window
+    (``MPI4JAX_TPU_ELASTIC_PORT_SPAN``; default 64, minimum 1)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_ELASTIC_PORT_SPAN", DEFAULT_ELASTIC_PORT_SPAN,
+        minimum=1,
     )
 
 

@@ -416,6 +416,329 @@ def test_elastic_run_produces_mpx126_clean_recovery():
 # ---------------------------------------------------------------------------
 
 
+def _grid_comm(shape=(2, 4)):
+    mesh = mpx.make_world_mesh(shape, ("y", "x"))
+    return mpx.Comm(tuple(mesh.axis_names), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# cache-key + HLO pins for the new elastic knobs
+# ---------------------------------------------------------------------------
+
+
+def test_cache_keys_byte_identical_with_new_flags_off(monkeypatch):
+    """The PR 1-8 contract for the grow/drain/fail-unit knobs: with
+    every new flag at its default the elastic token is the plain epoch
+    int, the resilience token is the exact pre-change tuple, and both
+    program-cache keys are untouched; toggling ANY new knob changes
+    them (retrace), while the lowered HLO stays byte-identical either
+    way (the knobs are host-side only)."""
+    from mpi4jax_tpu.ops._base import dynamic_cache_token
+    from mpi4jax_tpu.resilience import runtime as rt
+
+    assert el.elastic_cache_token() == 0
+    assert rt.cache_token() == (None, "", False, False, 0)
+
+    comm = _world_comm()
+
+    @mpx.spmd(comm=comm)
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM, comm=comm)
+        return res
+
+    x = jnp.ones((8, 4))
+    base_key = dynamic_cache_token()
+    base_hlo = jax.jit(f).lower(x).as_text()
+    for name, value in (
+        ("MPI4JAX_TPU_ELASTIC_GROW", "1"),
+        ("MPI4JAX_TPU_DRAIN_GRACE_S", "9"),
+        ("MPI4JAX_TPU_ELASTIC_FAIL_UNIT", "row"),
+        ("MPI4JAX_TPU_ELASTIC_PORT_SPAN", "16"),
+    ):
+        monkeypatch.setenv(name, value)
+        assert dynamic_cache_token() != base_key, name
+        assert jax.jit(f).lower(x).as_text() == base_hlo, name
+        monkeypatch.delenv(name)
+    assert dynamic_cache_token() == base_key
+
+
+# ---------------------------------------------------------------------------
+# Cartesian row/column shrink
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_world_mesh_row_and_col_units():
+    grid = mpx.make_world_mesh((2, 4), ("y", "x"))
+    devices = list(grid.devices.flat)
+    # rank 5 = (row 1, col 1): row shrink drops ranks 4..7
+    small = shrink_world_mesh(grid, {5}, "row")
+    assert tuple(small.shape.values()) == (1, 4)
+    assert small.axis_names == grid.axis_names
+    assert list(small.devices.flat) == devices[:4]
+    # col shrink drops ranks 1 and 5
+    small = shrink_world_mesh(grid, {5}, "col")
+    assert tuple(small.shape.values()) == (2, 3)
+    assert list(small.devices.flat) == [devices[i] for i in
+                                        (0, 2, 3, 4, 6, 7)]
+    # rank unit still refuses ragged grids, pointing at the units
+    with pytest.raises(ValueError, match="row"):
+        shrink_world_mesh(grid, {5}, "rank")
+    # 1-D meshes accept every unit (a row IS a rank)
+    line = mpx.make_world_mesh()
+    assert tuple(shrink_world_mesh(line, {3}, "row").shape.values()) == (7,)
+
+
+def test_comm_shrink_across_a_row_keeps_the_grid():
+    comm = _grid_comm()
+    el.advance_epoch()
+    removed = el.expand_fail_unit({5}, (2, 4), "row")
+    small_mesh = shrink_world_mesh(comm.mesh, removed, "row")
+    small = comm.shrink(removed, mesh=small_mesh)
+    assert small.Get_size() == 4
+    assert small.epoch == 1
+    out, _ = mpx.allreduce(jnp.ones((4, 2)), op=mpx.SUM, comm=small)
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+
+
+def test_elastic_run_row_failure_retraces_on_the_shrunken_grid(monkeypatch):
+    """The Cartesian acceptance: a (2, 4) tensor x data run that loses
+    rank 5 under fail_unit=row shrinks to (1, 4) — whole row removed,
+    grid rectangular, budget completed at the new size, one epoch."""
+    monkeypatch.setenv("MPI4JAX_TPU_ELASTIC_FAIL_UNIT", "row")
+    steps, fail_at = 6, 2
+    comm = _grid_comm()
+    store = mpx.ShardStore(comm)
+    losses = []
+    base = _make_step(losses)
+
+    def failing_step(state, step, comm):
+        if step == fail_at and comm.epoch == 0:
+            raise mpx.RankFailure({5}, "simulated row casualty")
+        return base(state, step, comm)
+
+    p0 = np.full((3, 1), 0.5, np.float32)
+    mpx.elastic.run(failing_step, {"p": p0}, store, steps=steps)
+
+    assert el.current_epoch() == 1
+    assert tuple(store.comm.mesh.shape.values()) == (1, 4)
+    assert store.comm.Get_size() == 4
+    post = [r for r in losses if r["world"] == 4]
+    assert sorted({r["step"] for r in post}) == list(range(fail_at, steps))
+    hist = el.epoch_history()
+    assert hist[-1]["cause"] == "failure"
+
+
+# ---------------------------------------------------------------------------
+# grow: simulated join + cold restore
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_run_grow_after_shrink_matches_clean_run():
+    """The closed loop, single-controller form of the CI grow drill:
+    8 -> (rank 3 dies) -> 7 -> (replacement admitted at a commit
+    boundary) -> 8, and from the admission step onward the losses match
+    a CLEAN 8-rank run started from the committed state — the joiner
+    received exactly the committed bytes."""
+    steps, fail_at, join_at = 10, 3, 5
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    losses = []
+    base = _make_step(losses)
+    entered = {}
+
+    def step_fn(state, step, comm):
+        if step == fail_at and comm.epoch == 0:
+            raise mpx.RankFailure({3}, "simulated")
+        if step == join_at and comm.Get_size() == 7:
+            el.post_simulated_join(1)
+        if comm.Get_size() == 8 and comm.epoch == 2 and not entered:
+            entered["state"] = {"p": np.array(state["p"])}
+            entered["step"] = step
+        return base(state, step, comm)
+
+    p0 = np.full((3, 1), 0.5, np.float32)
+    final = mpx.elastic.run(step_fn, {"p": p0}, store, steps=steps)
+
+    assert el.current_epoch() == 2
+    assert store.comm.Get_size() == 8
+    assert [h["cause"] for h in el.epoch_history()] == ["failure", "join"]
+    assert el.epoch_history()[-1]["world"] == 8
+    # the budget completed back at the full world size
+    last = [r for r in losses if r["step"] == steps - 1]
+    assert len(last) == 1 and last[0]["world"] == 8
+
+    # replay clean on a fresh epoch-0 8-device world from the state the
+    # loop re-entered with after the grow
+    el._reset_epoch_for_tests()
+    mpx.set_default_mesh(None)
+    mpx.clear_caches()
+    clean_comm = _world_comm()
+    clean_losses = []
+    clean_step = _make_step(clean_losses)
+    state = {"p": entered["state"]["p"]}
+    for s in range(entered["step"], steps):
+        state = clean_step(state, s, clean_comm)
+
+    post = {r["step"]: r["loss"] for r in losses
+            if r["world"] == 8 and r["step"] >= entered["step"]}
+    clean = {r["step"]: r["loss"] for r in clean_losses}
+    assert post.keys() == clean.keys()
+    for s in post:
+        np.testing.assert_allclose(post[s], clean[s], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(final["p"]), np.asarray(state["p"]),
+                               rtol=1e-6)
+
+
+def test_cold_join_adopted_commit_restores_bit_identical(monkeypatch):
+    """The cold-join metadata path on real jax state: describe_commit's
+    JSON round trip + adopt_commit reproduce a record through which the
+    exchanged bytes unpack to the EXACT committed state (shapes, dtypes,
+    structure) — the bit-identity the joiner depends on."""
+    import json
+
+    monkeypatch.setenv("MPI4JAX_TPU_ELASTIC_GROW", "1")
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    state = _jax_state()
+    store.commit(5, state)
+
+    desc = json.loads(json.dumps(store.describe_commit()))
+    cold = mpx.ShardStore(comm, rank=0)
+    cold.adopt_commit(desc)
+    assert cold.committed_step == 5
+
+    # the bytes the exchange would deliver: the full committed buffer
+    rec = store._committed
+    buf = np.concatenate(
+        [np.frombuffer(rec["shards"][s], np.uint8)
+         for s in range(rec["k"])])
+    crec = cold._committed
+    total = sum(m[2] for m in crec["meta"])
+    restored = el._unflatten_state(
+        crec["treedef"], el.unpack_leaves(buf[:total], crec["meta"]))
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(restored["w"]))
+    assert np.asarray(restored["w"]).dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(state["opt"][0]),
+                                  np.asarray(restored["opt"][0]))
+    assert np.asarray(restored["opt"][0]).dtype == np.float64
+    assert int(restored["opt"][1]) == 17
+
+
+def test_apply_grow_rebuilds_the_mesh_and_restore_replays():
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    state = _jax_state()
+    store.commit(4, state)
+    el.advance_epoch()
+    store.apply_shrink({6})
+    assert store.comm.Get_size() == 7
+    el.advance_epoch(world=8, cause="join")
+    store.apply_grow(1)
+    assert store.comm.Get_size() == 8
+    assert store.comm.epoch == 2
+    step, restored = store.restore()
+    assert step == 4
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(restored["w"]))
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (single-controller) + MPX127
+# ---------------------------------------------------------------------------
+
+
+def test_drain_executes_planned_shrink_with_forced_commit():
+    from mpi4jax_tpu.resilience import watchdog as wd
+
+    comm = _world_comm()
+    store = mpx.ShardStore(comm)
+    seen = []
+
+    def step_fn(state, step, comm):
+        seen.append((step, comm.Get_size()))
+        if step == 1 and comm.epoch == 0:
+            mpx.request_drain(rank=7)
+        return {"n": state["n"] + 1}
+
+    out = mpx.elastic.run(step_fn, {"n": 0}, store, steps=4,
+                          commit_every=4)
+    assert out["n"] == 4
+    # the drain boundary forced a commit OFF the commit_every cadence
+    assert seen == [(0, 8), (1, 8), (2, 7), (3, 7)]
+    assert el.current_epoch() == 1
+    assert el.epoch_history()[-1]["cause"] == "drain"
+    assert store.comm.Get_size() == 7
+    assert not store.drained                  # the controller never leaves
+    # the OLD comm is sealed: past its leave boundary now
+    assert comm.drained
+    assert not store.comm.drained
+    assert wd._registry.empty()
+
+
+def test_mpx127_flags_drained_comm_and_passes_draining():
+    comm = _world_comm()
+
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM, comm=comm)
+        return res
+
+    x = jnp.ones((8, 2))
+    report = mpx.analyze(f, x, comm=comm)
+    assert not [fd for fd in report.findings if fd.code == "MPX127"], (
+        report.render())
+
+    # scheduled but not past the boundary: still legal (that is what
+    # makes the drain graceful)
+    el.mark_comm_draining(comm, 5)
+    report = mpx.analyze(f, x, comm=comm)
+    assert not [fd for fd in report.findings if fd.code == "MPX127"], (
+        report.render())
+
+    el.seal_drained_comm(comm)
+    report = mpx.analyze(f, x, comm=comm)
+    (finding,) = [fd for fd in report.findings if fd.code == "MPX127"]
+    assert finding.severity == "error"
+    assert "leave boundary" in finding.message
+    # the epoch never advanced: MPX127 is not a duplicate of MPX126
+    assert not [fd for fd in report.findings if fd.code == "MPX126"], (
+        report.render())
+
+
+def test_mpx127_fires_through_ambient_error_mode():
+    stale = _world_comm()
+    x = jnp.ones((8, 2))
+    mpx.set_analyze_mode("error")
+    try:
+        out, _ = mpx.allreduce(x, op=mpx.SUM, comm=stale)  # clean
+        np.testing.assert_allclose(np.asarray(out), 8.0)
+        el.seal_drained_comm(stale)
+        with pytest.raises(mpx.AnalysisError, match="MPX127"):
+            mpx.allreduce(x, op=mpx.SUM, comm=stale)
+    finally:
+        mpx.set_analyze_mode(None)
+
+
+def test_telemetry_snapshot_carries_the_epoch_history():
+    mpx.set_telemetry_mode("counters")
+    try:
+        comm = _world_comm()
+        store = mpx.ShardStore(comm)
+
+        def step_fn(state, step, comm):
+            if step == 1 and comm.epoch == 0:
+                raise mpx.RankFailure({3}, "simulated")
+            return state
+
+        mpx.elastic.run(step_fn, {"x": 1}, store, steps=3)
+        snap = mpx.telemetry.snapshot()
+        (rec,) = snap["epochs"]
+        assert rec["epoch"] == 1 and rec["cause"] == "failure"
+        assert rec["world"] == 7
+    finally:
+        mpx.set_telemetry_mode(None)
+
+
 def test_claimed_watchdog_expiry_recovers_instead_of_killing():
     """End to end on one host: a watchdog expiry posted by the claimed
     handler converts into a shrink instead of a process kill (the

@@ -91,6 +91,10 @@ def _clean_state():
             "MPI4JAX_TPU_BOOTSTRAP_DEADLINE",
             "MPI4JAX_TPU_BOOTSTRAP_MAX_ATTEMPTS",
             "MPI4JAX_TPU_ELASTIC_REDUNDANCY",
+            "MPI4JAX_TPU_ELASTIC_GROW",
+            "MPI4JAX_TPU_DRAIN_GRACE_S",
+            "MPI4JAX_TPU_ELASTIC_FAIL_UNIT",
+            "MPI4JAX_TPU_ELASTIC_PORT_SPAN",
         )
     }
     yield
@@ -690,8 +694,11 @@ def test_bootstrap_flags_parse_and_validate():
 
 
 class _FakeComm:
+    _uids = iter(range(10_000, 20_000))
+
     def __init__(self, size):
         self._size = size
+        self.uid = next(self._uids)
 
     def world_size(self):
         return self._size
@@ -699,7 +706,7 @@ class _FakeComm:
 
 class _FakeStore:
     """Scripted ShardStore double: world of 4, shrink drops the failed
-    ranks, restore replays the committed (step, state)."""
+    ranks, grow appends, restore replays the committed (step, state)."""
 
     def __init__(self, world=4):
         self.redundancy = 1
@@ -708,6 +715,10 @@ class _FakeStore:
         self.commits = []
         self._committed = None
         self.shrunk_with = None
+        self.shrunk_unit = None
+        self.grown_by = 0
+        self.restores = 0
+        self.drained = False
 
     @property
     def committed_step(self):
@@ -720,11 +731,17 @@ class _FakeStore:
     def multiprocess(self):
         return False
 
-    def apply_shrink(self, failed):
+    def apply_shrink(self, failed, fail_unit="rank"):
         self.shrunk_with = frozenset(failed)
+        self.shrunk_unit = fail_unit
         self.comm = _FakeComm(self.comm.world_size() - len(self.shrunk_with))
 
-    def restore(self, failed=()):
+    def apply_grow(self, added):
+        self.grown_by += added
+        self.comm = _FakeComm(self.comm.world_size() + added)
+
+    def restore(self, failed=(), force_exchange=False):
+        self.restores += 1
         return self._committed
 
 
@@ -839,3 +856,558 @@ def test_run_validates_arguments():
         el.run(lambda s, i, c: s, 0, store, steps=-1)
     with pytest.raises(ValueError, match="commit_every"):
         el.run(lambda s, i, c: s, 0, store, steps=1, commit_every=0)
+
+
+# ---------------------------------------------------------------------------
+# port-wrap math (the declared rendezvous window)
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_port_wraps_within_the_span():
+    # identical to the unwrapped pre-span scheme for the first span epochs
+    for e in (0, 1, 63):
+        assert el.coordinator_port(5000, e, 64) == 5000 + e
+    # ...and bounded forever after
+    assert el.coordinator_port(5000, 64, 64) == 5000
+    assert el.coordinator_port(5000, 1000, 64) == 5000 + (1000 % 64)
+    ports = {el.coordinator_port(5000, e, 64) for e in range(1000)}
+    assert ports == set(range(5000, 5064))
+
+
+def test_port_banks_never_overlap():
+    """Coordinator, join, and the two control banks are disjoint for
+    every epoch — a joiner scanning the join window can never poke a
+    jax.distributed socket, and consecutive epochs' control listeners
+    never contend."""
+    span, base, world = 8, 5000, 8
+    coord = {el.coordinator_port(base, e, span) for e in range(100)}
+    join = {el.join_port(base, e, span) for e in range(100)}
+    ctrl = {el.control_port(base, r, e, span)
+            for e in range(100) for r in range(world)}
+    assert not coord & join and not coord & ctrl and not join & ctrl
+    # consecutive epochs use disjoint control banks
+    for r in range(world):
+        assert (el.control_port(base, r, 4, span)
+                != el.control_port(base, r, 5, span))
+        assert (el.control_port(base, r, 4, span)
+                == el.control_port(base, r, 6, span))
+
+
+def test_control_port_rejects_rank_outside_the_span():
+    with pytest.raises(ValueError, match="span"):
+        el.control_port(5000, 8, 0, 8)
+    with pytest.raises(ValueError, match="span"):
+        el.wrapped_epoch(3, 0)
+
+
+def test_port_span_flag_parses_and_validates():
+    assert config.elastic_port_span() == 64
+    os.environ["MPI4JAX_TPU_ELASTIC_PORT_SPAN"] = "16"
+    assert config.elastic_port_span() == 16
+    assert el.coordinator_port(5000, 20) == 5000 + 4   # flag-driven wrap
+    os.environ["MPI4JAX_TPU_ELASTIC_PORT_SPAN"] = "0"
+    with pytest.raises(ValueError, match="ELASTIC_PORT_SPAN"):
+        config.elastic_port_span()
+
+
+# ---------------------------------------------------------------------------
+# fail-unit expansion + the 2-D renumbering
+# ---------------------------------------------------------------------------
+
+
+def test_expand_fail_unit_rows_and_cols():
+    # 2x4 grid, row-major: rank 5 = (row 1, col 1)
+    assert el.expand_fail_unit({5}, (2, 4), "row") == frozenset({4, 5, 6, 7})
+    assert el.expand_fail_unit({5}, (2, 4), "col") == frozenset({1, 5})
+    # two failures in one row expand to that one row
+    assert el.expand_fail_unit({4, 6}, (2, 4), "row") == frozenset(
+        {4, 5, 6, 7})
+    # failures in different rows take both rows
+    assert el.expand_fail_unit({0, 5}, (2, 4), "row") == frozenset(range(8))
+    # rank unit is the identity; 1-D degrades every unit to rank
+    assert el.expand_fail_unit({5}, (2, 4), "rank") == frozenset({5})
+    assert el.expand_fail_unit({5}, (8,), "row") == frozenset({5})
+    assert el.expand_fail_unit((), (2, 4), "row") == frozenset()
+    with pytest.raises(ValueError, match="out of range"):
+        el.expand_fail_unit({8}, (2, 4), "row")
+    with pytest.raises(ValueError, match="fail_unit"):
+        el.expand_fail_unit({1}, (2, 4), "diagonal")
+    with pytest.raises(ValueError, match="2-D"):
+        el.expand_fail_unit({1}, (2, 2, 2), "row")
+
+
+def test_shrunken_shape_drops_whole_lines():
+    row_dead = el.expand_fail_unit({5}, (2, 4), "row")
+    assert el.shrunken_shape((2, 4), row_dead, "row") == (1, 4)
+    col_dead = el.expand_fail_unit({5}, (2, 4), "col")
+    assert el.shrunken_shape((2, 4), col_dead, "col") == (2, 3)
+    assert el.shrunken_shape((8,), {3}, "rank") == (7,)
+    two_cols = el.expand_fail_unit({0, 7}, (2, 4), "col")  # cols 0 and 3
+    assert el.shrunken_shape((2, 4), two_cols, "col") == (2, 2)
+
+
+@pytest.mark.parametrize("shape,failed,unit", [
+    ((2, 4), {5}, "row"),
+    ((2, 4), {5}, "col"),
+    ((4, 2), {0}, "row"),
+    ((3, 3), {4}, "col"),
+    ((2, 4), {1, 6}, "col"),
+])
+def test_compact_rank_map_is_the_2d_row_major_renumbering(
+        shape, failed, unit):
+    """Dropping whole grid lines keeps the survivors' row-major order =
+    the shrunken grid's row-major numbering: compact_rank_map over the
+    expanded set IS the 2-D renumbering, with no special casing."""
+    rows, cols = shape
+    world = rows * cols
+    dead = el.expand_fail_unit(failed, shape, unit)
+    rmap = el.compact_rank_map(world, dead)
+    new_shape = el.shrunken_shape(shape, dead, unit)
+    # enumerate the shrunken grid row-major and check each survivor maps
+    # to its position in it
+    dead_rows = {r // cols for r in dead} if unit == "row" else set()
+    dead_cols = {r % cols for r in dead} if unit == "col" else set()
+    expect = {}
+    new = 0
+    for i in range(rows):
+        if i in dead_rows:
+            continue
+        for j in range(cols):
+            if j in dead_cols:
+                continue
+            expect[i * cols + j] = new
+            new += 1
+    assert rmap == expect
+    assert len(rmap) == new_shape[0] * new_shape[1]
+
+
+def test_shrink_groups_on_an_expanded_row():
+    # column sub-comms of a 2x4 grid: group g = {g, g+4}
+    groups = tuple((j, j + 4) for j in range(4))
+    dead = el.expand_fail_unit({5}, (2, 4), "row")       # row 1 gone
+    # every column group loses its row-1 member; survivors renumber 0..3
+    assert el.shrink_groups(groups, dead, 8) == ((0,), (1,), (2,), (3,))
+    # row sub-comms: group 1 disappears wholesale
+    rows = ((0, 1, 2, 3), (4, 5, 6, 7))
+    assert el.shrink_groups(rows, dead, 8) == ((0, 1, 2, 3),)
+
+
+# ---------------------------------------------------------------------------
+# epoch history + cache-token pins
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_history_records_world_deltas():
+    assert el.epoch_history() == []
+    el.advance_epoch(world=7, cause="failure", detail="rank 3 died")
+    el.advance_epoch(world=8, cause="join", detail="1 replacement")
+    hist = el.epoch_history()
+    assert [h["epoch"] for h in hist] == [1, 2]
+    assert [h["cause"] for h in hist] == ["failure", "join"]
+    assert [h["world"] for h in hist] == [7, 8]
+    el._reset_epoch_for_tests()
+    assert el.epoch_history() == []
+
+
+def test_set_epoch_adopts_forward_only():
+    el._set_epoch(3)
+    assert el.current_epoch() == 3
+    assert el.epoch_history()[-1]["cause"] == "adopt"
+    el._set_epoch(3)                          # idempotent
+    assert el.current_epoch() == 3
+    with pytest.raises(ValueError, match="backwards"):
+        el._set_epoch(1)
+
+
+def test_cache_token_is_the_pre_change_literal_with_flags_off():
+    """The PR 1-8 contract, pinned byte-for-byte: with every elastic
+    knob at its default the elastic token is the plain epoch int and the
+    resilience cache token is EXACTLY the tuple previous releases
+    produced — both program-cache keys are unchanged."""
+    assert el.elastic_cache_token() == 0
+    assert rt.cache_token() == (None, "", False, False, 0)
+    el.advance_epoch()
+    assert el.elastic_cache_token() == 1
+    assert rt.cache_token() == (None, "", False, False, 1)
+
+
+def test_elastic_cache_token_folds_every_new_knob():
+    base = el.elastic_cache_token()
+    for name, value in (
+        ("MPI4JAX_TPU_ELASTIC_GROW", "1"),
+        ("MPI4JAX_TPU_DRAIN_GRACE_S", "9.5"),
+        ("MPI4JAX_TPU_ELASTIC_FAIL_UNIT", "row"),
+        ("MPI4JAX_TPU_ELASTIC_PORT_SPAN", "16"),
+    ):
+        os.environ[name] = value
+        tok = el.elastic_cache_token()
+        assert tok != base, name
+        assert isinstance(tok, tuple) and tok[0] == el.current_epoch()
+        del os.environ[name]
+    assert el.elastic_cache_token() == base
+
+
+def test_new_elastic_flags_parse_and_validate():
+    assert config.elastic_grow() is False
+    assert config.drain_grace_s() == 5.0
+    assert config.elastic_fail_unit() == "rank"
+    os.environ["MPI4JAX_TPU_ELASTIC_GROW"] = "yes"
+    os.environ["MPI4JAX_TPU_DRAIN_GRACE_S"] = "2.5"
+    os.environ["MPI4JAX_TPU_ELASTIC_FAIL_UNIT"] = "col"
+    assert config.elastic_grow() is True
+    assert config.drain_grace_s() == 2.5
+    assert config.elastic_fail_unit() == "col"
+    os.environ["MPI4JAX_TPU_DRAIN_GRACE_S"] = "0"
+    with pytest.raises(ValueError, match="DRAIN_GRACE_S"):
+        config.drain_grace_s()
+    os.environ["MPI4JAX_TPU_ELASTIC_FAIL_UNIT"] = "diagonal"
+    with pytest.raises(ValueError, match="FAIL_UNIT"):
+        config.elastic_fail_unit()
+
+
+# ---------------------------------------------------------------------------
+# the preempt fault verb
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_spec_parses_and_round_trips():
+    (c,) = fi.parse_fault_spec("preempt:rank=3:after=4:grace=2")
+    assert (c.verb, c.rank, c.after, c.grace) == ("preempt", 3, 4, 2.0)
+    canon = fi.canonical_spec((c,))
+    assert canon == "preempt:rank=3:after=4:grace=2"
+    assert fi.parse_fault_spec(canon) == (c,)
+    # bare preempt: every rank, immediately, flag-default grace
+    (c,) = fi.parse_fault_spec("preempt")
+    assert (c.rank, c.op, c.after, c.grace) == (None, None, 0, None)
+    assert c.canonical() == "preempt"
+
+
+def test_preempt_spec_rejects_misplaced_args():
+    with pytest.raises(ValueError, match="grace"):
+        fi.parse_fault_spec("die:grace=2")
+    with pytest.raises(ValueError, match="secs"):
+        fi.parse_fault_spec("preempt:secs=2")
+    with pytest.raises(ValueError, match="bare field"):
+        fi.parse_fault_spec("preempt:nan")
+    with pytest.raises(ValueError, match="grace must be > 0"):
+        fi.parse_fault_spec("preempt:grace=0")
+
+
+def test_preempt_probe_posts_a_drain_notice():
+    (c,) = fi.parse_fault_spec("preempt:rank=1:after=1:grace=3")
+    indexed = ((0, c),)
+    assert fi.probe_host(indexed, "MPI_Allreduce", 0) == 0   # wrong rank
+    assert el.take_pending_drain() is None
+    assert fi.probe_host(indexed, "MPI_Allreduce", 1) == 0   # clean window
+    assert el.take_pending_drain() is None
+    assert fi.probe_host(indexed, "MPI_Allreduce", 1) == 0   # fires
+    drain = el.take_pending_drain()
+    assert drain == {"rank": 1, "grace": 3.0}
+    # the collective itself proceeds (no mask bit, process alive) and a
+    # second notice while one is pending does not duplicate
+    fi.probe_host(indexed, "MPI_Allreduce", 1)
+    el.request_drain(rank=2)
+    fi.probe_host(indexed, "MPI_Allreduce", 1)
+    assert el.take_pending_drain()["rank"] == 1
+    assert el.take_pending_drain() is None
+
+
+# ---------------------------------------------------------------------------
+# drain scheduling (commit-before-leave) + join admission ordering
+# ---------------------------------------------------------------------------
+
+
+def test_drain_forces_commit_at_the_next_boundary_then_shrinks():
+    """The commit-before-leave invariant: a drain requested mid-interval
+    forces an EARLY commit at the next step boundary (off the
+    commit_every cadence), then executes the planned shrink — one epoch,
+    no restore (survivor state is live), loop continues to the budget."""
+    store = _FakeStore()
+    seen = []
+
+    def step_fn(state, step, comm):
+        seen.append((step, comm.world_size()))
+        if step == 2 and store.shrunk_with is None:
+            el.request_drain(rank=3)
+        return state + 1
+
+    out = el.run(step_fn, 0, store, steps=6, commit_every=2)
+    assert out == 6
+    # commit at 0 and 2 (cadence), then the FORCED commit at 3 (the
+    # drain boundary), then the cadence again on the shrunken world
+    assert store.commits == [0, 2, 3, 4, 6]
+    assert store.shrunk_with == frozenset({3})
+    assert store.shrunk_unit == "rank"
+    assert store.restores == 0                  # drains never restore
+    assert el.current_epoch() == 1
+    assert el.epoch_history()[-1]["cause"] == "drain"
+    # no step was replayed: the drain is planned, not a failure — the
+    # world shrinks at the boundary right after the notice landed
+    assert seen == [(0, 4), (1, 4), (2, 4),
+                    (3, 3), (4, 3), (5, 3)]
+    assert el.take_peer_drain() is None
+
+
+def test_drain_without_a_rank_needs_a_multiprocess_world():
+    store = _FakeStore()
+
+    def step_fn(state, step, comm):
+        el.request_drain()
+        return state
+
+    with pytest.raises(RuntimeError, match="multi-process"):
+        el.run(step_fn, 0, store, steps=2)
+
+
+def test_join_admitted_at_the_next_commit_boundary_only():
+    """Admission ordering: joiners posted mid-interval wait for the next
+    COMMIT boundary (the state streamed to them is the committed one),
+    then the world grows by the full pending count at once."""
+    store = _FakeStore()
+    seen = []
+
+    def step_fn(state, step, comm):
+        seen.append((step, comm.world_size()))
+        if step == 0:
+            el.post_simulated_join(2)
+        return state + 1
+
+    out = el.run(step_fn, 0, store, steps=6, commit_every=3)
+    assert out == 6
+    # posted during step 0, but steps 1 and 2 still run at world 4 —
+    # admission waits for the step-3 commit boundary
+    assert seen == [(0, 4), (1, 4), (2, 4),
+                    (3, 6), (4, 6), (5, 6)]
+    assert store.grown_by == 2
+    assert store.restores == 1                 # the cold-join restore
+    assert el.current_epoch() == 1
+    assert el.epoch_history()[-1]["cause"] == "join"
+    assert el.pending_join_count() == 0
+
+
+def test_join_and_drain_at_one_boundary_drain_wins():
+    """A drain scheduled for a boundary takes priority; the join is
+    admitted at the following commit boundary."""
+    store = _FakeStore()
+
+    def step_fn(state, step, comm):
+        if step == 0:
+            el.post_simulated_join(1)
+            el.request_drain(rank=3)
+        return state + 1
+
+    out = el.run(step_fn, 0, store, steps=4, commit_every=1)
+    assert out == 4
+    assert store.shrunk_with == frozenset({3})
+    assert store.grown_by == 1
+    assert el.current_epoch() == 2             # drain epoch, then join epoch
+    assert [h["cause"] for h in el.epoch_history()] == ["drain", "join"]
+
+
+# ---------------------------------------------------------------------------
+# cold-join: describe/adopt + the zero-contribution exchange
+# ---------------------------------------------------------------------------
+
+
+class _SizedComm:
+    uid = 4242
+
+    def __init__(self, k):
+        self._k = k
+
+    def world_size(self):
+        return self._k
+
+
+def test_describe_adopt_commit_round_trips_through_json():
+    import json as _json
+
+    os.environ["MPI4JAX_TPU_ELASTIC_GROW"] = "1"   # spec computed on commit
+    state = _state()
+    store = el.ShardStore(_SizedComm(4), redundancy=1, rank=0)
+    store.commit(7, state)
+    assert store.can_describe_commit()
+    desc = _json.loads(_json.dumps(store.describe_commit()))
+    assert desc["step"] == 7 and desc["k"] == 4
+    assert "shards" not in desc                # geometry only, no payload
+    cold = el.ShardStore(_SizedComm(4), redundancy=1, rank=4)
+    cold.adopt_commit(desc)
+    assert cold.committed_step == 7
+    rec = cold._committed
+    assert rec["shards"] == {} and rec["cold"] is True
+    assert rec["meta"] == store._committed["meta"]
+
+
+def test_cold_join_exchange_reassembles_bit_identical():
+    """The cold-join branch of the restore exchange, simulated purely:
+    every old rank contributes the shards the plan makes it provider of,
+    the cold joiner contributes ZEROS, and the SUM reassembles the
+    committed state bit-identically on every rank (one contributor per
+    shard, so sum is placement)."""
+    os.environ["MPI4JAX_TPU_ELASTIC_GROW"] = "1"
+    state = _state()
+    k = 4
+    stores = {r: el.ShardStore(_SizedComm(k), redundancy=1, rank=r)
+              for r in range(k)}
+    for s in stores.values():
+        s.commit(7, state)
+    desc = stores[0].describe_commit()
+    cold = el.ShardStore(_SizedComm(k + 1), redundancy=1, rank=k)
+    cold.adopt_commit(desc)
+
+    plan = el.reconstruction_plan((), k, 1)
+    contribs = [s.exchange_contribution(s._committed, plan)
+                for s in stores.values()]
+    cold_contrib = cold.exchange_contribution(cold._committed, plan)
+    assert not cold_contrib.any()              # the joiner supplies zeros
+    total = sum(c.astype(np.int64) for c in contribs) + cold_contrib
+    assert total.max() <= 255                  # one contributor per shard
+    buf = total.astype(np.uint8)
+    rec = cold._committed
+    nbytes = sum(m[2] for m in rec["meta"])
+    restored = el._unflatten_state(
+        rec["treedef"], el.unpack_leaves(buf[:nbytes], rec["meta"]))
+    _assert_state_equal(state, restored)
+
+
+def test_describe_commit_refuses_undescribable_structures():
+    # with grow off the spec is never computed (hot-path cost gating);
+    # with a custom pytree node it validates to None — either way the
+    # description refuses loudly and can_describe_commit gates admission
+    store = el.ShardStore(_SizedComm(2), redundancy=1, rank=0)
+    store.commit(1, _state())
+    assert store._committed["pure_spec"] is None   # grow off: not computed
+    assert not store.can_describe_commit()
+    with pytest.raises(RuntimeError, match="not.*JSON-able"):
+        store.describe_commit()
+
+
+def test_restore_skips_feasibility_check_when_all_shards_are_local():
+    """A single-controller store (holding every shard) restores locally
+    even when a whole contiguous replica block died — the row-shrink
+    case that would falsely trip the neighbor-replication budget."""
+    state = _state()
+
+    class _All:
+        uid = 9
+        mesh = None
+
+        def world_size(self):
+            return 8
+
+    store = el.ShardStore(_All(), redundancy=1)   # no rank pin: holds all
+    store.commit(3, state)
+    assert store.held_shards() == tuple(range(8))
+    step, restored = store.restore({4, 5, 6, 7})  # adjacent block dead
+    assert step == 3
+    _assert_state_equal(state, restored)
+
+
+# ---------------------------------------------------------------------------
+# drained-comm registry (MPX127's ground truth)
+# ---------------------------------------------------------------------------
+
+
+def test_drained_comm_registry_transitions():
+    comm = _FakeComm(4)
+    assert el.comm_draining(comm) is None
+    assert not el.comm_drained(comm)
+    el.mark_comm_draining(comm, 7)
+    assert el.comm_draining(comm) == 7
+    assert not el.comm_drained(comm)           # legal through the boundary
+    el.seal_drained_comm(comm)
+    assert el.comm_drained(comm)               # MPX127 territory
+    assert el.comm_draining(comm) is None
+    el._reset_epoch_for_tests()
+    assert not el.comm_drained(comm)
+
+
+# ---------------------------------------------------------------------------
+# the control/join TCP protocol on localhost
+# ---------------------------------------------------------------------------
+
+
+def _free_port_base():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_control_server_acks_drain_notices():
+    """The planned-drain announcement: notify_drain reaches every peer's
+    control listener, each acks immediately (the leaver's proof nobody
+    can race past the boundary), and the notice lands in the peer-drain
+    slot the run loop consumes."""
+    os.environ["MPI4JAX_TPU_ELASTIC_PORT_SPAN"] = "8"
+    base = _free_port_base()
+    servers = [el._ControlServer("localhost", el.control_port(base, r, 0))
+               for r in (1, 2)]
+    try:
+        unacked = el.notify_drain("localhost", base, 0, 3, boundary=7,
+                                  epoch=0, grace=10.0)
+        assert unacked == []
+        assert el.peek_peer_drain() == {"rank": 0, "boundary": 7}
+    finally:
+        for srv in servers:
+            srv.stop()
+    # a dead peer never acks: it is reported, the drain proceeds anyway
+    # (epoch 1's control bank was never bound — nobody listens there)
+    el.take_peer_drain()
+    assert el.notify_drain("localhost", base, 0, 2, boundary=3,
+                           epoch=1, grace=0.5) == [1]
+
+
+def test_join_server_parks_request_and_admit_round_trips():
+    """The join handshake end to end on localhost: request_join scans
+    the declared port window for the live epoch's listener (it does not
+    know the epoch), parks on the connection, and receives the admit
+    message the coordinator sends at the boundary."""
+    os.environ["MPI4JAX_TPU_ELASTIC_PORT_SPAN"] = "8"
+    base = _free_port_base()
+    srv = el._JoinServer("localhost", el.join_port(base, 3))  # epoch 3
+    result = {}
+
+    def joiner():
+        result["admit"] = el.request_join("localhost", base, timeout=20.0)
+
+    t = threading.Thread(target=joiner, daemon=True)
+    try:
+        t.start()
+        deadline = time.monotonic() + 15.0
+        while el.pending_join_count() == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        (parked,) = el._take_pending_joins()
+        assert parked["info"]["kind"] == "join"
+        admit = {"kind": "admit", "epoch": 4, "process_id": 3,
+                 "num_processes": 4, "step": 6,
+                 "commit": {"k": 3}, "axes": ["i"]}
+        el._send_json(parked["conn"], admit)
+        parked["conn"].close()
+        t.join(timeout=20.0)
+        assert result["admit"]["process_id"] == 3
+        assert result["admit"]["num_processes"] == 4
+        assert result["admit"]["commit"] == {"k": 3}
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# watchdog expiry suspension (planned-reconfiguration windows)
+# ---------------------------------------------------------------------------
+
+
+def test_suspend_expiries_masks_detection_and_nests():
+    now = [100.0]
+    reg = wd._Registry(on_timeout=lambda e, x: None, clock=lambda: now[0])
+    reg.arm("MPI_Allreduce", "ffff0001", 0, "('i',)", timeout=1.0)
+    now[0] += 5.0
+    assert reg.check_expired() is not None
+    with wd.suspend_expiries():
+        assert reg.check_expired() is None
+        with wd.suspend_expiries():            # windows nest
+            assert reg.check_expired() is None
+        assert reg.check_expired() is None     # still inside the outer
+    assert reg.check_expired() is not None     # coverage resumes
+    assert reg.drain() == 1

@@ -671,3 +671,48 @@ def test_algo_cache_token_reflects_topology_knobs():
     del os.environ["MPI4JAX_TPU_TOPOLOGY"]
     del os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"]
     assert al.algo_cache_token() == base
+
+
+# ---------------------------------------------------------------------------
+# elastic row/column shrink: hierarchical == flat on the shrunken grid
+# ---------------------------------------------------------------------------
+
+
+def test_hier_flat_equality_on_the_shrunken_grid():
+    """The elastic Cartesian shrink (resilience/elastic.py fail_unit)
+    removes whole grid rows/columns; the renumbered world must keep the
+    hierarchical == flat fold equality — the lockstep pin for the comms
+    a row-shrunken training run retraces with."""
+    el = importlib.import_module(f"{_ISO_NAME}.resilience.elastic")
+    cases = [
+        ((2, 4), {5}, "row", (1, 4)),   # (2,4) -> (1,4): 4 ranks
+        ((2, 4), {5}, "col", (2, 3)),   # (2,4) -> (2,3): 6 ranks
+        ((4, 2), {3}, "row", (3, 2)),   # (4,2) -> (3,2): 6 ranks
+    ]
+    for shape, failed, unit, expect_shape in cases:
+        dead = el.expand_fail_unit(failed, shape, unit)
+        new_shape = el.shrunken_shape(shape, dead, unit)
+        assert new_shape == expect_shape, (shape, failed, unit)
+        h, r = new_shape
+        k = h * r
+        rmap = el.compact_rank_map(shape[0] * shape[1], dead)
+        assert sorted(rmap.values()) == list(range(k))
+        # string fold: the two-level fold over the shrunken world's
+        # host blocks is EXACTLY the flat ascending fold (only
+        # associativity, observable operand order)
+        xs = [[f"({g}:{c})" for c in range(r)] for g in range(k)]
+        fn = lambda a, b: a + b  # noqa: E731
+        out = sim_hier_allreduce(xs, fn, h, r, preserve=True)
+        expected = flat_fold(xs, fn, k, r)
+        for g in range(k):
+            assert out[g] == expected, (shape, unit, g)
+        # exact-arithmetic numpy fold: bit-for-bit equality
+        rng = np.random.default_rng(100 + k)
+        data = rng.integers(-100, 100, size=(k, r, 3)).astype(np.float64)
+        xs = [[data[g, c] for c in range(r)] for g in range(k)]
+        out = sim_hier_allreduce(xs, np.add, h, r, preserve=False)
+        expected = flat_fold(xs, np.add, k, r)
+        for g in range(k):
+            for c in range(r):
+                assert np.array_equal(np.asarray(out[g][c]),
+                                      np.asarray(expected[c])), (shape, g, c)

@@ -128,12 +128,16 @@ def _clean_resilience_state():
         "die:rank=0:op=barrier:after=1",
         "hang:rank=3:op=allreduce:after=5",
         "hang",
+        "preempt:rank=3:after=4:grace=2",
+        "preempt:rank=3:op=allreduce:after=4",
+        "preempt",
         "corrupt:nan:rank=2:op=allreduce",
         "corrupt:inf:op=bcast",
         "delay:secs=0.5",
         "die",
         "delay:rank=1:op=allreduce:after=3:secs=2;"
         "die:rank=0:op=barrier:after=1;hang:rank=3:op=allreduce;"
+        "preempt:rank=2:after=1:grace=5;"
         "corrupt:nan:rank=2:op=allreduce",
     ],
 )
@@ -172,6 +176,10 @@ def test_fault_spec_field_semantics():
         "die:secs=2",                  # secs on a non-delay verb
         "hang:secs=2",                 # hang is forever; secs is delay-only
         "hang:nan",                    # bare mode on a non-corrupt verb
+        "die:grace=2",                 # grace is preempt-only
+        "preempt:secs=2",              # a notice does not sleep
+        "preempt:grace=0",             # grace must be positive
+        "preempt:nan",                 # bare mode on a non-corrupt verb
         "delay:rank=1:rank=2",         # duplicate key
         "delay:after=-1",              # negative after
         "delay:secs=-0.5",             # negative secs
