@@ -34,15 +34,25 @@ def main():
     # frame wide-halo kernel (model_step_pallas_wide: widen once, 4
     # margin-band messages per pair of steps), falling back to the
     # split-phase kernels (model_step_pallas_halo) only below its
-    # 16-cell minimum local interior
-    wall, n_steps = solve_fused(cfg, t1, devices=devices, fast="auto")
+    # 16-cell minimum local interior.
+    # pinned=True: the timed calls execute an mpx.compile-pinned
+    # artifact (docs/aot.md) — zero per-call key work, which is what
+    # closes the dispatch_overhead_s gap BENCH_r05 measured at 0.063 s;
+    # solve_fused falls back to the spmd program if pinning is
+    # unavailable, and the "pinned" field below records which ran.
+    import mpi4jax_tpu as mpx
+
+    wall, n_steps = solve_fused(cfg, t1, devices=devices, fast="auto",
+                                pinned=True)
 
     # second, 5x-longer run: the slope between the two cancels the fixed
     # per-dispatch overhead (on a remote-attached chip the round-trip can
     # reach ~0.1 s, a fifth of the short run's wall), giving the true
     # on-chip per-step time — see docs/shallow_water.md "Roofline"
-    wall5, n_steps5 = solve_fused(cfg, 5 * t1, devices=devices, fast="auto")
+    wall5, n_steps5 = solve_fused(cfg, 5 * t1, devices=devices,
+                                  fast="auto", pinned=True)
     per_step = (wall5 - wall) / (n_steps5 - n_steps)
+    aot_stats = mpx.cache_stats()["aot"]
 
     steps_per_sec_per_chip = n_steps / wall / len(devices)
     ref_gpu_wall = 6.28  # Tesla P100, 1 process (BASELINE.md)
@@ -62,6 +72,12 @@ def main():
                 "vs_baseline": round(ref_gpu_wall / wall, 3),
                 "state_traffic_gb_per_s": round(gbps, 1),
                 "wall_s": round(wall, 3),
+                # did the timed loops run the AOT-pinned artifact?
+                # Each successful solve_fused pins exactly once, so
+                # BOTH runs pinned iff pins >= 2 — a first-run pin with
+                # a second-run fallback must not claim a pinned number
+                "pinned": aot_stats["pins"] >= 2,
+                "pinned_calls": aot_stats["calls"],
                 **(
                     {
                         "onchip_steps_per_s_per_chip": round(

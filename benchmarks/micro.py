@@ -340,6 +340,66 @@ def bench_overlap(comm, sizes_mb=(1, 4), iters=10, compute_dim=128):
     return rows
 
 
+def bench_dispatch(comm, sizes_kb=(0.004, 4, 64), iters=100):
+    """The dispatch sweep (``--dispatch-sweep``): per-CALL overhead of
+    the three execution surfaces for the SAME one-allreduce program —
+
+    - **eager**: ``mpx.allreduce`` outside any region (the one-op
+      compiled-program cache; per call: flag-stamp check + interned key
+      probe + cached jit call);
+    - **spmd**: an ``mpx.spmd``-decorated program (per call: statics
+      normalization, program-cache key build + probe, then the jit
+      call);
+    - **pinned**: ``mpx.compile`` (per call: one stamp validation, then
+      the compiled executable — no key work at all; docs/aot.md).
+
+    At the smallest payload the device op is noise and the numbers are
+    pure host dispatch — the gap ``mpx.compile`` exists to close.  Each
+    loop is timed whole (N calls then one sync), so per-call numbers
+    amortize the device queue the way a real hot loop does.
+    """
+    n = comm.Get_size()
+    rows = []
+    for kb in sizes_kb:
+        nelem = max(1, int(kb * 1e3 / 4))
+        x = jnp.ones((n, nelem), jnp.float32)
+
+        def eager_call(v):
+            return mpx.allreduce(v, op=mpx.SUM)[0]
+
+        @mpx.spmd(comm=comm)
+        def prog(v):
+            return mpx.varying(mpx.allreduce(v, op=mpx.SUM)[0])
+
+        def per_rank(v):
+            return mpx.varying(mpx.allreduce(v, op=mpx.SUM)[0])
+
+        pinned = mpx.compile(per_rank, x, comm=comm)
+
+        def time_per_call(fn):
+            fn(x)
+            jax.block_until_ready(fn(x))  # compile + drain
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = fn(x)
+                jax.block_until_ready(out)
+                best = min(best, (time.perf_counter() - t0) / iters)
+            return best
+
+        rows.append({
+            "size_kb": round(nelem * 4 / 1e3, 3),
+            "eager_us": round(time_per_call(eager_call) * 1e6, 2),
+            "spmd_us": round(time_per_call(prog) * 1e6, 2),
+            "pinned_us": round(time_per_call(pinned) * 1e6, 2),
+        })
+        rows[-1]["pinned_vs_spmd"] = round(
+            rows[-1]["spmd_us"] / rows[-1]["pinned_us"], 2
+        ) if rows[-1]["pinned_us"] else None
+    return rows
+
+
 def save_results(payload, outdir=None):
     """Write one sweep payload to ``benchmarks/results/`` (the ``--save``
     flag): ``micro_{platform}_{n}dev_{YYYYMMDD}.json``, returning the path
@@ -405,6 +465,16 @@ def main():
     p.add_argument("--hierarchy-sizes-mb", type=float, nargs="+",
                    default=[1, 4],
                    help="payload sizes for --hierarchy-sweep (MB)")
+    p.add_argument("--dispatch-sweep", action="store_true",
+                   help="also run the dispatch sweep (per-call overhead "
+                        "of eager vs spmd vs mpx.compile-pinned for the "
+                        "same one-allreduce program across payload "
+                        "sizes; docs/aot.md)")
+    p.add_argument("--dispatch-sizes-kb", type=float, nargs="+",
+                   default=[0.004, 4, 64],
+                   help="payload sizes for --dispatch-sweep (KiB)")
+    p.add_argument("--dispatch-iters", type=int, default=100,
+                   help="calls per timed loop for --dispatch-sweep")
     args = p.parse_args()
 
     devices = jax.devices()
@@ -458,6 +528,9 @@ def main():
                    tuple(args.hierarchy_sizes_mb),
                    tuple(args.hierarchy_topologies))
           if args.hierarchy_sweep else None)
+    ds = (_section("dispatch", bench_dispatch, comm,
+                   tuple(args.dispatch_sizes_kb), args.dispatch_iters)
+          if args.dispatch_sweep else None)
 
     payload = {
         "platform": devices[0].platform,
@@ -484,6 +557,15 @@ def main():
     if hs is not None:
         payload["hierarchy"] = hs
         payload["hierarchy_topologies"] = list(args.hierarchy_topologies)
+    if ds is not None:
+        payload["dispatch"] = ds
+        # the AOT/persistent-cache counters are the sweep's provenance:
+        # whether the pinned column was served from disk or compiled
+        # (one cache_stats() call — it walks the disk tier when enabled)
+        cstats = mpx.cache_stats()
+        payload["dispatch_cache_stats"] = {
+            k: cstats[k] for k in ("aot", "disk_cache")
+        }
     if args.telemetry:
         payload["telemetry"] = telemetry_sections
         mpx.set_telemetry_mode(None)
@@ -536,6 +618,15 @@ def main():
                   if r["hier_speedup"] is not None else "n/a (1 device)")
             print(f"  {r['size_mb']:>10.3f} MB   {r['topology']:>8}"
                   f"   {r['flat_us']:>8.1f} us   {r['hier_us']:>8.1f} us"
+                  f"   {sp}")
+    if ds is not None:
+        print("\ndispatch sweep (SUM, f32)     eager        spmd"
+              "         pinned       pinned vs spmd")
+        for r in ds:
+            sp = (f"{r['pinned_vs_spmd']:>6.2f}x"
+                  if r["pinned_vs_spmd"] is not None else "-")
+            print(f"  {r['size_kb']:>10.3f} KB   {r['eager_us']:>8.2f} us"
+                  f"   {r['spmd_us']:>8.2f} us   {r['pinned_us']:>8.2f} us"
                   f"   {sp}")
 
 

@@ -92,6 +92,12 @@ from .analysis import (  # noqa: F401
     analyze,
     set_analyze_mode,
 )
+from . import aot  # noqa: F401
+from .aot import (  # noqa: F401
+    PinnedProgram,
+    StaleProgramError,
+    compile,
+)
 from . import telemetry  # noqa: F401
 from .telemetry import set_telemetry_mode  # noqa: F401
 from .utils.profiling import ProfileSummary, profile_ops  # noqa: F401
@@ -168,6 +174,11 @@ __all__ = [
     "AsyncHandle",
     "overlap",
     "set_fusion_mode",
+    # AOT pinning + persistent compile cache (docs/aot.md)
+    "aot",
+    "compile",
+    "PinnedProgram",
+    "StaleProgramError",
     # runtime telemetry (docs/observability.md)
     "telemetry",
     "set_telemetry_mode",

@@ -427,6 +427,66 @@ def check_drained_comm(graph: CollectiveGraph) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# AOT pinning advisory (MPX128)
+# ---------------------------------------------------------------------------
+
+# repeats of one collective signature inside a single trace before the
+# advisory fires: below this, dispatch cost is noise; at or above it the
+# trace is almost certainly a Python-level hot loop (fori_loop bodies
+# trace ONCE, so they never trip this)
+AOT_ADVISORY_MIN_REPEATS = 8
+
+
+@checker("MPX128")
+def check_unpinned_hot_loop(graph: CollectiveGraph) -> List[Finding]:
+    """A single trace re-dispatching the same (op, comm, statics) prefix
+    ``AOT_ADVISORY_MIN_REPEATS``-or-more times: a Python loop unrolled
+    into the program — every iteration pays the full dispatch fast path
+    at trace time and grows the program linearly — where ``mpx.compile``
+    would pin the whole thing once (docs/aot.md).
+
+    Gated on the config snapshot EXPLICITLY recording ``pinned: False``
+    (every real trace does, via ``hook.config_snapshot``; a trace that
+    is being pinned right now records True): hand-built graphs without
+    pinning meta are testing other rules.  Eager events never count —
+    each eager op is its own one-op program, not an unrolled loop.
+    """
+    if graph.meta.get("pinned") is not False:
+        return []
+    counts: dict = {}
+    for e in graph.events:
+        if e.eager:
+            continue
+        # point-to-point loops (one send/recv per neighbor) and async
+        # spans are STRUCTURE — same-signature repeats there route to
+        # different peers, not a hot loop; only whole-group collectives
+        # count
+        if e.op in ("send", "recv", "sendrecv") or e.span is not None:
+            continue
+        sig = (e.op, e.comm_uid, e.reduction, e.root, e.tag, e.dtype,
+               e.shape)
+        counts.setdefault(sig, []).append(e)
+    findings: List[Finding] = []
+    for sig, events in counts.items():
+        if len(events) < AOT_ADVISORY_MIN_REPEATS:
+            continue
+        first = events[0]
+        findings.append(Finding(
+            code="MPX128", op=first.op, index=first.index,
+            message=(f"{len(events)} dispatches of the same {first.op} "
+                     f"signature on comm {first.comm_uid} in one trace "
+                     f"(events {first.index}..{events[-1].index}): a "
+                     "Python-level hot loop unrolled into the program"),
+            suggestion=("pin the program once with mpx.compile(fn, "
+                        "*abstract_args, comm=...) and call the pinned "
+                        "executable in the loop (or move the loop into "
+                        "jax.lax.fori_loop so it traces once) — "
+                        "docs/aot.md"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # topology advisory (MPX113)
 # ---------------------------------------------------------------------------
 

@@ -83,6 +83,16 @@ def config_snapshot() -> dict:
     from ..ops._fusion import effective_mode as fusion_mode
     from ..resilience.elastic import current_epoch
 
+    # is this trace being pinned by mpx.compile right now?  Gates the
+    # MPX128 advisory: a program under the pinner must not be advised to
+    # pin itself.  Guarded — the aot package needs jax, and hand-built
+    # graphs (pure test half) never pass through here anyway.
+    try:
+        from ..aot.pinning import tracing_pinned
+
+        pinned = tracing_pinned()
+    except ImportError:
+        pinned = False
     return {
         "collective_algo": config.collective_algo(),
         "ring_crossover_bytes": config.ring_crossover_bytes(),
@@ -91,6 +101,7 @@ def config_snapshot() -> dict:
         "fusion": fusion_mode(),
         "fusion_bucket_bytes": config.fusion_bucket_bytes(),
         "epoch": current_epoch(),
+        "pinned": pinned,
     }
 
 

@@ -202,6 +202,28 @@ CODES = {
             "the comm on recovery) or call comm.shrink(failed, "
             "mesh=...) and re-issue on the result.",
         ),
+        # --- AOT pinning codes (aot/pinning.py + aot/invalidation.py):
+        CodeInfo(
+            "MPX128", "hot loop not pinned", ADVISORY,
+            "One trace dispatches the same (op, comm, statics) "
+            "collective signature many times — a Python-level hot loop "
+            "unrolled into the program, each dispatch paying the full "
+            "Python fast path at trace time and the program growing "
+            "linearly with the trip count.  mpx.compile would pin the "
+            "program to one executable whose call path does no per-call "
+            "key work (docs/aot.md).",
+        ),
+        CodeInfo(
+            "MPX129", "stale pinned program", ERROR,
+            "A pinned program (mpx.compile) was called after the world "
+            "it was compiled for was revoked: a configuration flag or "
+            "set_* override changed the config stamp, or the elastic "
+            "communication epoch advanced (shrink, grow, drain).  A "
+            "pinned executable does no per-call key work and cannot "
+            "retrace itself — re-pin (program.repin(), or a fresh "
+            "mpx.compile; mpx.elastic.run re-pins step functions "
+            "automatically).",
+        ),
     )
 }
 

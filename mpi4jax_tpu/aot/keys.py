@@ -1,0 +1,102 @@
+"""Persistent compile-cache key derivation (pure — no jax).
+
+The on-disk compiled-program cache (diskcache.py) must produce the SAME
+key for the same program on every rank of a multi-host job and across
+process restarts, and a DIFFERENT key whenever anything that shapes the
+lowered program moves.  A key is the SHA-256 over the canonical forms
+of:
+
+- the **jaxpr fingerprint** — the traced program itself (shapes, dtypes,
+  the collective structure, every trace-shaping flag's effect);
+- the **mesh/topology descriptor** — device kind, mesh shape and axis
+  names, process count, and the host-topology override (the same jaxpr
+  compiled for a different physical partition is a different artifact);
+- the **full dynamic cache token** — the flag half of the in-memory
+  program-cache keys (ops/_base.dynamic_cache_token): anything that
+  retraces in memory must miss on disk too;
+- the **version tuple** — jax, jaxlib, libtpu (when present), and this
+  package: serialized executables are not portable across compilers.
+
+Canonicalization is deliberately dumb and total: every structure the
+token can contain (nested tuples, strings, numbers, None, the interned
+hash-once wrappers of the dispatch fast path) renders to one
+deterministic string.  Objects with unstable ``repr``s (anything showing
+an ``0x...`` address) are rejected loudly rather than silently keyed by
+process-local identity.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+# the artifact/layout version: bump when the serialized payload format or
+# the canonicalization below changes incompatibly (old entries then
+# simply never match and age out via LRU eviction)
+KEY_SCHEMA = "mpx-aot-v1"
+
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
+def canonical(obj) -> str:
+    """Deterministic string form of a cache-key part.
+
+    Handles the shapes that actually occur in the dynamic token: scalars,
+    strings, None, nested tuples/lists, dicts (sorted by key), and the
+    dispatch fast path's hash-once ``_Interned`` wrappers (unwrapped via
+    their ``key`` attribute).  Raises ``TypeError`` on anything whose
+    repr carries a memory address — a process-local identity must never
+    leak into a cross-process key.
+    """
+    # the interned wrapper (ops/_base._Interned) and anything else that
+    # exposes a stable `.key` payload canonicalizes through it
+    key = getattr(obj, "key", None)
+    if key is not None and not isinstance(obj, (str, bytes, dict)):
+        return canonical(key)
+    if obj is None or isinstance(obj, (bool, int, float)):
+        return repr(obj)
+    if isinstance(obj, str):
+        return repr(obj)
+    if isinstance(obj, bytes):
+        return "b:" + hashlib.sha256(obj).hexdigest()
+    if isinstance(obj, (tuple, list)):
+        return "(" + ",".join(canonical(x) for x in obj) + ")"
+    if isinstance(obj, (set, frozenset)):
+        return "{" + ",".join(sorted(canonical(x) for x in obj)) + "}"
+    if isinstance(obj, dict):
+        return ("{" + ",".join(
+            f"{canonical(k)}:{canonical(v)}" for k, v in
+            sorted(obj.items(), key=lambda kv: canonical(kv[0]))
+        ) + "}")
+    text = repr(obj)
+    if _ADDR_RE.search(text):
+        raise TypeError(
+            f"cannot derive a stable cache key from {type(obj).__name__} "
+            f"(repr carries a memory address): {text[:80]}"
+        )
+    return f"{type(obj).__name__}:{text}"
+
+
+def fingerprint(text) -> str:
+    """SHA-256 hex digest of a program text (jaxpr pretty-print or
+    StableHLO).  Accepts str or bytes."""
+    if isinstance(text, str):
+        text = text.encode()
+    return hashlib.sha256(text).hexdigest()
+
+
+def derive_key(jaxpr_fingerprint: str, mesh_descriptor, dynamic_token,
+               versions) -> str:
+    """The persistent cache key: SHA-256 over the canonical parts.
+
+    Returns a 64-char hex string — also the artifact's file name stem
+    (diskcache.py shards on the first two chars).
+    """
+    parts = "\n".join((
+        KEY_SCHEMA,
+        str(jaxpr_fingerprint),
+        canonical(mesh_descriptor),
+        canonical(dynamic_token),
+        canonical(versions),
+    ))
+    return hashlib.sha256(parts.encode()).hexdigest()

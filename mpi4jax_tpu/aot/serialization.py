@@ -1,0 +1,70 @@
+"""Compiled-executable (de)serialization — gated on JAX support.
+
+The persistent tier stores *loaded-executable* artifacts: the XLA
+executable bytes plus the call signature trees, via
+``jax.experimental.serialize_executable`` (the same machinery JAX's own
+persistent compilation cache rides).  Everything here degrades
+gracefully:
+
+- ``supported()`` probes the API once; absent (old JAX, or a backend
+  whose PjRt client cannot serialize executables) the persistent tier
+  simply stores nothing — pinning still works, it just recompiles;
+- ``dumps`` returns ``None`` instead of raising on any serialization
+  failure (an unserializable program must not take the pin down);
+- ``loads`` returns ``None`` on any deserialization failure — the
+  caller treats it as a cache miss and recompiles (diskcache's container
+  digest already filtered bit-rot; this filters version skew the key
+  should have caught and anything pickle-level).
+
+Payload format (inside the diskcache container): pickle of
+``(SERIALIZED_EXECUTABLE_BYTES, in_tree, out_tree)``.  PyTreeDefs of
+standard containers pickle portably; exotic custom nodes may not — that
+is one of the graceful-``None`` paths above.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+_PROTO = 4  # stable across the supported Pythons
+
+
+def _api():
+    from jax.experimental import serialize_executable as se
+
+    return se
+
+
+def supported() -> bool:
+    """True when this JAX exposes the executable-serialization API."""
+    try:
+        se = _api()
+    except ImportError:
+        return False
+    return hasattr(se, "serialize") and hasattr(se, "deserialize_and_load")
+
+
+def dumps(compiled) -> Optional[bytes]:
+    """Serialize a ``jax.stages.Compiled`` into an artifact payload, or
+    ``None`` when this program/backend cannot serialize."""
+    if not supported():
+        return None
+    try:
+        payload, in_tree, out_tree = _api().serialize(compiled)
+        return pickle.dumps((payload, in_tree, out_tree), protocol=_PROTO)
+    except Exception:
+        return None
+
+
+def loads(data: bytes):
+    """Deserialize an artifact payload back into a callable
+    ``jax.stages.Compiled``, or ``None`` on any failure (caller
+    recompiles)."""
+    if not supported():
+        return None
+    try:
+        payload, in_tree, out_tree = pickle.loads(data)
+        return _api().deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        return None

@@ -559,16 +559,27 @@ def _eager_prefix(opname: str, comm: Comm, static_key):
 
 
 def cache_stats() -> dict:
-    """Eager compiled-program cache accounting:
-    ``{"hits", "misses", "evictions", "size"}``.
+    """Compiled-program cache accounting, all tiers in one call:
 
-    ``misses`` counts cacheable dispatches that compiled a new program
-    (uncacheable dispatches — e.g. a Status out-param — count neither
-    way); a high eviction rate means the working set exceeds the LRU
-    bound and eager calls are recompiling in cycles.  Reset by
-    ``clear_caches()``.
+    - the eager one-op cache: ``{"hits", "misses", "evictions",
+      "size"}`` — ``misses`` counts cacheable dispatches that compiled
+      a new program (uncacheable dispatches — e.g. a Status out-param —
+      count neither way); a high eviction rate means the working set
+      exceeds the LRU bound and eager calls are recompiling in cycles;
+    - ``"aot"``: the pinning layer (``mpx.compile`` — pins, pinned
+      calls, MPX129 stale refusals, disk loads vs fresh compiles);
+    - ``"disk_cache"``: the persistent tier
+      (``MPI4JAX_TPU_COMPILE_CACHE_DIR`` — hits/misses/writes/
+      evictions/bytes plus the on-disk entry count), the before/after
+      evidence for cold-start behavior (docs/aot.md).
+
+    Reset by ``clear_caches()`` (on-disk artifacts are untouched).
     """
-    return dict(_eager_cache_stats, size=len(_eager_cache))
+    out = dict(_eager_cache_stats, size=len(_eager_cache))
+    from ..aot import stats as _aot_stats
+
+    out.update(_aot_stats())
+    return out
 
 
 def _bump_cache_stat(name: str, telemetry_off: bool = False) -> None:
@@ -597,6 +608,9 @@ def clear_caches() -> None:
     for k in _eager_cache_stats:
         _eager_cache_stats[k] = 0
     _analysis.clear_analysis_caches()
+    from ..aot import reset_stats as _aot_reset
+
+    _aot_reset()
 
 
 def group_select_gather(comm: Comm, xl):

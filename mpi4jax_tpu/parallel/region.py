@@ -103,6 +103,68 @@ def resolve_comm(comm: Optional[Comm]) -> Comm:
     return comm if comm is not None else get_default_comm()
 
 
+def region_axes_spec(c: Comm):
+    """The default PartitionSpec of a comm's region: global arrays carry
+    a leading axis sharded over the comm's mesh axes."""
+    return P(c.axes if len(c.axes) > 1 else c.axes[0])
+
+
+def make_region_body(f, c: Comm, statics, static_vals, kw_names, n_dyn,
+                     squeeze_in: bool, squeeze_out: bool):
+    """Build the per-rank region body ``spmd`` traces: argument
+    re-interleaving, the region context push/pop, fusion drain, pending
+    tokenless-barrier tie-in, and the trace-time verifier hooks.
+
+    Shared by the ``spmd`` program cache (below) and the AOT pinning
+    layer (``mpi4jax_tpu/aot/pinning.py``), so a pinned program traces
+    the IDENTICAL body a cached ``spmd`` program would — same HLO, same
+    jaxpr fingerprint, same persistent-cache artifact.
+    """
+
+    def body(*a):
+        from ..analysis import hook as _analysis
+
+        ctx = RegionContext(c)
+        _analysis.arm_context(ctx)
+        _region_stack.append(ctx)
+        try:
+            if squeeze_in:
+                a = jax.tree.map(lambda v: v[0], a)
+            pos, kwvals = a[:n_dyn], a[n_dyn:]
+            kw = dict(zip(kw_names, kwvals))
+            # re-interleave the closed-over static args
+            full = list(pos)
+            for i, v in zip(statics, static_vals):
+                full.insert(i, v)
+            out = f(*full, **kw)
+            # drain the fusion queue and force any deferred
+            # results: region outputs must be real arrays
+            # before they cross the shard_map boundary
+            from ..ops import _fusion
+
+            _fusion.flush_pending(ctx)
+            out = _fusion.materialize_tree(out)
+            if ctx.pending_sync is not None:
+                # a trailing tokenless barrier: tie it into the
+                # region outputs so it is not dead-code-eliminated
+                from ..ops.token import tie
+
+                sync = ctx.pending_sync
+                ctx.pending_sync = None
+                out = jax.tree.map(lambda v: tie(sync, v), out)
+            if squeeze_out:
+                out = jax.tree.map(lambda v: v[None], out)
+            ctx.check_drained()
+            _analysis.finish_context(
+                ctx, f"spmd region {getattr(f, '__name__', f)!s}"
+            )
+            return out
+        finally:
+            _region_stack.pop()
+
+    return body
+
+
 def spmd(
     fn=None,
     *,
@@ -232,7 +294,7 @@ def spmd(
                     f"recompiles.spmd.{getattr(f, '__name__', 'fn')}"
                 )
             if sm is None:
-                axes_spec = P(c.axes if len(c.axes) > 1 else c.axes[0])
+                axes_spec = region_axes_spec(c)
                 ispecs = in_specs if in_specs is not None else axes_spec
                 ospecs = out_specs if out_specs is not None else axes_spec
                 # Default-spec convention: a global array is
@@ -240,55 +302,31 @@ def spmd(
                 # the body sees true local shapes, we squeeze the sharded
                 # leading axis on the way in and restore it on the way out.
                 # Custom specs disable this.
-                squeeze_in = in_specs is None
-                squeeze_out = out_specs is None
-
-                def body(*a):
-                    from ..analysis import hook as _analysis
-
-                    ctx = RegionContext(c)
-                    _analysis.arm_context(ctx)
-                    _region_stack.append(ctx)
-                    try:
-                        if squeeze_in:
-                            a = jax.tree.map(lambda v: v[0], a)
-                        pos, kwvals = a[:n_dyn], a[n_dyn:]
-                        kw = dict(zip(kw_names, kwvals))
-                        # re-interleave the closed-over static args
-                        full = list(pos)
-                        for i, v in zip(statics, static_vals):
-                            full.insert(i, v)
-                        out = f(*full, **kw)
-                        # drain the fusion queue and force any deferred
-                        # results: region outputs must be real arrays
-                        # before they cross the shard_map boundary
-                        from ..ops import _fusion
-
-                        _fusion.flush_pending(ctx)
-                        out = _fusion.materialize_tree(out)
-                        if ctx.pending_sync is not None:
-                            # a trailing tokenless barrier: tie it into the
-                            # region outputs so it is not dead-code-eliminated
-                            from ..ops.token import tie
-
-                            sync = ctx.pending_sync
-                            ctx.pending_sync = None
-                            out = jax.tree.map(lambda v: tie(sync, v), out)
-                        if squeeze_out:
-                            out = jax.tree.map(lambda v: v[None], out)
-                        ctx.check_drained()
-                        _analysis.finish_context(
-                            ctx, f"spmd region {getattr(f, '__name__', f)!s}"
-                        )
-                        return out
-                    finally:
-                        _region_stack.pop()
-
+                body = make_region_body(
+                    f, c, statics, static_vals, kw_names, n_dyn,
+                    squeeze_in=in_specs is None,
+                    squeeze_out=out_specs is None,
+                )
                 sm = jax.shard_map(
                     body, mesh=c.mesh, in_specs=ispecs, out_specs=ospecs
                 )
                 if jit:
                     sm = jax.jit(sm)
+                    # the persistent tier (docs/aot.md): with
+                    # MPI4JAX_TPU_COMPILE_CACHE_DIR set, a program-cache
+                    # MISS consults the on-disk compiled-program cache
+                    # before XLA re-lowers — a multi-host cold start
+                    # deserializes identical SPMD programs instead of
+                    # compiling them on every rank.  Unset (default),
+                    # the jitted program is used as-is: keys and HLO
+                    # byte-identical to a build without the AOT layer.
+                    from ..utils.config import compile_cache_dir
+
+                    if compile_cache_dir():
+                        from ..aot import pinning as _pinning
+
+                        sm = _pinning.through_disk_cache(
+                            sm, c, label=getattr(f, "__name__", "fn"))
                 program_cache[key] = sm
             return sm(*dyn_args, *(kwargs[k] for k in kw_names))
 

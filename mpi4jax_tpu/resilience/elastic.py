@@ -1889,6 +1889,19 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
     if commit_every < 1:
         raise ValueError(f"commit_every must be >= 1, got {commit_every}")
 
+    # the AOT layer's staleness signal (aot/invalidation.py): a pinned
+    # step function refuses execution after an epoch/config change with
+    # StaleProgramError (MPX129), and THIS loop is the re-entry point —
+    # it re-pins (step_fn.repin()) and retries the same step, so an
+    # elastic job keeps its pinned hot path across shrink/grow/drain
+    # boundaries.  Lazy + guarded: the aot package needs jax, which the
+    # isolated pure-test loaders do not have.
+    try:
+        from ..aot.invalidation import StaleProgramError as _Stale
+    except Exception:  # aot layer unavailable (isolated loaders, no jax)
+        class _Stale(BaseException):  # never raised without the aot layer
+            pass
+
     claimed = False
     prev_handler = prev_fallback = None
     if claim_watchdog:
@@ -1930,6 +1943,16 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
                     kind, step, state = outcome
                     if kind == "leave":
                         return state
+            except _Stale:
+                # a pinned step refused the new world: re-pin and retry
+                # the SAME step (state/step were not advanced).  No
+                # repin hook means the caller pinned by hand — surface
+                # the refusal rather than looping on it.
+                repin = getattr(step_fn, "repin", None)
+                if repin is None:
+                    raise
+                step_fn = repin() or step_fn
+                _meter("elastic.repins")
             except BaseException as exc:  # noqa: B036 - KeyboardInterrupt too
                 rf = classify_failure(exc)
                 if rf is None:

@@ -386,6 +386,21 @@ def snapshot(include_events: bool = False) -> dict:
         history = _elastic.epoch_history()
         if history:
             snap["epochs"] = history
+    # the compile-cache tier (docs/aot.md): AOT pin/call counters + the
+    # persistent disk-cache counters, so report() can render the
+    # cold-start before/after evidence.  Guarded — the aot package needs
+    # jax (absent under the isolated loaders), and a process that never
+    # pinned nor enabled the cache dir contributes nothing.
+    try:
+        from ..aot import stats as _aot_stats
+    except ImportError:
+        pass
+    else:
+        cc = _aot_stats()
+        if (any(cc["aot"].values()) or cc["disk_cache"]["enabled"]
+                or any(v for k, v in cc["disk_cache"].items()
+                       if isinstance(v, int))):
+            snap["compile_cache"] = cc
     if include_events:
         snap["events"] = journal.snapshot_events()
     return snap

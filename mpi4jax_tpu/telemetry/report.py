@@ -173,6 +173,48 @@ def render(snaps: List[dict]) -> str:
         lines.append("meters:")
         for name in sorted(total_meters):
             lines.append(f"  {name:<40} {total_meters[name]:>10}")
+    # compile-cache section (docs/aot.md): AOT pins/calls/stale refusals
+    # summed across processes, disk-cache traffic per process — the one-
+    # glance answer to "did the second cold start actually deserialize?"
+    cc_snaps = [(snap.get("process", 0), snap["compile_cache"])
+                for snap in snaps if "compile_cache" in snap]
+    if cc_snaps:
+        agg = {k: 0 for k in ("pins", "calls", "stale_raises",
+                              "disk_loads", "compiles")}
+        disk = {k: 0 for k in ("hits", "misses", "writes", "evictions",
+                               "bytes")}
+        enabled_dirs = set()
+        entries = disk_bytes = 0
+        for _, cc in cc_snaps:
+            for k in agg:
+                agg[k] += cc.get("aot", {}).get(k, 0)
+            d = cc.get("disk_cache", {})
+            for k in disk:
+                disk[k] += d.get(k, 0)
+            if d.get("enabled"):
+                enabled_dirs.add(d.get("dir", ""))
+            entries = max(entries, d.get("entries", 0))
+            disk_bytes = max(disk_bytes, d.get("disk_bytes", 0))
+        lines.append("")
+        lines.append("compile cache:")
+        lines.append(
+            f"  aot: {agg['pins']} pin(s), {agg['calls']} pinned call(s), "
+            f"{agg['stale_raises']} stale refusal(s) "
+            f"({agg['disk_loads']} loaded from disk, "
+            f"{agg['compiles']} compiled fresh)"
+        )
+        if enabled_dirs:
+            lines.append(
+                f"  disk: {disk['hits']} hit(s), {disk['misses']} "
+                f"miss(es), {disk['writes']} write(s), "
+                f"{disk['evictions']} eviction(s); "
+                f"{entries} artifact(s), "
+                f"{_fmt_bytes(disk_bytes)} on disk "
+                f"({', '.join(sorted(enabled_dirs))})"
+            )
+        else:
+            lines.append("  disk: persistent tier disabled "
+                         "(MPI4JAX_TPU_COMPILE_CACHE_DIR unset)")
     epochs = {}
     for snap in snaps:
         for rec in snap.get("epochs", ()):

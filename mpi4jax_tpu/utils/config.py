@@ -77,6 +77,12 @@ DEFAULT_ELASTIC_PORT_SPAN = 64
 # DCN round trip under load, far below any eviction deadline
 DEFAULT_DRAIN_GRACE_S = 5.0
 
+# default size cap of the persistent compiled-program cache
+# (mpi4jax_tpu/aot/diskcache.py): 1 GiB — a few hundred lowered+compiled
+# SPMD programs at typical sizes; oldest-used entries are evicted first
+# once the cap is crossed (docs/aot.md).
+DEFAULT_COMPILE_CACHE_MAX_BYTES = 1 << 30
+
 # default ring/butterfly crossover: 1 MiB — below it the butterfly's
 # ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
 # the ring's O(size) vs O(size·log k) byte volume dominates.  Measured per
@@ -248,6 +254,24 @@ FLAGS = {
              "Byte cap per fusion bucket (per dtype): a bucket closes "
              "when adding the next member would exceed it.  Default "
              "4 MiB."),
+        Flag("MPI4JAX_TPU_COMPILE_CACHE_DIR", "str", "",
+             "Persistent compiled-program cache directory "
+             "(mpi4jax_tpu/aot/diskcache.py): lowered+compiled SPMD "
+             "programs — ``mpx.compile`` pins and ``mpx.spmd`` "
+             "program-cache misses — are serialized here keyed by "
+             "(jaxpr fingerprint, mesh/topology, dynamic cache token, "
+             "jax/jaxlib/libtpu versions), so repeated cold starts and "
+             "every rank of a multi-host job deserialize instead of "
+             "re-lowering identical programs.  Empty (default) disables "
+             "the persistent tier entirely — cache keys and HLO are "
+             "byte-identical to a build without the AOT layer "
+             "(docs/aot.md)."),
+        Flag("MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES", "int",
+             DEFAULT_COMPILE_CACHE_MAX_BYTES,
+             "Byte cap of the persistent compiled-program cache: after "
+             "each write, least-recently-used artifacts are evicted "
+             "until the cache fits.  Default 1 GiB; 0 disables "
+             "eviction (unbounded)."),
         Flag("MPI4JAX_TPU_OVERLAP_CHUNKS", "int",
              DEFAULT_OVERLAP_CHUNKS,
              "Chunk count for the async start/wait collectives "
@@ -643,6 +667,23 @@ def overlap_chunks() -> int:
     (``MPI4JAX_TPU_OVERLAP_CHUNKS``; default 2, minimum 1)."""
     return _parse_env_positive_int(
         "MPI4JAX_TPU_OVERLAP_CHUNKS", DEFAULT_OVERLAP_CHUNKS, minimum=1
+    )
+
+
+def compile_cache_dir() -> str:
+    """Persistent compiled-program cache directory
+    (``MPI4JAX_TPU_COMPILE_CACHE_DIR``; '' = the persistent tier is
+    disabled — see mpi4jax_tpu/aot/diskcache.py and docs/aot.md)."""
+    return (_getenv("MPI4JAX_TPU_COMPILE_CACHE_DIR") or "").strip()
+
+
+def compile_cache_max_bytes() -> int:
+    """Byte cap of the persistent compiled-program cache
+    (``MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES``; default 1 GiB, 0 =
+    unbounded)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES",
+        DEFAULT_COMPILE_CACHE_MAX_BYTES,
     )
 
 

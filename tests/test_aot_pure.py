@@ -1,0 +1,417 @@
+"""Pure half of the AOT pinning + persistent compile cache suite
+(docs/aot.md).
+
+Everything here runs WITHOUT importing mpi4jax_tpu (the isolated loader
+below, mirroring tests/test_elastic_pure.py), so the cache core is
+verified under any JAX version:
+
+- key derivation (aot/keys.py): canonicalization totality and
+  determinism, per-part key sensitivity, interned-wrapper unwrapping,
+  address-bearing-repr rejection;
+- the artifact container + disk cache (aot/diskcache.py): round-trip,
+  atomicity leftovers, corruption self-healing, LRU eviction to the
+  byte cap, counter accounting, the disabled tier;
+- the stale-detection state machine (aot/invalidation.py): env-flag
+  mutation, set_*-override epoch bumps, elastic epoch advances, the
+  MPX129 tagging, flip-back revalidation;
+- the MPX128 hot-loop advisory checker and both new catalog rows.
+
+The traced half (pinned==jit bit-identity, donation, HLO pins, the
+disk round-trip through real executables, the elastic re-pin drill) is
+tests/test_aot.py, which needs jax >= the package floor.
+"""
+
+import importlib
+import os
+import pathlib
+import sys
+import time
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_aot_iso"
+
+
+def _load_isolated():
+    """Load the pure-Python AOT stack under a private package name
+    (bypasses mpi4jax_tpu/__init__.py and its JAX floor; state isolated
+    from any real import in the same process)."""
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "analysis", "telemetry", "resilience", "aot"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in (
+        "utils.config",
+        "analysis.report",
+        "analysis.graph",
+        "analysis.checkers",
+        "telemetry.core",
+        "resilience.elastic",
+        "aot.keys",
+        "aot.diskcache",
+        "aot.invalidation",
+    ):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+keys = ISO.aot.keys
+diskcache = ISO.aot.diskcache
+inv = ISO.aot.invalidation
+config = ISO.utils.config
+elastic = ISO.resilience.elastic
+report = ISO.analysis.report
+graph_mod = ISO.analysis.graph
+checkers = ISO.analysis.checkers
+
+KEY_A = "ab" * 32
+KEY_B = "cd" * 32
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "compile-cache")
+    monkeypatch.setenv("MPI4JAX_TPU_COMPILE_CACHE_DIR", d)
+    diskcache.reset_stats()
+    yield d
+    diskcache.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_scalars_and_containers():
+    assert keys.canonical(None) == "None"
+    assert keys.canonical(True) == "True"
+    assert keys.canonical(3) != keys.canonical(3.0)
+    assert keys.canonical("3") != keys.canonical(3)
+    assert keys.canonical((1, (2, "x"))) == keys.canonical([1, [2, "x"]])
+    # dicts canonicalize order-independently
+    assert keys.canonical({"b": 1, "a": 2}) == keys.canonical({"a": 2, "b": 1})
+    assert keys.canonical({"a": 1}) != keys.canonical({"a": 2})
+    assert keys.canonical(frozenset({2, 1})) == keys.canonical({1, 2})
+
+
+def test_canonical_unwraps_interned_wrappers():
+    class Interned:  # shape of ops/_base._Interned
+        def __init__(self, key):
+            self.key = key
+
+    tok = (("MPI4JAX_TPU_FUSION", "auto"), 3, True)
+    assert keys.canonical(Interned(tok)) == keys.canonical(tok)
+
+
+def test_canonical_rejects_address_reprs():
+    class Opaque:
+        pass
+
+    with pytest.raises(TypeError, match="memory address"):
+        keys.canonical(Opaque())
+
+
+def test_canonical_bytes_hash():
+    assert keys.canonical(b"abc") == keys.canonical(b"abc")
+    assert keys.canonical(b"abc") != keys.canonical(b"abd")
+    assert keys.canonical(b"abc") != keys.canonical("abc")
+
+
+def test_fingerprint_deterministic():
+    assert keys.fingerprint("jaxpr text") == keys.fingerprint(b"jaxpr text")
+    assert keys.fingerprint("a") != keys.fingerprint("b")
+    assert len(keys.fingerprint("x")) == 64
+
+
+def test_derive_key_sensitivity():
+    k0 = keys.derive_key("fp", (("x",), (8,)), ("tok",), ("0.6.0", "0.6.0"))
+    # identical parts -> identical key (the multi-host contract)
+    assert k0 == keys.derive_key("fp", (("x",), (8,)), ("tok",),
+                                 ("0.6.0", "0.6.0"))
+    assert len(k0) == 64
+    # every part is load-bearing
+    assert k0 != keys.derive_key("FP", (("x",), (8,)), ("tok",),
+                                 ("0.6.0", "0.6.0"))
+    assert k0 != keys.derive_key("fp", (("x",), (4,)), ("tok",),
+                                 ("0.6.0", "0.6.0"))
+    assert k0 != keys.derive_key("fp", (("x",), (8,)), ("tok2",),
+                                 ("0.6.0", "0.6.0"))
+    assert k0 != keys.derive_key("fp", (("x",), (8,)), ("tok",),
+                                 ("0.7.0", "0.6.0"))
+
+
+# ---------------------------------------------------------------------------
+# the artifact container
+# ---------------------------------------------------------------------------
+
+
+def test_container_roundtrip():
+    data = diskcache.pack(b"payload bytes")
+    assert diskcache.unpack(data) == b"payload bytes"
+    assert diskcache.unpack(diskcache.pack(b"")) == b""
+
+
+@pytest.mark.parametrize("mutation", [
+    lambda d: d[:-1],                       # truncated digest
+    lambda d: b"XXXXXXXX" + d[8:],          # bad magic
+    lambda d: d[:20] + b"\x00" + d[21:],    # flipped payload byte
+    lambda d: d[:10] + b"\xff" + d[11:],    # corrupted length
+    lambda d: b"",                          # empty file
+], ids=["truncated", "magic", "payload-bit", "length", "empty"])
+def test_container_rejects_corruption(mutation):
+    data = diskcache.pack(b"payload bytes")
+    assert diskcache.unpack(mutation(data)) is None
+
+
+# ---------------------------------------------------------------------------
+# the disk cache
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_tier_stores_nothing(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_COMPILE_CACHE_DIR", raising=False)
+    diskcache.reset_stats()
+    assert not diskcache.enabled()
+    assert diskcache.cache_root() is None
+    assert diskcache.get(KEY_A) is None
+    assert diskcache.put(KEY_A, b"x") is False
+    st = diskcache.stats()
+    # a disabled tier neither hits nor misses: it does not exist
+    assert st["hits"] == st["misses"] == st["writes"] == 0
+    assert st["enabled"] is False
+
+
+def test_put_get_roundtrip(cache_dir):
+    assert diskcache.get(KEY_A) is None          # miss
+    assert diskcache.put(KEY_A, b"artifact-1")
+    assert diskcache.get(KEY_A) == b"artifact-1"  # hit
+    # overwrite wins (the concurrent-rank race: last writer, same bytes)
+    assert diskcache.put(KEY_A, b"artifact-2")
+    assert diskcache.get(KEY_A) == b"artifact-2"
+    st = diskcache.stats()
+    assert st["hits"] == 2 and st["misses"] == 1 and st["writes"] == 2
+    assert st["entries"] == 1
+    assert st["dir"] == cache_dir
+
+
+def test_corrupt_artifact_self_heals(cache_dir):
+    diskcache.put(KEY_A, b"good")
+    path = diskcache._path_for(diskcache.cache_root(), KEY_A)
+    with open(path, "wb") as f:
+        f.write(b"rotten bits")
+    assert diskcache.get(KEY_A) is None      # corrupt -> miss
+    assert not os.path.exists(path)          # and deleted
+    # the recompile path rewrites it
+    assert diskcache.put(KEY_A, b"good again")
+    assert diskcache.get(KEY_A) == b"good again"
+
+
+def test_eviction_lru_to_byte_cap(cache_dir, monkeypatch):
+    def put_aged(key, payload, age_s):
+        assert diskcache.put(key, payload)
+        path = diskcache._path_for(diskcache.cache_root(), key)
+        t = time.time() - age_s
+        os.utime(path, (t, t))
+        return path
+
+    one = len(diskcache.pack(b"x" * 64))
+    monkeypatch.setenv("MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES",
+                       str(2 * one + 10))
+    oldest = put_aged(KEY_A, b"x" * 64, 300)
+    put_aged(KEY_B, b"y" * 64, 200)
+    # third write exceeds the cap -> the OLDEST artifact goes, never the
+    # one just written
+    diskcache.put("ef" * 32, b"z" * 64)
+    assert not os.path.exists(oldest)
+    assert diskcache.get("ef" * 32) == b"z" * 64
+    assert diskcache.get(KEY_B) == b"y" * 64
+    assert diskcache.stats()["evictions"] == 1
+
+
+def test_eviction_unbounded_when_zero(cache_dir, monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES", "0")
+    for i in range(4):
+        diskcache.put(("%02x" % i) * 32, bytes(64))
+    assert diskcache.stats()["evictions"] == 0
+    assert diskcache.stats()["entries"] == 4
+
+
+def test_hit_touches_mtime_for_lru(cache_dir):
+    path_a = None
+    diskcache.put(KEY_A, b"a")
+    path_a = diskcache._path_for(diskcache.cache_root(), KEY_A)
+    old = time.time() - 500
+    os.utime(path_a, (old, old))
+    before = os.stat(path_a).st_mtime
+    assert diskcache.get(KEY_A) == b"a"
+    assert os.stat(path_a).st_mtime > before  # refreshed to ~now
+
+
+# ---------------------------------------------------------------------------
+# the stale-detection state machine
+# ---------------------------------------------------------------------------
+
+
+def test_stamp_current_roundtrip():
+    ws = inv.WorldStamp.capture()
+    assert ws.is_current()
+    ws.check()  # no raise
+    assert ws.describe_staleness() is None
+
+
+def test_env_mutation_goes_stale_and_back(monkeypatch):
+    ws = inv.WorldStamp.capture()
+    monkeypatch.setenv("MPI4JAX_TPU_FUSION", "auto")
+    assert not ws.is_current()
+    with pytest.raises(inv.StaleProgramError) as ei:
+        ws.check("pinned program 'step'")
+    assert getattr(ei.value, "mpx_code", None) == "MPX129"
+    assert "MPX129" in str(ei.value)
+    assert "MPI4JAX_TPU_FUSION" in str(ei.value)  # names the moved flag
+    # flip-back revalidates: same stamp, same trace
+    monkeypatch.delenv("MPI4JAX_TPU_FUSION")
+    assert ws.is_current()
+    ws.check()
+
+
+def test_programmatic_override_goes_stale():
+    ws = inv.WorldStamp.capture()
+    config.bump_config_epoch()  # what every set_* override does
+    assert not ws.is_current()
+    why = ws.describe_staleness()
+    assert "set_*" in why or "epoch" in why
+    with pytest.raises(inv.StaleProgramError):
+        ws.check()
+    # re-capture enters the new world
+    assert inv.WorldStamp.capture().is_current()
+
+
+def test_elastic_epoch_goes_stale_permanently(monkeypatch):
+    ws = inv.WorldStamp.capture()
+    before = elastic.current_epoch()
+    elastic.advance_epoch(world=3, cause="revoke", detail="test")
+    try:
+        assert not ws.is_current()
+        with pytest.raises(inv.StaleProgramError) as ei:
+            ws.check("pinned program 'loop'")
+        msg = str(ei.value)
+        assert "epoch" in msg and f"{before} -> {before + 1}" in msg
+        assert getattr(ei.value, "mpx_code", None) == "MPX129"
+        # a fresh capture is current in the new epoch
+        ws2 = inv.WorldStamp.capture()
+        assert ws2.epoch == before + 1 and ws2.is_current()
+    finally:
+        elastic._reset_epoch_for_tests()
+
+
+def test_storage_only_flags_never_stale(monkeypatch):
+    # the compile-cache knobs decide where artifacts are STORED — they
+    # shape no trace, so retuning them must not revoke live pins
+    ws = inv.WorldStamp.capture()
+    monkeypatch.setenv("MPI4JAX_TPU_COMPILE_CACHE_DIR", "/tmp/somewhere")
+    monkeypatch.setenv("MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES", "123456")
+    assert ws.is_current()
+    ws.check()  # no raise
+    for name in inv.STORAGE_ONLY_FLAGS:
+        assert name in config.FLAGS  # exemption list stays declared
+
+
+def test_check_message_names_the_repin_recipe():
+    ws = inv.WorldStamp.capture()
+    config.bump_config_epoch()
+    with pytest.raises(inv.StaleProgramError, match="repin"):
+        ws.check()
+
+
+# ---------------------------------------------------------------------------
+# MPX128 checker + catalog rows
+# ---------------------------------------------------------------------------
+
+
+def _events(n, op="allreduce", eager=False, **over):
+    base = dict(comm_uid=1, reduction="sum", dtype="float32", shape=(8,))
+    base.update(over)
+    return [graph_mod.CollectiveEvent(index=i, op=op, eager=eager, **base)
+            for i in range(n)]
+
+
+def _graph(events, pinned=False):
+    return graph_mod.CollectiveGraph(events=events,
+                                     meta={"pinned": pinned})
+
+
+def test_mpx128_fires_at_threshold():
+    n = checkers.AOT_ADVISORY_MIN_REPEATS
+    findings = checkers.check_unpinned_hot_loop(_graph(_events(n)))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.code == "MPX128" and f.severity == "advisory"
+    assert "mpx.compile" in f.suggestion
+    assert str(n) in f.message
+
+
+def test_mpx128_negative_below_threshold():
+    n = checkers.AOT_ADVISORY_MIN_REPEATS - 1
+    assert not checkers.check_unpinned_hot_loop(_graph(_events(n)))
+
+
+def test_mpx128_gated_on_pinned_meta():
+    n = checkers.AOT_ADVISORY_MIN_REPEATS
+    # a trace being pinned right now must not be advised to pin itself
+    assert not checkers.check_unpinned_hot_loop(
+        _graph(_events(n), pinned=True))
+    # hand-built graphs without the meta key are testing other rules
+    assert not checkers.check_unpinned_hot_loop(
+        graph_mod.CollectiveGraph(events=_events(n), meta={}))
+
+
+def test_mpx128_ignores_eager_and_mixed_signatures():
+    n = checkers.AOT_ADVISORY_MIN_REPEATS
+    # eager ops are one-op programs, not an unrolled loop
+    assert not checkers.check_unpinned_hot_loop(
+        _graph(_events(n, eager=True)))
+    # p2p loops are structure (one message per neighbor), never a
+    # hot-loop advisory — and async spans pair, they don't repeat
+    assert not checkers.check_unpinned_hot_loop(
+        _graph(_events(n, op="sendrecv", reduction=None, tag=0)))
+    assert not checkers.check_unpinned_hot_loop(
+        _graph([graph_mod.CollectiveEvent(index=i, op="allreduce_start",
+                                          comm_uid=1, reduction="sum",
+                                          dtype="float32", shape=(8,),
+                                          span=i)
+                for i in range(n)]))
+    # n distinct signatures (different shapes) never accumulate
+    events = [graph_mod.CollectiveEvent(index=i, op="allreduce", comm_uid=1,
+                                        reduction="sum", dtype="float32",
+                                        shape=(i + 1,))
+              for i in range(n)]
+    assert not checkers.check_unpinned_hot_loop(_graph(events))
+
+
+def test_new_codes_in_catalog():
+    assert report.CODES["MPX128"].severity == report.ADVISORY
+    assert report.CODES["MPX129"].severity == report.ERROR
+    # the registry covers them: MPX128 via the checker, MPX129 via the
+    # tagged raise site (invalidation.check) — build one of each
+    exc = report.mpx_error(inv.StaleProgramError, "MPX129", "stale")
+    assert exc.mpx_code == "MPX129"
+    f = report.finding_from_exception(exc)
+    assert f is not None and f.code == "MPX129"
+
+
+def test_flags_declared():
+    assert "MPI4JAX_TPU_COMPILE_CACHE_DIR" in config.FLAGS
+    assert "MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES" in config.FLAGS
+    assert config.compile_cache_dir() == "" or True  # readable
+    assert config.compile_cache_max_bytes() >= 0

@@ -110,6 +110,20 @@ def test_bench_hierarchy_schema():
     assert (r["hier_speedup"] is None) == (comm.Get_size() == 1)
 
 
+def test_bench_dispatch_schema():
+    # compiles all three execution surfaces — eager one-op, spmd, and
+    # the mpx.compile-pinned artifact — for the same allreduce at a tiny
+    # size: a pinning or dispatch-path regression fails here, fast
+    comm = _world_comm()
+    rows = micro.bench_dispatch(comm, sizes_kb=[0.004], iters=3)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["eager_us"] > 0 and r["spmd_us"] > 0 and r["pinned_us"] > 0
+    assert r["pinned_vs_spmd"] is not None and r["pinned_vs_spmd"] > 0
+    # the sweep pinned at least one program this process
+    assert mpx.cache_stats()["aot"]["pins"] >= 1
+
+
 def test_save_results_roundtrip(tmp_path):
     import json
 
