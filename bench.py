@@ -11,6 +11,7 @@ the same workload, ref docs/shallow-water.rst:81-83): values > 1 mean
 faster than the reference's GPU.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -19,6 +20,16 @@ import jax
 
 
 def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--unroll", type=int, default=0,
+        help="megastep trip count: run the solve as pinned megastep "
+             "dispatches of N device-resident steps each instead of one "
+             "whole-run program (mpx.compile(fn, ..., unroll=N); "
+             "docs/aot.md 'Megastep execution').  0 (default) keeps the "
+             "whole-run program.")
+    args = parser.parse_args()
+
     sys.path.insert(
         0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples")
     )
@@ -42,15 +53,18 @@ def main():
     # unavailable, and the "pinned" field below records which ran.
     import mpi4jax_tpu as mpx
 
+    info1, info5 = {}, {}
     wall, n_steps = solve_fused(cfg, t1, devices=devices, fast="auto",
-                                pinned=True)
+                                pinned=True, unroll=args.unroll,
+                                info=info1)
 
     # second, 5x-longer run: the slope between the two cancels the fixed
     # per-dispatch overhead (on a remote-attached chip the round-trip can
     # reach ~0.1 s, a fifth of the short run's wall), giving the true
     # on-chip per-step time — see docs/shallow_water.md "Roofline"
     wall5, n_steps5 = solve_fused(cfg, 5 * t1, devices=devices,
-                                  fast="auto", pinned=True)
+                                  fast="auto", pinned=True,
+                                  unroll=args.unroll, info=info5)
     per_step = (wall5 - wall) / (n_steps5 - n_steps)
     aot_stats = mpx.cache_stats()["aot"]
 
@@ -78,6 +92,14 @@ def main():
                 # a second-run fallback must not claim a pinned number
                 "pinned": aot_stats["pins"] >= 2,
                 "pinned_calls": aot_stats["calls"],
+                # the megastep trip count BOTH timed runs actually
+                # executed with (0 = whole-run program; a megastep
+                # compile failure falls back and must not claim the
+                # configuration it did not run — same honesty rule as
+                # "pinned" above; docs/aot.md "Megastep execution")
+                "unroll": (info1.get("unroll", 0)
+                           if info1.get("unroll") == info5.get("unroll")
+                           else 0),
                 **(
                     {
                         "onchip_steps_per_s_per_chip": round(

@@ -400,6 +400,71 @@ def bench_dispatch(comm, sizes_kb=(0.004, 4, 64), iters=100):
     return rows
 
 
+def bench_dispatch_unroll(comm, unrolls=(1, 8, 64), size_kb=0.004,
+                          iters=50):
+    """The megastep amortization sweep (``--dispatch-sweep``'s unroll
+    axis): the SAME one-allreduce step pinned at ``unroll=N`` for each N
+    (``mpx.compile(fn, ..., unroll=N)`` — one host dispatch executes N
+    device-resident steps, docs/aot.md "Megastep execution"), timed per
+    megastep call.
+
+    Per-step **host** cost is separated from per-step device cost with a
+    two-point fit: per-call wall is ``wall(N) = D + N * d`` (D = fixed
+    host dispatch per call, d = on-chip per-step time), so ``d`` falls
+    out of the difference between the two largest unrolls — the dispatch
+    term cancels — and each row's ``per_step_host_us = wall(N)/N - d``
+    is an independent measurement.  The 1/N amortization claim is then
+    checkable from the saved artifact: host cost at unroll=64 should be
+    ~1/64 of unroll=1 (CI asserts < 1/8).
+    """
+    n = comm.Get_size()
+    nelem = max(1, int(size_kb * 1e3 / 4))
+    x = jnp.ones((n, nelem), jnp.float32)
+    unrolls = sorted(set(int(u) for u in unrolls))
+
+    def per_rank(v):
+        return mpx.varying(mpx.allreduce(v, op=mpx.SUM)[0] * (1.0 / n))
+
+    walls = {}
+    fast_paths = {}
+    for u in unrolls:
+        pinned = mpx.compile(per_rank, x, comm=comm, unroll=u)
+        fast_paths[u] = pinned.fast_path
+        pinned(x)
+        jax.block_until_ready(pinned(x))  # compile + drain
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = pinned(x)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        walls[u] = best
+
+    # on-chip per-step estimate d from the two largest unrolls (the
+    # host dispatch term cancels in the difference); one unroll = no fit
+    if len(unrolls) >= 2:
+        hi, lo = unrolls[-1], unrolls[-2]
+        d = max(0.0, (walls[hi] - walls[lo]) / (hi - lo))
+    else:
+        d = 0.0
+    rows = []
+    for u in unrolls:
+        wall = walls[u]
+        rows.append({
+            "unroll": u,
+            "megastep_us": round(wall * 1e6, 2),
+            "per_step_us": round(wall / u * 1e6, 3),
+            "per_step_host_us": round(max(0.0, wall / u - d) * 1e6, 3),
+            "fast_path": fast_paths[u],
+        })
+    return {
+        "size_kb": round(nelem * 4 / 1e3, 3),
+        "onchip_per_step_us": round(d * 1e6, 3),
+        "rows": rows,
+    }
+
+
 def save_results(payload, outdir=None):
     """Write one sweep payload to ``benchmarks/results/`` (the ``--save``
     flag): ``micro_{platform}_{n}dev_{YYYYMMDD}.json``, returning the path
@@ -475,6 +540,12 @@ def main():
                    help="payload sizes for --dispatch-sweep (KiB)")
     p.add_argument("--dispatch-iters", type=int, default=100,
                    help="calls per timed loop for --dispatch-sweep")
+    p.add_argument("--dispatch-unrolls", type=int, nargs="+",
+                   default=[1, 8, 64],
+                   help="megastep trip counts for --dispatch-sweep's "
+                        "unroll axis (mpx.compile(fn, ..., unroll=N): "
+                        "per-step host cost amortizes ~1/N; "
+                        "docs/aot.md 'Megastep execution')")
     args = p.parse_args()
 
     devices = jax.devices()
@@ -531,6 +602,10 @@ def main():
     ds = (_section("dispatch", bench_dispatch, comm,
                    tuple(args.dispatch_sizes_kb), args.dispatch_iters)
           if args.dispatch_sweep else None)
+    du = (_section("dispatch_unroll", bench_dispatch_unroll, comm,
+                   tuple(args.dispatch_unrolls),
+                   min(args.dispatch_sizes_kb), args.dispatch_iters)
+          if args.dispatch_sweep else None)
 
     payload = {
         "platform": devices[0].platform,
@@ -566,6 +641,8 @@ def main():
         payload["dispatch_cache_stats"] = {
             k: cstats[k] for k in ("aot", "disk_cache")
         }
+    if du is not None:
+        payload["dispatch_unroll"] = du
     if args.telemetry:
         payload["telemetry"] = telemetry_sections
         mpx.set_telemetry_mode(None)
@@ -628,6 +705,14 @@ def main():
             print(f"  {r['size_kb']:>10.3f} KB   {r['eager_us']:>8.2f} us"
                   f"   {r['spmd_us']:>8.2f} us   {r['pinned_us']:>8.2f} us"
                   f"   {sp}")
+    if du is not None:
+        print(f"\nmegastep unroll sweep ({du['size_kb']} KB; on-chip "
+              f"~{du['onchip_per_step_us']} us/step)"
+              "\n  unroll   megastep/call   per step     host/step")
+        for r in du["rows"]:
+            print(f"  {r['unroll']:>6}   {r['megastep_us']:>10.2f} us"
+                  f"   {r['per_step_us']:>8.3f} us"
+                  f"   {r['per_step_host_us']:>8.3f} us")
 
 
 if __name__ == "__main__":

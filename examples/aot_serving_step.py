@@ -40,6 +40,19 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 import mpi4jax_tpu as mpx  # noqa: E402
 
 
+def decode_step(x, w):
+    """The per-rank decode step: a row-parallel linear — each rank holds
+    a (dim/size, dim) weight shard and its slice of the activations; the
+    matmul produces a PARTIAL sum that one allreduce completes
+    (Megatron-style).  Module-level so the cache-warming CLI can name it
+    in a manifest (``python -m mpi4jax_tpu.aot warm``, docs/aot.md
+    "Cache warming"): the output slice width comes from the weight
+    shard's own shape, no closed-over configuration."""
+    partial = x @ w
+    full, _ = mpx.allreduce(partial, op=mpx.SUM)
+    return jnp.tanh(mpx.varying(full))[:, : w.shape[0]]
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=50,
@@ -53,14 +66,6 @@ def main():
     comm = mpx.get_default_comm()
     size = comm.Get_size()
     dim = max(size, args.dim // size * size)  # divisible by the mesh
-
-    def decode_step(x, w):
-        # row-parallel linear: each rank holds a (dim/size, dim) weight
-        # shard and its slice of the activations; the matmul produces a
-        # PARTIAL sum that one allreduce completes (Megatron-style)
-        partial = x @ w
-        full, _ = mpx.allreduce(partial, op=mpx.SUM)
-        return jnp.tanh(mpx.varying(full))[:, : dim // size]
 
     # global arrays: leading axis = ranks
     x = jnp.ones((size, 8, dim // size), jnp.float32) * 0.01

@@ -1708,7 +1708,7 @@ def solve(cfg: Config, t1: float, *, num_multisteps: int = 10, devices=None,
 
 def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
                 devices=None, fast=True, return_state=False,
-                pinned: bool = False):
+                pinned: bool = False, unroll: int = 0, info: dict = None):
     """Benchmark-mode solve: the ENTIRE simulation is one XLA program
     (first Euler step + a ``fori_loop`` over all remaining steps), so the
     host dispatches once instead of once per multistep.  Runs the same
@@ -1724,6 +1724,14 @@ def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
     local layout happens once at the end — per pair this costs four
     band messages and zero full-array copies, where cropping and
     re-widening every call costs two extra full-state HBM round-trips.
+
+    ``unroll=N`` (> 0) switches to megastep mode: the run becomes
+    ``ceil((n_steps - 1)/N)`` pinned megastep dispatches of N
+    device-resident steps each (``mpx.compile(..., unroll=N)``,
+    docs/aot.md "Megastep execution") — unroll implies pinning.  When
+    ``info`` (a dict) is passed, ``info["unroll"]`` records the trip
+    count that ACTUALLY executed (0 on fallback), so callers like
+    bench.py stamp only configurations that ran.
     """
     mesh, comm = make_mesh_and_comm(cfg, devices=devices)
     n_iters = max(0, math.ceil((t1 - cfg.dt) / (cfg.dt * num_multisteps)))
@@ -1748,13 +1756,57 @@ def solve_fused(cfg: Config, t1: float, *, num_multisteps: int = 10,
 
     state = initial_state(cfg)
     runner = fused
-    if pinned:
+    if info is not None:
+        # what actually executed: the megastep block below flips
+        # "unroll" on success only, so a fallback run never stamps a
+        # megastep configuration it did not use (mirrors the aot-stats
+        # guard bench.py applies to "pinned")
+        info["unroll"] = 0
+    megastep_ok = False
+    if unroll and unroll > 0:
+        # Megastep mode (docs/aot.md "Megastep execution"): instead of
+        # one whole-run program, the run is ceil((n_steps - 1)/unroll)
+        # pinned megastep dispatches of `unroll` device-resident steps
+        # each — the configuration that exposes per-dispatch host cost
+        # so bench.py --unroll can show it amortizing as 1/N.  The Euler
+        # first step runs through the whole-run program at total=0.
+        def one_step(state: State) -> State:
+            if step is model_step_wide:
+                return _wide_run(state, 1, cfg, comm, chunk_size, m,
+                                 interpret, euler_first=False)
+            return _run_steps(state, 1, cfg, comm, step, chunk, chunk_size)
+
+        try:
+            n_mega, tail = divmod(n_steps - 1, unroll)
+            pp = (mpx.compile(one_step, state, comm=comm, unroll=unroll)
+                  if n_mega else None)
+            tail_pp = (mpx.compile(one_step, state, comm=comm, unroll=tail)
+                       if tail else None)
+
+            def runner(s, total, _pp=pp, _tail=tail_pp, _n=n_mega):
+                assert total == n_steps - 1, \
+                    "megastep runner compiled for a fixed step count"
+                s = fused(s, 0)
+                for _ in range(_n):
+                    s = _pp(s)
+                if _tail is not None:
+                    s = _tail(s)
+                return s
+
+            megastep_ok = True
+            if info is not None:
+                info["unroll"] = unroll
+        except Exception as e:  # noqa: BLE001 - diagnostic fallback
+            print(f"shallow_water: megastep unroll unavailable ({e!r}); "
+                  "falling back to the whole-run program", file=sys.stderr)
+    if pinned and not megastep_ok:
         # AOT-pin the whole-run program (docs/aot.md): the timed calls
         # then execute a compiled artifact with zero per-call key work —
         # the dispatch_overhead_s line item bench.py reports is exactly
         # what this removes.  The step-count static folds at pin time.
         # Best-effort: any pin failure falls back to the spmd program
-        # so the benchmark never regresses.
+        # so the benchmark never regresses.  (With an ACTIVE megastep
+        # runner this pin is skipped: nothing would execute it.)
         try:
             pp = mpx.compile(fused, state, n_steps - 1)
 

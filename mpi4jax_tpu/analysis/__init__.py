@@ -108,6 +108,9 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
             in_specs=kw["in_specs"],
             out_specs=kw["out_specs"],
             static_argnums=kw["static_argnums"],
+            # the megastep loop is part of the verified structure: the
+            # twin must trace it so MPX130 can see span straddles
+            unroll=kw.get("unroll"),
             jit=False,
         )
         if static_argnums is None:

@@ -450,12 +450,16 @@ def check_unpinned_hot_loop(graph: CollectiveGraph) -> List[Finding]:
     is being pinned right now records True): hand-built graphs without
     pinning meta are testing other rules.  Eager events never count —
     each eager op is its own one-op program, not an unrolled loop.
+    Events traced inside a megastep loop body (``e.loop`` set,
+    parallel/megastep.py) never count either: the body traces ONCE — the
+    advisory's advice (keep the loop on device) is already taken, the
+    exact mirror of the ``tracing_pinned()`` exemption.
     """
     if graph.meta.get("pinned") is not False:
         return []
     counts: dict = {}
     for e in graph.events:
-        if e.eager:
+        if e.eager or e.loop is not None:
             continue
         # point-to-point loops (one send/recv per neighbor) and async
         # spans are STRUCTURE — same-signature repeats there route to
@@ -479,10 +483,76 @@ def check_unpinned_hot_loop(graph: CollectiveGraph) -> List[Finding]:
                      "Python-level hot loop unrolled into the program"),
             suggestion=("pin the program once with mpx.compile(fn, "
                         "*abstract_args, comm=...) and call the pinned "
-                        "executable in the loop (or move the loop into "
-                        "jax.lax.fori_loop so it traces once) — "
-                        "docs/aot.md"),
+                        "executable in the loop — or collapse the loop "
+                        "onto the device with unroll=: mpx.compile(fn, "
+                        "*abstract_args, comm=..., unroll=N) / "
+                        "mpx.spmd(..., unroll=N) keeps N iterations "
+                        "device-resident per host dispatch (megastep "
+                        "execution, docs/aot.md)"),
         ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# megastep span-straddle error (MPX130)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX130")
+def check_megastep_span_straddle(graph: CollectiveGraph) -> List[Finding]:
+    """An async ``*_start``/``*_wait`` span straddling a megastep loop
+    boundary (parallel/megastep.py): the loop body traces ONCE, so a
+    start whose wait is not inside the same loop body would — at run
+    time — leave iteration N's collective phases un-awaited when
+    iteration N+1 begins (its instrumentation span armed with nothing to
+    disarm it, its phases dead-code-eliminated out of the carry).  Spans
+    must open AND close within one iteration; a span fully inside the
+    loop body (start and wait under the same loop id) is legal and
+    overlaps per-iteration.
+    """
+    spans: dict = {}
+    for e in graph.events:
+        if e.span is not None:
+            spans.setdefault(e.span, []).append(e)
+    findings: List[Finding] = []
+    for span_id, events in sorted(spans.items()):
+        loops = {e.loop for e in events}
+        if loops == {None}:
+            continue  # span entirely outside any megastep: MPX112 domain
+        first = events[0]
+        starts = [e for e in events if e.op.endswith("_start")]
+        waits = [e for e in events if e.op.endswith("_wait")]
+        if len(loops) > 1:
+            where = ("the start is inside the loop body and the wait "
+                     "outside (or in a different loop)"
+                     if starts and starts[0].loop is not None
+                     else "the wait is inside the loop body but its "
+                     "start is not")
+            findings.append(Finding(
+                code="MPX130", op=first.op, index=first.index,
+                message=(f"async span {span_id} ({first.op} on comm "
+                         f"{first.comm_uid}) straddles a megastep loop "
+                         f"boundary: {where}"),
+                suggestion=("keep each *_start/*_wait pair inside one "
+                            "loop iteration (overlap is per-iteration "
+                            "in a megastep), or drop unroll= for this "
+                            "program — docs/aot.md 'Megastep "
+                            "execution'"),
+            ))
+        elif not (starts and waits):
+            missing = "*_wait" if starts else "*_start"
+            findings.append(Finding(
+                code="MPX130", op=first.op, index=first.index,
+                message=(f"async span {span_id} ({first.op} on comm "
+                         f"{first.comm_uid}) opens inside a megastep "
+                         f"loop body with no matching {missing} in the "
+                         "same iteration: the span straddles the loop "
+                         "boundary by construction"),
+                suggestion=("issue the matching start/wait inside the "
+                            "same loop iteration, or drop unroll= for "
+                            "this program — docs/aot.md 'Megastep "
+                            "execution'"),
+            ))
     return findings
 
 

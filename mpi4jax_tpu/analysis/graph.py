@@ -48,6 +48,13 @@ class CollectiveEvent:
     token_out: Optional[int] = None
     eager: bool = False
     span: Optional[int] = None          # async start/wait pairing handle id
+    # megastep loop scope (parallel/megastep.py): the loop id of the
+    # device-resident fori_loop body this op was traced inside, and its
+    # trip count.  None outside any megastep.  MPX130 errors on async
+    # spans straddling a loop boundary; MPX128 skips loop-body events
+    # (the body traces ONCE — it is not an unrolled Python loop).
+    loop: Optional[int] = None
+    unroll: Optional[int] = None
     fused_members: Optional[int] = None  # member ops packed into this op
     fused_bytes: Optional[int] = None   # flat-buffer payload bytes
     # per-member (dtype, nelems) composition of a fused flat buffer — the

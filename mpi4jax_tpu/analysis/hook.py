@@ -93,6 +93,15 @@ def config_snapshot() -> dict:
         pinned = tracing_pinned()
     except ImportError:
         pinned = False
+    # is this trace inside a megastep loop body right now?  Meta-level
+    # twin of the per-event ``loop`` stamp (guarded for the same
+    # isolated-loader reason as the aot import above).
+    try:
+        from ..parallel.megastep import tracing_megastep
+
+        megastep = tracing_megastep()
+    except ImportError:
+        megastep = False
     return {
         "collective_algo": config.collective_algo(),
         "ring_crossover_bytes": config.ring_crossover_bytes(),
@@ -102,6 +111,7 @@ def config_snapshot() -> dict:
         "fusion_bucket_bytes": config.fusion_bucket_bytes(),
         "epoch": current_epoch(),
         "pinned": pinned,
+        "megastep": megastep,
     }
 
 
@@ -185,6 +195,12 @@ def begin_event(opname: str, comm, arrays, token, ana: Optional[dict],
         drained=bool(getattr(comm, "drained", False)),
         groups=static_groups_for(comm),
     )
+    # megastep loop scope (parallel/megastep.py _loop_trace_scope): ops
+    # traced inside a device-resident loop body carry their loop id and
+    # trip count, the MPX130/MPX128 discriminator
+    ms = getattr(ctx, "megastep", None) if ctx is not None else None
+    if ms is not None:
+        evt.loop, evt.unroll = ms
     if ana:
         for k, v in ana.items():
             setattr(evt, k, v)

@@ -224,6 +224,18 @@ CODES = {
             "mpx.compile; mpx.elastic.run re-pins step functions "
             "automatically).",
         ),
+        CodeInfo(
+            "MPX130", "async span straddles a megastep loop boundary", ERROR,
+            "An async *_start/*_wait span crosses a megastep loop "
+            "boundary (mpx.compile/mpx.spmd unroll=N, "
+            "parallel/megastep.py): the loop body traces once, so a "
+            "start whose wait is not in the same iteration leaves every "
+            "iteration's collective phases un-awaited at run time — "
+            "instrumentation armed with nothing to disarm it, phases "
+            "dead-code-eliminated out of the carry.  Keep each span "
+            "inside one iteration (overlap is per-iteration in a "
+            "megastep), or drop unroll= for this program.",
+        ),
     )
 }
 

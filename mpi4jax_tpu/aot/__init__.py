@@ -6,13 +6,17 @@ artifact with donated buffers and zero per-call key computation, and
 identical SPMD programs should never be re-lowered on every rank of a
 multi-host cold start.
 
-- ``mpx.compile(fn, *abstract_args, comm=..., donate_argnums=...)``
-  -> :class:`PinnedProgram` (pinning.py);
-- ``mpx.aot.compile_step(fn)`` — the elastic adapter: pinned step
-  functions that ``mpx.elastic.run`` re-pins across epoch changes;
+- ``mpx.compile(fn, *abstract_args, comm=..., donate_argnums=...,
+  unroll=N)`` -> :class:`PinnedProgram` (pinning.py; ``unroll=N`` pins
+  a device-resident megastep — parallel/megastep.py), driven through
+  jax's C++ fast-path dispatch where available (fastpath.py);
+- ``mpx.aot.compile_step(fn, unroll=N)`` — the elastic adapter: pinned
+  (mega)step functions that ``mpx.elastic.run`` re-pins across epoch
+  changes;
 - ``MPI4JAX_TPU_COMPILE_CACHE_DIR`` — the persistent tier (diskcache.py
   + serialization.py), also consulted by ``mpx.spmd``'s program cache
-  on miss;
+  on miss, pre-populated fleet-wide by the cache-warming CLI
+  (``python -m mpi4jax_tpu.aot warm manifest.json``, warm.py);
 - staleness (invalidation.py): :class:`StaleProgramError` (MPX129) when
   a pinned program is called after a config-stamp or elastic-epoch
   change.
@@ -22,7 +26,7 @@ invalidation rules, the multi-host cold-start recipe, flag table).
 """
 
 from .invalidation import StaleProgramError, WorldStamp  # noqa: F401
-from . import diskcache, keys  # noqa: F401
+from . import diskcache, fastpath, keys, warm  # noqa: F401
 from .pinning import (  # noqa: F401
     ElasticStep,
     PinnedProgram,

@@ -1,0 +1,58 @@
+"""AOT CLI: ``python -m mpi4jax_tpu.aot warm manifest.json``.
+
+Pre-populates the persistent compiled-program cache
+(``MPI4JAX_TPU_COMPILE_CACHE_DIR``) from a program manifest — the fleet
+cold-start recipe of docs/aot.md run ahead of the fleet, so the first
+real boot of every rank deserializes instead of lowering.
+
+Exit codes: 0 = every program warmed; 1 = some program failed to
+import/pin (the rest were still attempted; failures are listed); 2 =
+the manifest is unreadable/malformed or the cache dir is unset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.aot",
+        description="AOT compiled-program cache tools (docs/aot.md)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    warm_p = sub.add_parser(
+        "warm",
+        help="pre-populate MPI4JAX_TPU_COMPILE_CACHE_DIR from a program "
+             "manifest (fn import path + abstract shapes per program)",
+    )
+    warm_p.add_argument("manifest", help="path to the manifest JSON")
+    warm_p.add_argument("--json", action="store_true",
+                        help="machine-readable result payload on stdout")
+    args = parser.parse_args(argv)
+
+    from .warm import warm_from_manifest
+
+    code, payload = warm_from_manifest(args.manifest)
+    if args.json:
+        print(json.dumps(payload))
+    else:
+        if "error" in payload:
+            print(f"warm: {payload['error']}", file=sys.stderr)
+        else:
+            for row in payload["programs"]:
+                src = "disk" if row["from_disk"] else "compiled"
+                extra = f", unroll={row['unroll']}" if row["unroll"] > 1 else ""
+                print(f"warmed {row['fn']} ({src}{extra}, "
+                      f"{row['pin_wall_s']}s)")
+            for row in payload["failures"]:
+                print(f"FAILED {row['fn']}: {row['error']}", file=sys.stderr)
+            print(f"warm: {payload['warmed']} warmed, "
+                  f"{payload['failed']} failed")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

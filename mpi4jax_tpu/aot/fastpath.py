@@ -1,0 +1,77 @@
+"""C++ fast-path dispatch for pinned executables.
+
+``jax.jit`` owes its low per-call overhead to a C++ dispatch path
+(``xla_client._xla.pjit``): argument flattening, signature matching, and
+executable invocation all happen below Python.  The AOT surface exposes
+the same machinery — ``MeshExecutable.create_cpp_call(no_kwargs,
+in_tree, out_tree)`` builds a C++-backed callable for a compiled
+executable — but ``jax.stages.Compiled.__call__`` still runs a Python
+prologue per call (tree flatten, signature check, error mapping).  For a
+:class:`~.pinning.PinnedProgram` that prologue is the LAST per-call
+Python cost after PR 10 removed key work, so the pin path routes through
+the C++ callable whenever the running jax/jaxlib exposes it.
+
+Everything here is best-effort by design: the factory is a private jax
+surface that has moved between releases, so every probe is wrapped and
+ANY failure falls back to the plain ``Compiled`` call — a pinned program
+never breaks because a jaxlib lacks the fast path, it just dispatches
+through Python (``MPI4JAX_TPU_CPP_DISPATCH=false`` forces that fallback
+explicitly).  Imports of jax internals are lazy and guarded, so this
+module loads under the isolated test loader without jax
+(tests/test_megastep_pure.py drives :func:`cpp_call_for` with fakes).
+"""
+
+from __future__ import annotations
+
+__all__ = ["cpp_call_for", "supported"]
+
+
+def _trees(compiled):
+    """(in_tree, out_tree) of a ``Compiled``, probing the public
+    properties first and the param record older releases kept them on."""
+    in_tree = getattr(compiled, "in_tree", None)
+    out_tree = getattr(compiled, "out_tree", None)
+    if in_tree is None or out_tree is None:
+        params = getattr(compiled, "_params", None)
+        if in_tree is None:
+            in_tree = getattr(params, "in_tree", None)
+        if out_tree is None:
+            out_tree = getattr(params, "out_tree", None)
+    return in_tree, out_tree
+
+
+def cpp_call_for(compiled):
+    """Best-effort C++ fast-path callable for a ``jax.stages.Compiled``.
+
+    Returns ``(call, used_fastpath)``: ``call`` is the C++-backed
+    callable when the executable exposes ``create_cpp_call`` and the
+    factory succeeds, else ``compiled`` itself; ``used_fastpath`` says
+    which.  Pinned calls are positional-only, so the factory is asked
+    for the ``no_kwargs`` form.
+    """
+    try:
+        exe = getattr(compiled, "_executable", None)
+        factory = getattr(exe, "create_cpp_call", None)
+        if factory is None:
+            return compiled, False
+        in_tree, out_tree = _trees(compiled)
+        if in_tree is None or out_tree is None:
+            return compiled, False
+        fast = factory(True, in_tree, out_tree)
+        if not callable(fast):
+            return compiled, False
+        return fast, True
+    except Exception:
+        # a moved private surface must degrade to the Python call path,
+        # never take the pin down
+        return compiled, False
+
+
+def supported(compiled) -> bool:
+    """Non-installing probe: would :func:`cpp_call_for` hand back a C++
+    callable for this executable's shape of object?"""
+    exe = getattr(compiled, "_executable", None)
+    if getattr(exe, "create_cpp_call", None) is None:
+        return False
+    in_tree, out_tree = _trees(compiled)
+    return in_tree is not None and out_tree is not None

@@ -54,7 +54,8 @@ __all__ = ["PinnedProgram", "compile", "compile_step", "stats",
 
 
 class _Stats:
-    __slots__ = ("pins", "calls", "stale_raises", "disk_loads", "compiles")
+    __slots__ = ("pins", "calls", "stale_raises", "disk_loads", "compiles",
+                 "fast_path_pins", "warmed")
 
     def __init__(self):
         self.reset()
@@ -65,6 +66,8 @@ class _Stats:
         self.stale_raises = 0
         self.disk_loads = 0
         self.compiles = 0
+        self.fast_path_pins = 0
+        self.warmed = 0
 
 
 _stats = _Stats()
@@ -74,7 +77,9 @@ def stats() -> dict:
     """AOT-layer counters: ``pins`` (programs pinned), ``calls`` (pinned
     executions), ``stale_raises`` (MPX129 refusals), ``disk_loads``
     (pins served by deserializing a persistent artifact), ``compiles``
-    (pins that lowered+compiled fresh)."""
+    (pins that lowered+compiled fresh), ``fast_path_pins`` (pins driven
+    through jax's C++ fast-path dispatch — aot/fastpath.py), ``warmed``
+    (programs pre-compiled by the cache-warming CLI — aot/warm.py)."""
     return {k: getattr(_stats, k) for k in _Stats.__slots__}
 
 
@@ -269,6 +274,19 @@ def _pin_executable(jitted, mesh, avals, label: str,
         return compiled, key, False
 
 
+def _dispatch_call(compiled):
+    """The call the hot loop will drive: jax's C++ fast-path dispatch
+    when available and not disabled (``MPI4JAX_TPU_CPP_DISPATCH``), else
+    the plain ``Compiled`` — returns ``(call, used_fastpath)``."""
+    from ..utils.config import cpp_dispatch
+
+    if not cpp_dispatch():
+        return compiled, False
+    from . import fastpath
+
+    return fastpath.cpp_call_for(compiled)
+
+
 def through_disk_cache(jitted, c, label: str = "fn"):
     """Route a jitted SPMD program through the persistent tier (the
     ``mpx.spmd`` program-cache miss hook, parallel/region.py).
@@ -291,6 +309,9 @@ def through_disk_cache(jitted, c, label: str = "fn"):
         if call is None:
             call, _, _ = _pin_executable(jitted, mesh, _abstract(args),
                                          label, mark_pinned=False)
+            # spmd misses served through the disk tier get the same C++
+            # fast-path dispatch a pin would (fallback: the Compiled)
+            call, _ = _dispatch_call(call)
             memo[sig] = call
         return call(*args)
 
@@ -308,20 +329,27 @@ class PinnedProgram:
     ``program(*dynamic_args)`` validates the captured world — one epoch
     int compare plus one raw-environment fingerprint compare; no flag
     parsing, no key hashing, no cache probe — and executes the pinned
-    executable.  A moved world (config stamp or elastic epoch) raises
+    executable.  Where the running jaxlib exposes the C++ fast-path
+    dispatch (aot/fastpath.py; ``fast_path`` records it), that execution
+    is ONE C++ call — no Python tree flattening or signature re-checking
+    either.  A moved world (config stamp or elastic epoch) raises
     :class:`StaleProgramError` (MPX129); ``repin()`` rebuilds against
     the current world.
 
     Static arguments were folded at pin time: call with the dynamic
     arguments only, shaped exactly like the abstract templates given to
     :func:`compile` (an AOT executable accepts exactly one signature).
+    ``unroll`` records the megastep trip count (1 = single-step): a
+    megastep program runs ``unroll`` state iterations per call and
+    returns the final carry (docs/aot.md "Megastep execution").
     """
 
     __slots__ = ("_call", "_world", "_stats", "_respec", "fn_name", "key",
-                 "from_disk", "donate_argnums")
+                 "from_disk", "donate_argnums", "fast_path", "unroll")
 
     def __init__(self, call, world: WorldStamp, respec, fn_name: str,
-                 key, from_disk: bool, donate_argnums):
+                 key, from_disk: bool, donate_argnums,
+                 fast_path: bool = False, unroll: int = 1):
         self._call = call
         self._world = world
         self._stats = _stats
@@ -330,6 +358,8 @@ class PinnedProgram:
         self.key = key
         self.from_disk = from_disk
         self.donate_argnums = donate_argnums
+        self.fast_path = fast_path
+        self.unroll = unroll
 
     def __call__(self, *args):
         world = self._world
@@ -354,6 +384,8 @@ class PinnedProgram:
         src = "disk" if self.from_disk else "compiled"
         return (f"PinnedProgram({self.fn_name!r}, {src}, "
                 f"epoch={self._world.epoch}"
+                + (f", unroll={self.unroll}" if self.unroll > 1 else "")
+                + (", cpp" if self.fast_path else "")
                 + (", STALE" if self.is_stale() else "") + ")")
 
 
@@ -376,14 +408,15 @@ def _normalize_statics(static_argnums, nargs: int) -> tuple:
 
 def compile(fn, *abstract_args, comm=None, donate_argnums=(),
             static_argnums=None, in_specs=None, out_specs=None,
-            wrap: Optional[bool] = None) -> PinnedProgram:
+            wrap: Optional[bool] = None,
+            unroll: Optional[int] = None) -> PinnedProgram:
     """Pin ``fn(*abstract_args)`` to a fully compiled executable.
 
     ``fn`` follows the same three conventions as ``mpx.analyze``:
 
     - an ``mpx.spmd``-decorated function: pinned as-is (its comm,
-      specs, and static_argnums breadcrumbs are adopted; pass overrides
-      to replace them);
+      specs, static_argnums, and unroll breadcrumbs are adopted; pass
+      overrides to replace them);
     - a plain per-rank function: wrapped over ``comm`` (or the default
       comm) exactly like ``mpx.spmd`` would — same region body, same
       HLO;
@@ -397,10 +430,20 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
     indexes the original argument positions; donated buffers are reused
     for outputs (the hot-loop double-buffer idiom).
 
+    ``unroll=N`` (N > 1) pins a **megastep**: the body is rewritten into
+    a device-resident ``lax.fori_loop`` over N iterations with the
+    dynamic arguments as the carry, so each pinned call executes N steps
+    for one host dispatch — the per-step host cost falls as 1/N
+    (docs/aot.md "Megastep execution"; requires the region convention,
+    not ``wrap=False``).  ``None`` resolves
+    ``MPI4JAX_TPU_UNROLL_DEFAULT`` (1 = single-step, trace and HLO
+    byte-identical to a pin without the megastep layer).
+
     With ``MPI4JAX_TPU_COMPILE_CACHE_DIR`` set, the lowered+compiled
     artifact is served from / written to the persistent cache
     (docs/aot.md); the call path is identical either way.
     """
+    from ..parallel.megastep import validate_unroll
     from ..parallel.region import (
         make_region_body,
         region_axes_spec,
@@ -409,7 +452,7 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
 
     spec = dict(comm=comm, donate_argnums=donate_argnums,
                 static_argnums=static_argnums, in_specs=in_specs,
-                out_specs=out_specs, wrap=wrap)
+                out_specs=out_specs, wrap=wrap, unroll=unroll)
 
     inner = fn
     if wrap is None:
@@ -425,6 +468,18 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
             out_specs = crumbs.get("out_specs")
         if static_argnums is None:
             static_argnums = crumbs.get("static_argnums")
+        if unroll is None:
+            unroll = crumbs.get("unroll")
+    # only an EXPLICIT unroll= errors on a shape that cannot carry the
+    # loop (wrap=False, no dynamic args); the MPI4JAX_TPU_UNROLL_DEFAULT
+    # fleet default degrades those to a single-step pin instead
+    explicit_unroll = unroll is not None
+    if explicit_unroll:
+        n_unroll = validate_unroll(unroll)
+    else:
+        from ..utils.config import unroll_default
+
+        n_unroll = unroll_default()
     name = getattr(inner, "__name__", "fn")
 
     donate = _normalize_statics(donate_argnums, len(abstract_args)) \
@@ -439,6 +494,16 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
 
     c = resolve_comm(comm)
     if wrap is False:
+        if n_unroll > 1:
+            if not explicit_unroll:
+                n_unroll = 1
+            else:
+                raise ValueError(
+                    "mpx.compile(unroll=N) needs the region calling "
+                    "convention (a per-rank or spmd-decorated function): "
+                    "an eager-style wrap=False function has no per-rank "
+                    "carry to thread through the device-resident loop"
+                )
         if c.mesh is None and comm is not None:
             raise RuntimeError(
                 "mpx.compile(wrap=False) with an explicit comm needs it "
@@ -475,9 +540,18 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
         axes_spec = region_axes_spec(c)
         ispecs = in_specs if in_specs is not None else axes_spec
         ospecs = out_specs if out_specs is not None else axes_spec
+        if n_unroll > 1 and not dyn_args:
+            if not explicit_unroll:
+                n_unroll = 1
+            else:
+                raise ValueError(
+                    "mpx.compile(unroll=N) needs at least one dynamic "
+                    "argument to carry through the device-resident loop"
+                )
         body = make_region_body(
             inner, c, statics, static_vals, (), len(dyn_args),
             squeeze_in=in_specs is None, squeeze_out=out_specs is None,
+            unroll=n_unroll,
         )
         sm = jax.shard_map(body, mesh=c.mesh, in_specs=ispecs,
                            out_specs=ospecs)
@@ -489,13 +563,17 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
     # stamp that (correctly, conservatively) refuses the first call
     world = WorldStamp.capture()
     call, key, from_disk = _pin_executable(jitted, mesh, trace_args, name)
+    call, fast = _dispatch_call(call)
     _stats.pins += 1
+    if fast:
+        _stats.fast_path_pins += 1
     _meter("aot.pins")
 
     def respec():
         return compile(fn, *abstract_args, **spec)
 
-    return PinnedProgram(call, world, respec, name, key, from_disk, donate)
+    return PinnedProgram(call, world, respec, name, key, from_disk, donate,
+                         fast_path=fast, unroll=n_unroll)
 
 
 # ---------------------------------------------------------------------------
@@ -522,11 +600,24 @@ class ElasticStep:
     drops the pin; ``mpx.elastic.run`` performs exactly that dance
     automatically, so an elastic loop keeps its pinned hot path across
     epochs without serving a single old-world execution.
+
+    ``unroll=N`` pins a **megastep** step: each call executes N
+    consecutive ``fn(state, step + i, comm)`` iterations device-resident
+    (``lax.fori_loop``; the step index rides in the carry) and returns
+    the state after step ``step + N``.  ``mpx.elastic.run`` reads the
+    ``unroll`` attribute, aligns ``commit_every`` up to a multiple of N,
+    and advances its step counter by N per call; a mid-megastep
+    ``StaleProgramError`` retries the whole megastep from the same
+    state — restart-idempotent by construction, since state only commits
+    at megastep boundaries (docs/aot.md "Megastep execution").
     """
 
-    def __init__(self, fn, donate_state: bool = False):
+    def __init__(self, fn, donate_state: bool = False, unroll: int = 1):
+        from ..parallel.megastep import validate_unroll
+
         self._fn = fn
         self._donate_state = donate_state
+        self.unroll = validate_unroll(unroll)
         self._pinned: Optional[PinnedProgram] = None
         self._world_key = None
 
@@ -560,13 +651,32 @@ class ElasticStep:
         k = comm.world_size()
         g = self._tile(state, k)
         if pinned is None:
+            n_unroll = self.unroll
+
             def per_rank(st, step_scalar):
-                return self._fn(st, step_scalar, comm)
+                if n_unroll == 1:
+                    return self._fn(st, step_scalar, comm)
+                from ..parallel.megastep import megastep_loop
+
+                # the megastep form: N device-resident iterations with
+                # the state as the carry; the step index advances inside
+                # the loop, so one pinned call covers steps
+                # [step, step + N)
+                def one(i, carry):
+                    return self._fn(carry, step_scalar + i, comm)
+
+                return megastep_loop(
+                    one, st, n_unroll, comm,
+                    label=getattr(self._fn, "__name__", "fn"))
 
             per_rank.__name__ = getattr(self._fn, "__name__", "fn")
+            # unroll=1 here on purpose: the loop (when any) is built
+            # above — a non-1 MPI4JAX_TPU_UNROLL_DEFAULT must not wrap a
+            # second fori_loop around it
             self._pinned = compile(
                 per_rank, g, self._step_array(comm, step), comm=comm,
                 donate_argnums=(0,) if self._donate_state else (),
+                unroll=1,
             )
             self._world_key = (comm.uid, getattr(comm, "epoch", 0))
             pinned = self._pinned
@@ -581,10 +691,14 @@ class ElasticStep:
         return self
 
 
-def compile_step(fn, *, donate_state: bool = False) -> ElasticStep:
+def compile_step(fn, *, donate_state: bool = False,
+                 unroll: int = 1) -> ElasticStep:
     """Adapt a per-rank ``fn(state, step, comm)`` for ``mpx.elastic.run``
     with a pinned hot path: see :class:`ElasticStep` (replicated-state
     contract).  ``donate_state`` donates the tiled state buffers into
     each step (they are rebuilt per call, so donation is safe) — the
-    double-buffer idiom."""
-    return ElasticStep(fn, donate_state=donate_state)
+    double-buffer idiom.  ``unroll=N`` makes each pinned call a megastep
+    of N device-resident iterations; ``mpx.elastic.run`` aligns its
+    commit cadence to the megastep boundary automatically (docs/aot.md
+    "Megastep execution")."""
+    return ElasticStep(fn, donate_state=donate_state, unroll=unroll)

@@ -110,7 +110,7 @@ def region_axes_spec(c: Comm):
 
 
 def make_region_body(f, c: Comm, statics, static_vals, kw_names, n_dyn,
-                     squeeze_in: bool, squeeze_out: bool):
+                     squeeze_in: bool, squeeze_out: bool, unroll: int = 1):
     """Build the per-rank region body ``spmd`` traces: argument
     re-interleaving, the region context push/pop, fusion drain, pending
     tokenless-barrier tie-in, and the trace-time verifier hooks.
@@ -119,6 +119,15 @@ def make_region_body(f, c: Comm, statics, static_vals, kw_names, n_dyn,
     layer (``mpi4jax_tpu/aot/pinning.py``), so a pinned program traces
     the IDENTICAL body a cached ``spmd`` program would — same HLO, same
     jaxpr fingerprint, same persistent-cache artifact.
+
+    ``unroll > 1`` rewrites the body into a device-resident megastep
+    loop (parallel/megastep.py): the dynamic positional arguments become
+    the ``lax.fori_loop`` carry and ``f`` runs once per iteration — one
+    host dispatch executes ``unroll`` steps.  ``f`` must map its dynamic
+    arguments to a like-structured pytree (the carry contract;
+    docs/aot.md "Megastep execution").  ``unroll == 1`` keeps the exact
+    single-step body — trace and HLO byte-identical to before the
+    megastep layer existed.
     """
 
     def body(*a):
@@ -136,7 +145,36 @@ def make_region_body(f, c: Comm, statics, static_vals, kw_names, n_dyn,
             full = list(pos)
             for i, v in zip(statics, static_vals):
                 full.insert(i, v)
-            out = f(*full, **kw)
+            if unroll > 1:
+                from .megastep import megastep_loop
+
+                label = getattr(f, "__name__", "fn")
+
+                def one(_i, carry):
+                    it_full = list(carry)
+                    for si, v in zip(statics, static_vals):
+                        it_full.insert(si, v)
+                    r = f(*it_full)
+                    if n_dyn == 1:
+                        return (r,)
+                    if (not isinstance(r, (tuple, list))
+                            or len(r) != n_dyn):
+                        raise ValueError(
+                            f"megastep carry contract violated in "
+                            f"{label!r}: with unroll={unroll} and "
+                            f"{n_dyn} dynamic arguments the step must "
+                            f"return a matching {n_dyn}-tuple of new "
+                            "states, got "
+                            f"{type(r).__name__} (docs/aot.md "
+                            "'Megastep execution')"
+                        )
+                    return tuple(r)
+
+                final = megastep_loop(one, tuple(pos), unroll, c,
+                                      label=label)
+                out = final[0] if n_dyn == 1 else final
+            else:
+                out = f(*full, **kw)
             # drain the fusion queue and force any deferred
             # results: region outputs must be real arrays
             # before they cross the shard_map boundary
@@ -173,6 +211,7 @@ def spmd(
     out_specs: Any = None,
     jit: bool = True,
     static_argnums=(),
+    unroll: Optional[int] = None,
 ):
     """Turn a per-rank function into an SPMD program over ``comm``'s mesh.
 
@@ -183,6 +222,14 @@ def spmd(
 
     Inside the body, ops called with ``comm=None`` use this region's comm, and
     ``send``/``recv`` matching is scoped to the region.
+
+    ``unroll=N`` (N > 1) compiles a **megastep**: the body becomes a
+    device-resident ``lax.fori_loop`` over N iterations with the dynamic
+    positional arguments as the carry, so one host call runs N steps
+    (docs/aot.md "Megastep execution").  The step must map its dynamic
+    arguments to a like-structured pytree, and keyword arguments are not
+    accepted in megastep mode.  ``None`` (default) resolves
+    ``MPI4JAX_TPU_UNROLL_DEFAULT`` (1 = off — body and HLO unchanged).
     """
 
     def wrap(f):
@@ -254,6 +301,33 @@ def spmd(
                     "entries cannot be matched to keywords"
                 )
             n_dyn = len(dyn_args)
+            from .megastep import validate_unroll
+
+            if unroll is not None:
+                n_unroll = validate_unroll(unroll)
+            else:
+                from ..utils.config import unroll_default
+
+                n_unroll = unroll_default()
+            if n_unroll > 1 and (kw_names or n_dyn == 0):
+                # only an EXPLICIT unroll= is a contract error here: a
+                # fleet-wide MPI4JAX_TPU_UNROLL_DEFAULT must not break
+                # unrelated programs that cannot carry a megastep loop —
+                # those degrade to the single-step body
+                if unroll is None:
+                    n_unroll = 1
+                elif kw_names:
+                    raise TypeError(
+                        "spmd(unroll=N) takes positional arguments only "
+                        f"(got keyword argument(s) {kw_names}): the "
+                        "megastep carry is the dynamic positional tuple"
+                    )
+                else:
+                    raise ValueError(
+                        "spmd(unroll=N) needs at least one dynamic "
+                        "argument to carry through the device-resident "
+                        "loop"
+                    )
             # every dynamically-read flag that shapes the trace must be in
             # the key (mirrors _eager_cache in ops/_base.py), or toggling
             # tracing/logging/prefer_notoken after the first call would
@@ -266,7 +340,7 @@ def spmd(
 
             dyn_token, analysis_off, _ = _dynamic_state()
             key = (c.mesh, c.uid, statics, static_vals, kw_names, n_dyn,
-                   dyn_token)
+                   n_unroll, dyn_token)
             sm = program_cache.get(key)
             if not analysis_off:
                 # ambient cross-rank pass (analysis/crossrank.py): runs
@@ -306,6 +380,7 @@ def spmd(
                     f, c, statics, static_vals, kw_names, n_dyn,
                     squeeze_in=in_specs is None,
                     squeeze_out=out_specs is None,
+                    unroll=n_unroll,
                 )
                 sm = jax.shard_map(
                     body, mesh=c.mesh, in_specs=ispecs, out_specs=ospecs
@@ -337,7 +412,7 @@ def spmd(
         wrapped._mpx_fn = f
         wrapped._mpx_spmd_kwargs = dict(
             comm=comm, in_specs=in_specs, out_specs=out_specs,
-            static_argnums=statics_raw,
+            static_argnums=statics_raw, unroll=unroll,
         )
         return wrapped
 

@@ -1842,6 +1842,16 @@ def revoke_epoch(failed: Iterable[int], *, rank: int = 0,
 # ---------------------------------------------------------------------------
 
 
+def align_commit_every(commit_every: int, unroll: int) -> int:
+    """Round a commit interval UP to a multiple of the megastep trip
+    count: state only exists at megastep boundaries (the loop body is
+    device-resident, aot/pinning.py ``ElasticStep``), so commits can
+    only land there.  Pure — shared with tests/test_megastep_pure.py."""
+    if unroll <= 1:
+        return commit_every
+    return ((commit_every + unroll - 1) // unroll) * unroll
+
+
 def run(step_fn, state, store: ShardStore, *, steps: int,
         start_step: int = 0, commit_every: int = 1,
         claim_watchdog: bool = True, drain_on_sigterm: bool = True):
@@ -1889,6 +1899,28 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
     if commit_every < 1:
         raise ValueError(f"commit_every must be >= 1, got {commit_every}")
 
+    # megastep granularity (docs/aot.md "Megastep execution"): a step_fn
+    # advertising ``unroll`` (mpx.aot.compile_step(fn, unroll=N)) runs N
+    # steps per call, so the loop advances by N, commits land on
+    # megastep boundaries (commit_every rounded UP to a multiple of N),
+    # and a StaleProgramError mid-megastep retries the WHOLE megastep
+    # from the un-advanced state — restart-idempotent by construction,
+    # since state only commits at boundaries.
+    stride = getattr(step_fn, "unroll", 1) or 1
+    try:
+        stride = max(1, int(stride))
+    except (TypeError, ValueError):
+        stride = 1
+    if stride > 1:
+        if (steps - start_step) % stride:
+            raise ValueError(
+                f"steps - start_step ({steps - start_step}) must be a "
+                f"multiple of the step function's megastep unroll "
+                f"({stride}): a pinned megastep cannot run a partial "
+                "trip (pad the budget or drop unroll)"
+            )
+        commit_every = align_commit_every(commit_every, stride)
+
     # the AOT layer's staleness signal (aot/invalidation.py): a pinned
     # step function refuses execution after an epoch/config change with
     # StaleProgramError (MPX129), and THIS loop is the re-entry point —
@@ -1931,7 +1963,7 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
             try:
                 state = step_fn(state, step, store.comm)
                 _block_on(state)
-                step += 1
+                step += stride
                 committed = False
                 if (step - start_step) % commit_every == 0 or step == steps:
                     store.commit(step, state)

@@ -165,6 +165,17 @@ class _Journal:
                         record.get("dtype", "")),
             record["latency"],
         )
+        # megastep brackets (parallel/megastep.py) synthesize a per-step
+        # latency estimate on close: bracket latency / trip count into
+        # the megastep_step histogram — pure host bucket math, no extra
+        # io_callbacks on the hot path
+        unroll = record.get("unroll")
+        if unroll and unroll > 1 and record.get("op") == "megastep":
+            core.record_latency(
+                core.op_key("megastep_step", record.get("comm_uid", "?"),
+                            "estimate", ""),
+                record["latency"] / unroll,
+            )
 
     def instant(self, name: str, rank: int, meta: dict) -> None:
         mono, wall = _clocks()

@@ -279,6 +279,25 @@ FLAGS = {
              "independent double-buffered ring pipelines so the XLA "
              "scheduler can interleave independent compute between "
              "chunk phases.  Default 2."),
+        Flag("MPI4JAX_TPU_UNROLL_DEFAULT", "int", 1,
+             "Default megastep unroll factor (parallel/megastep.py): "
+             "``mpx.spmd`` / ``mpx.compile`` calls without an explicit "
+             "``unroll=`` keep N step iterations device-resident per "
+             "host dispatch by rewriting the step body into a "
+             "``lax.fori_loop`` carry (docs/aot.md 'Megastep "
+             "execution').  1 (default) disables the rewrite — the "
+             "traced body and HLO are byte-identical to a build "
+             "without the megastep layer."),
+        Flag("MPI4JAX_TPU_CPP_DISPATCH", "bool", True,
+             "Drive pinned executables (``mpx.compile`` -> "
+             "``PinnedProgram``) through jax's C++ fast-path dispatch "
+             "(``MeshExecutable.create_cpp_call``) where the installed "
+             "jaxlib supports it, so a pinned call costs one "
+             "world-stamp check plus one C++ call "
+             "(mpi4jax_tpu/aot/fastpath.py).  ``false`` forces the "
+             "plain Python ``Compiled`` call path (debugging, or a "
+             "jaxlib whose fast path misbehaves).  Never shapes a "
+             "trace: flipping it does not stale live pins."),
     )
 }
 
@@ -685,6 +704,21 @@ def compile_cache_max_bytes() -> int:
         "MPI4JAX_TPU_COMPILE_CACHE_MAX_BYTES",
         DEFAULT_COMPILE_CACHE_MAX_BYTES,
     )
+
+
+def unroll_default() -> int:
+    """Default megastep unroll factor (``MPI4JAX_TPU_UNROLL_DEFAULT``;
+    default 1 = no device-resident loop — see parallel/megastep.py and
+    docs/aot.md 'Megastep execution')."""
+    return _parse_env_positive_int("MPI4JAX_TPU_UNROLL_DEFAULT", 1,
+                                   minimum=1)
+
+
+def cpp_dispatch() -> bool:
+    """Whether pinned executables use jax's C++ fast-path dispatch where
+    available (``MPI4JAX_TPU_CPP_DISPATCH``; default on — see
+    mpi4jax_tpu/aot/fastpath.py)."""
+    return parse_env_bool("MPI4JAX_TPU_CPP_DISPATCH", True)
 
 
 def prefer_notoken() -> bool:

@@ -124,6 +124,28 @@ def test_bench_dispatch_schema():
     assert mpx.cache_stats()["aot"]["pins"] >= 1
 
 
+def test_bench_dispatch_unroll_schema():
+    # compiles the same one-allreduce step pinned at two megastep trip
+    # counts (mpx.compile(fn, ..., unroll=N)) — a megastep lowering or
+    # amortization-math regression fails here, fast (docs/aot.md
+    # "Megastep execution"); the full 1/8 amortization assert at
+    # unroll=64 lives in the CI aot lane against the saved sweep
+    comm = _world_comm()
+    du = micro.bench_dispatch_unroll(comm, unrolls=(1, 4), size_kb=0.004,
+                                     iters=3)
+    assert set(du) == {"size_kb", "onchip_per_step_us", "rows"}
+    assert du["onchip_per_step_us"] >= 0
+    assert [r["unroll"] for r in du["rows"]] == [1, 4]
+    for r in du["rows"]:
+        assert r["megastep_us"] > 0 and r["per_step_us"] > 0
+        assert r["per_step_host_us"] >= 0
+        assert isinstance(r["fast_path"], bool)
+    # amortization direction: per-step host cost must not grow with N
+    assert (du["rows"][1]["per_step_host_us"]
+            <= du["rows"][0]["per_step_host_us"] + 1e-9)
+    assert mpx.cache_stats()["aot"]["pins"] >= 2
+
+
 def test_save_results_roundtrip(tmp_path):
     import json
 
