@@ -465,6 +465,116 @@ def bench_dispatch_unroll(comm, unrolls=(1, 8, 64), size_kb=0.004,
     }
 
 
+def fit_alpha_beta(points):
+    """Least-squares fit of the alpha-beta line ``t_us = alpha_us +
+    bytes / (gb_per_s * 1e3)`` over ``points`` = [(bytes, us), ...].
+    Returns ``(alpha_us, gb_per_s)``, clamped into the cost-model
+    schema's valid ranges (a tiny sweep can fit a negative intercept or
+    a non-positive slope; the emitted file must still load verbatim)."""
+    xs = np.asarray([p[0] for p in points], dtype=float)
+    ys = np.asarray([p[1] for p in points], dtype=float)
+    if len(points) >= 2 and float(np.ptp(xs)) > 0:
+        slope, intercept = np.polyfit(xs, ys, 1)
+    else:  # single size: all latency, analytic bandwidth
+        slope, intercept = 0.0, float(ys.mean()) if len(points) else 0.0
+    alpha_us = max(float(intercept), 0.001)
+    # slope is us/byte; 1 GB/s == 1000 bytes/us
+    gb_per_s = (1.0 / (float(slope) * 1e3)) if slope > 0 else 1e4
+    gb_per_s = min(max(gb_per_s, 0.001), 1e4)
+    return alpha_us, gb_per_s
+
+
+def measured_ring_crossover(algo_rows):
+    """The payload (bytes) where the measured ring first beats the
+    measured butterfly, linearly interpolated between the straddling
+    sweep points — the measured twin of
+    ``MPI4JAX_TPU_RING_CROSSOVER_BYTES`` the MPX109/111/113 advisories
+    cite when a tuning file is loaded.  ``None`` when the ring never
+    wins in the sweep (or the sweep ran on one device)."""
+    prev = None
+    for row in algo_rows:
+        if row.get("ring_speedup") is None:
+            return None
+        nbytes = row["size_mb"] * 1e6
+        delta = row["butterfly_us"] - row["ring_us"]  # >0: ring wins
+        if delta >= 0:
+            if prev is None:
+                return int(nbytes)
+            p_bytes, p_delta = prev
+            span = delta - p_delta
+            frac = (-p_delta / span) if span > 0 else 0.0
+            return int(p_bytes + frac * (nbytes - p_bytes))
+        prev = (nbytes, delta)
+    return None
+
+
+def build_cost_model(platform, n_devices, sendrecv_rows, algo_rows):
+    """The ``--cost-calibrate`` payload: a complete ``mpx-cost-model/1``
+    tuning file (analysis/costmodel.py schema) that
+    ``MPI4JAX_TPU_COST_MODEL`` loads verbatim.
+
+    ICI alpha/beta are fit by least squares over the sendrecv ring
+    latency sweep (one hop = one alpha + payload/bandwidth — exactly
+    the model's p2p term); the DCN class is scaled from the ICI fit by
+    the documented analytic ratios (the virtual CPU mesh has no real
+    DCN to measure; a multi-host capture overwrites it by hand or via a
+    future ``mpx.autotune()``).  The measured ring crossover is
+    interpolated from the forced butterfly-vs-ring sweep.
+    """
+    from mpi4jax_tpu.analysis import costmodel
+
+    pts = [(r["size_kb"] * 1e3, r["hop_us"]) for r in sendrecv_rows]
+    alpha_us, gb_per_s = fit_alpha_beta(pts)
+    defaults = costmodel.DEFAULT_PARAMS
+    dcn_alpha_ratio = (defaults["links"]["dcn"]["alpha_us"]
+                       / defaults["links"]["ici"]["alpha_us"])
+    dcn_bw_ratio = (defaults["links"]["dcn"]["gb_per_s"]
+                    / defaults["links"]["ici"]["gb_per_s"])
+    payload = {
+        "schema": costmodel.SCHEMA,
+        "source": (f"benchmarks/micro.py --cost-calibrate ({platform}, "
+                   f"{n_devices} devices; dcn scaled from the ici fit "
+                   "by the analytic ratios)"),
+        "links": {
+            "ici": {"alpha_us": round(alpha_us, 4),
+                    "gb_per_s": round(gb_per_s, 4)},
+            "dcn": {"alpha_us": round(alpha_us * dcn_alpha_ratio, 4),
+                    "gb_per_s": round(max(gb_per_s * dcn_bw_ratio,
+                                          0.001), 4)},
+        },
+        "gamma_gb_per_s": defaults["gamma_gb_per_s"],
+        "compute_gb_per_s": defaults["compute_gb_per_s"],
+        "dispatch_us": defaults["dispatch_us"],
+    }
+    crossover = measured_ring_crossover(algo_rows)
+    if crossover is not None:
+        payload["measured"] = {"ring_crossover_bytes": crossover}
+    # the emitted file must load verbatim — validate before anyone saves
+    costmodel.validate_model_dict(payload)
+    return payload
+
+
+def save_cost_model(payload, outdir=None):
+    """Write a ``--cost-calibrate`` tuning file to
+    ``benchmarks/results/`` (dated like ``save_results``), returning
+    the path — the file ``MPI4JAX_TPU_COST_MODEL`` points at."""
+    import datetime
+    import re
+
+    if outdir is None:
+        outdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "results")
+    os.makedirs(outdir, exist_ok=True)
+    stamp = datetime.date.today().strftime("%Y%m%d")
+    m = re.search(r"\((\w+), (\d+) devices", payload.get("source", ""))
+    tag = f"{m.group(1)}_{m.group(2)}dev" if m else "unknown"
+    path = os.path.join(outdir, f"cost_model_{tag}_{stamp}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
 def save_results(payload, outdir=None):
     """Write one sweep payload to ``benchmarks/results/`` (the ``--save``
     flag): ``micro_{platform}_{n}dev_{YYYYMMDD}.json``, returning the path
@@ -546,6 +656,15 @@ def main():
                         "unroll axis (mpx.compile(fn, ..., unroll=N): "
                         "per-step host cost amortizes ~1/N; "
                         "docs/aot.md 'Megastep execution')")
+    p.add_argument("--cost-calibrate", action="store_true",
+                   help="fit the static cost model's alpha/beta per "
+                        "link class (least squares over the sendrecv "
+                        "latency sweep) plus the measured ring "
+                        "crossover, and emit an mpx-cost-model/1 "
+                        "tuning file that MPI4JAX_TPU_COST_MODEL loads "
+                        "verbatim (with --save: written to "
+                        "benchmarks/results/cost_model_*.json; "
+                        "docs/analysis.md 'Cost model')")
     args = p.parse_args()
 
     devices = jax.devices()
@@ -643,6 +762,12 @@ def main():
         }
     if du is not None:
         payload["dispatch_unroll"] = du
+    if args.cost_calibrate:
+        cm = build_cost_model(devices[0].platform, n, pp, al)
+        payload["cost_model"] = cm
+        if args.save:
+            path = save_cost_model(cm)
+            print(f"saved cost model: {path}", file=sys.stderr)
     if args.telemetry:
         payload["telemetry"] = telemetry_sections
         mpx.set_telemetry_mode(None)
@@ -713,6 +838,14 @@ def main():
             print(f"  {r['unroll']:>6}   {r['megastep_us']:>10.2f} us"
                   f"   {r['per_step_us']:>8.3f} us"
                   f"   {r['per_step_host_us']:>8.3f} us")
+    if args.cost_calibrate:
+        cm = payload["cost_model"]
+        ici = cm["links"]["ici"]
+        print(f"\ncost model fit (ici): alpha {ici['alpha_us']} us, "
+              f"{ici['gb_per_s']} GB/s"
+              + (f"; measured ring crossover "
+                 f"{cm['measured']['ring_crossover_bytes']} B"
+                 if "measured" in cm else ""))
 
 
 if __name__ == "__main__":

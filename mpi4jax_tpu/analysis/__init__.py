@@ -48,7 +48,8 @@ from .walker import check_cond_divergence  # noqa: F401
 
 
 def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
-            static_argnums=None, ranks=None) -> Report:
+            static_argnums=None, ranks=None, cost: bool = False,
+            cost_model=None) -> Report:
     """Statically verify the collective structure of ``fn(*args)``.
 
     ``fn`` is re-traced abstractly (nothing executes, nothing compiles):
@@ -76,6 +77,21 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
     region-style function (``wrap=False`` has no per-rank program to
     concretize).
 
+    ``cost=True`` additionally extends the progress simulation into the
+    **critical-path timing simulation** (analysis/cost.py): the report
+    gains ``Report.cost`` — predicted step time, per-op / per-link-class
+    latency+byte breakdown, the critical path rank by rank, predicted
+    megastep/fusion amortization — and the quantified performance
+    advisories MPX131-MPX135 join ``Report.findings``.  Parameters come
+    from the alpha-beta-gamma model's documented analytic defaults, a
+    ``MPI4JAX_TPU_COST_MODEL`` tuning file, or an explicit ``cost_model``
+    (a path, a parsed dict, or a
+    :class:`~mpi4jax_tpu.analysis.costmodel.CostModel`).  ``cost``
+    implies ``ranks='all'`` when ``ranks`` is not given (the timing runs
+    over the matched cross-rank schedules); with ``cost=False`` the
+    report, the memo keys, and the lowered HLO stay byte-identical to a
+    build without the cost model (docs/analysis.md 'Cost model').
+
     Returns a :class:`Report`; ``report.raise_if_findings()`` converts it
     into the same :class:`AnalysisError` the
     ``MPI4JAX_TPU_ANALYZE=error`` dispatch mode raises.  Results are
@@ -87,11 +103,17 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
     from ..ops._algos import algo_cache_token
     from ..parallel.region import resolve_comm, spmd
 
+    ranks_implied = cost and ranks is None
+    if ranks_implied:
+        ranks = "all"
     if wrap is None:
         wrap = not getattr(fn, "_mpx_spmd", False)
     if ranks is not None and not wrap and not getattr(fn, "_mpx_spmd", False):
+        what = ("analyze(cost=True) implies ranks='all' (the timing "
+                "runs over the matched cross-rank schedules) and"
+                if ranks_implied else "analyze(ranks=...)")
         raise ValueError(
-            "analyze(ranks=...) needs a region-style function (plain "
+            f"{what} needs a region-style function (plain "
             "per-rank or spmd-decorated): an eager-style wrap=False "
             "function has no per-rank program to re-trace"
         )
@@ -139,14 +161,25 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
             world *= s
         rank_list = crossrank.resolve_rank_list(ranks, world)
 
+    model = None
+    if cost:
+        from . import cost as _cost
+
+        model = _cost.resolve_model(cost_model)
+
     key = _cache_key(jax, fn, comm, args, statics, wrap, algo_cache_token(),
                      rank_list)
+    if key is not None and cost:
+        # appended ONLY when the cost pass runs: cost=False keys stay
+        # byte-identical to a build without the cost model
+        key = key + ("cost", model.stamp())
     if key is not None and key in _analyze_cache:
         return _analyze_cache[key]
 
     if rank_list is not None:
         report = _analyze_cross_rank(jax, target, args, statics, c,
-                                     axis_sizes, world, rank_list)
+                                     axis_sizes, world, rank_list,
+                                     cost_model=model)
         if key is not None:
             _analyze_cache[key] = report
         return report
@@ -182,9 +215,10 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
 
 
 def _analyze_cross_rank(jax, target, args, statics, c, axis_sizes, world,
-                        rank_list) -> Report:
+                        rank_list, cost_model=None) -> Report:
     """The ranks= path: per-rank re-traces -> per-rank graph checkers ->
-    global matcher -> progress checker."""
+    global matcher -> progress checker -> (optionally) the critical-path
+    cost pass."""
     from . import crossrank
     from .hook import config_snapshot
 
@@ -205,12 +239,23 @@ def _analyze_cross_rank(jax, target, args, statics, c, axis_sizes, world,
                 continue
             seen_cond.add(f.message)
             findings.append(f)
+    cost_report = None
     if not fatal:
+        matched = crossrank.match_rank_schedules(per_rank, world, watermark)
         findings.extend(
-            crossrank.cross_rank_findings(per_rank, world, watermark))
+            crossrank.cross_rank_findings(per_rank, world, matched=matched))
+        if cost_model is not None:
+            from . import cost as _cost
+
+            cost_report, cost_findings = _cost.run_cost_pass(
+                matched, model=cost_model,
+                host_of_rank=_cost.host_map_for(c), closed=closed,
+                meta=config_snapshot())
+            findings.extend(cost_findings)
     events = per_rank.get(rank_list[0], ())
     return Report(findings=tuple(findings), events=tuple(events),
-                  meta=dict(config_snapshot(), ranks=list(rank_list)))
+                  meta=dict(config_snapshot(), ranks=list(rank_list)),
+                  cost=cost_report)
 
 
 def _normalize_statics(static_argnums, nargs) -> tuple:

@@ -17,13 +17,24 @@ Options:
   schedule pass (per-rank re-trace + deadlock/progress matching,
   MPX120–MPX125) runs for every spmd region on a comm of at most N
   ranks;
+- ``--cost`` — sets ``MPI4JAX_TPU_ANALYZE_COST=on``: every cross-rank
+  pass extends into the critical-path timing simulation
+  (analysis/cost.py) — reports gain a ``cost`` breakdown (predicted
+  step time, per-op / per-link-class latency+bytes, the critical path)
+  and the quantified MPX131–MPX135 performance advisories;
+- ``--cost-model PATH`` — sets ``MPI4JAX_TPU_COST_MODEL=PATH``: load
+  measured alpha/beta parameters from a tuning file (the
+  ``benchmarks/micro.py --cost-calibrate`` schema) instead of the
+  analytic defaults;
 - ``--json`` — print the aggregated machine-readable payload (one
-  ``Report.to_json()`` object per dirty region, plus per-script status)
-  to stdout; the scripts' own stdout is redirected to stderr so the
-  payload stays parseable.
+  ``Report.to_json()`` object per dirty — or, under ``--cost``,
+  costed — region, plus per-script status) to stdout; the scripts' own
+  stdout is redirected to stderr so the payload stays parseable.
 
 The CI ``lint/analyze`` lane runs this over everything in ``examples/``
-with ``--ranks 8 --json`` and uploads the payloads as artifacts
+with ``--ranks 8 --cost --json``, uploads the payloads as artifacts,
+and asserts ``examples/pipeline_parallel.py`` reports MPX135 while
+exiting 0 — advisory severity never fails the lane
 (.github/workflows/test.yml).
 """
 
@@ -34,13 +45,15 @@ import runpy
 import sys
 import traceback
 
-USAGE = ("usage: python -m mpi4jax_tpu.analysis [--ranks N] [--json] "
-         "script.py [...]")
+USAGE = ("usage: python -m mpi4jax_tpu.analysis [--ranks N] [--cost] "
+         "[--cost-model PATH] [--json] script.py [...]")
 
 
 def _parse_args(argv):
     ranks = None
     as_json = False
+    cost = False
+    cost_model = None
     scripts = []
     i = 0
     while i < len(argv):
@@ -52,6 +65,15 @@ def _parse_args(argv):
             ranks = argv[i]
         elif a.startswith("--ranks="):
             ranks = a.split("=", 1)[1]
+        elif a == "--cost":
+            cost = True
+        elif a == "--cost-model":
+            i += 1
+            if i >= len(argv):
+                return None
+            cost_model = argv[i]
+        elif a.startswith("--cost-model="):
+            cost_model = a.split("=", 1)[1]
         elif a == "--json":
             as_json = True
         elif a.startswith("-"):
@@ -61,7 +83,7 @@ def _parse_args(argv):
         i += 1
     if not scripts:
         return None
-    return ranks, as_json, scripts
+    return ranks, as_json, cost, cost_model, scripts
 
 
 def main(argv) -> int:
@@ -69,9 +91,13 @@ def main(argv) -> int:
     if parsed is None:
         print(USAGE, file=sys.stderr)
         return 2
-    ranks, as_json, scripts = parsed
+    ranks, as_json, cost, cost_model, scripts = parsed
     if ranks is not None:
         os.environ["MPI4JAX_TPU_ANALYZE_RANKS"] = ranks
+    if cost:
+        os.environ["MPI4JAX_TPU_ANALYZE_COST"] = "on"
+    if cost_model is not None:
+        os.environ["MPI4JAX_TPU_COST_MODEL"] = cost_model
     os.environ.setdefault("MPI4JAX_TPU_ANALYZE", "warn")
     mode = os.environ["MPI4JAX_TPU_ANALYZE"]
 
@@ -132,7 +158,9 @@ def main(argv) -> int:
                     trace_failure = True
             finally:
                 sys.argv = saved_argv
-            if len(sink) > before and script_status[path] == "ok":
+            if script_status[path] == "ok" and any(
+                    rep.findings for _, rep in sink[before:]):
+                # a clean --cost breakdown report is not a "finding"
                 script_status[path] = "findings"
     finally:
         sys.argv = saved_argv
@@ -151,7 +179,8 @@ def main(argv) -> int:
         }
         print(json.dumps(payload, indent=2))
     for where, rep in sink:
-        print(f"[mpx.analyze] findings in {where}:\n{rep.render()}",
+        label = "findings in" if rep.findings else "cost report for"
+        print(f"[mpx.analyze] {label} {where}:\n{rep.render()}",
               file=sys.stderr)
     if trace_failure:
         return 2

@@ -276,10 +276,22 @@ def check_unfused_adjacent(graph: CollectiveGraph) -> List[Finding]:
 
     Gated on the config snapshot EXPLICITLY recording ``fusion: off``
     (every real trace does, via ``hook.config_snapshot``): hand-built
-    graphs without fusion meta are testing other rules."""
+    graphs without fusion meta are testing other rules.
+
+    When a cost-model tuning file is loaded
+    (``MPI4JAX_TPU_COST_MODEL``), the MEASURED fusion bucket takes the
+    place of the static env default — both as the bucketing cap and in
+    the advisory text (which then cites the calibration source instead
+    of the flag)."""
     if graph.meta.get("fusion") != "off":
         return []
-    cap = graph.meta.get("fusion_bucket_bytes", 0)
+    measured = graph.meta.get("measured_fusion_bucket_bytes")
+    cap = measured or graph.meta.get("fusion_bucket_bytes", 0)
+    cap_cite = (
+        f"the measured {measured} B bucket "
+        f"(cost model {graph.meta.get('cost_model')})"
+        if measured else "the fusion bucket cap"
+    )
     findings: List[Finding] = []
     run: List = []
 
@@ -295,9 +307,9 @@ def check_unfused_adjacent(graph: CollectiveGraph) -> List[Finding]:
                 message=(f"{len(run)} adjacent {first.op} collectives on "
                          f"comm {first.comm_uid} "
                          f"(events {first.index}..{run[-1].index}, "
-                         f"{total} B total) would coalesce into one "
-                         "flat-buffer collective, but "
-                         "MPI4JAX_TPU_FUSION is off"),
+                         f"{total} B total) each fit {cap_cite} and "
+                         "would coalesce into one flat-buffer "
+                         "collective, but MPI4JAX_TPU_FUSION is off"),
                 suggestion=("set MPI4JAX_TPU_FUSION=auto (or call "
                             "mpx.set_fusion_mode('auto')) and consume "
                             "results after issuing the whole batch — see "
@@ -574,10 +586,20 @@ def check_flat_over_dcn(graph: CollectiveGraph) -> List[Finding]:
     whose host partition is non-uniform — where flat is the only option —
     never fire this.  Requires ``comm_size > hosts`` (with one rank per
     host there is no intra level and hier degenerates to flat).
+
+    When a cost-model tuning file is loaded
+    (``MPI4JAX_TPU_COST_MODEL``), the MEASURED ring crossover replaces
+    the static env default — as the firing threshold and in the
+    advisory text, which then cites the calibration source.
     """
-    crossover = graph.meta.get("ring_crossover_bytes")
+    measured = graph.meta.get("measured_ring_crossover_bytes")
+    crossover = measured or graph.meta.get("ring_crossover_bytes")
     if not crossover:
         return []
+    cite = (
+        f"measured crossover, cost model {graph.meta.get('cost_model')}"
+        if measured else "ring crossover"
+    )
     findings: List[Finding] = []
     for e in graph.events:
         if e.op not in ALGO_OPS or e.algo not in ("ring", "butterfly"):
@@ -593,7 +615,7 @@ def check_flat_over_dcn(graph: CollectiveGraph) -> List[Finding]:
             message=(f"{e.op} on comm {e.comm_uid} spans {e.hosts} hosts "
                      f"({e.comm_size} ranks) but ran the flat '{e.algo}' "
                      f"algorithm at {e.payload_bytes} B (>= the "
-                     f"{crossover} B ring crossover): every round is "
+                     f"{crossover} B {cite}): every round is "
                      "gated on the slowest DCN hop"),
             suggestion=("let algo=auto pick the two-level lowering, or "
                         "force MPI4JAX_TPU_COLLECTIVE_ALGO=hier for an "

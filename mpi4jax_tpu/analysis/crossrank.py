@@ -134,15 +134,25 @@ def uid_watermark() -> int:
     return next(_comm._uid_counter)
 
 
-def cross_rank_findings(per_rank_events: Dict[int, list], world: int,
-                        watermark: Optional[int] = None) -> List[Finding]:
-    """Schedules -> matcher -> progress, over per-rank event streams."""
+def match_rank_schedules(per_rank_events: Dict[int, list], world: int,
+                         watermark: Optional[int] = None):
+    """Per-rank event streams -> schedules -> the matched whole-program
+    view (the cost pass in analysis/cost.py consumes the same
+    :class:`~.matcher.MatchedProgram` the progress checker does)."""
     schedules = {
         r: _schedule.build_schedule(events, rank=r, world=world,
                                     uid_watermark=watermark)
         for r, events in per_rank_events.items()
     }
-    matched = match_schedules(schedules)
+    return match_schedules(schedules)
+
+
+def cross_rank_findings(per_rank_events: Dict[int, list], world: int,
+                        watermark: Optional[int] = None,
+                        matched=None) -> List[Finding]:
+    """Schedules -> matcher -> progress, over per-rank event streams."""
+    if matched is None:
+        matched = match_rank_schedules(per_rank_events, world, watermark)
     findings = list(matched.findings)
     findings.extend(check_progress(matched))
     return findings
@@ -243,6 +253,20 @@ def verify_region_crossrank(fn, *, comm, in_specs, out_specs,
     key = ("crossrank", fn, c.uid, treedef, avals,
            tuple(static_argnums or ()), mode, config.analyze_ranks(),
            algo_cache_token())
+    cost_model = None
+    if config.analyze_cost_enabled():
+        from . import cost as _cost
+
+        try:
+            cost_model = _cost.resolve_model(None)
+        except ValueError as e:
+            warnings.warn(
+                f"MPI4JAX_TPU_ANALYZE_COST: cost pass skipped "
+                f"(tuning file rejected: {e})", stacklevel=3)
+        else:
+            # folded in ONLY when the cost pass is armed: cost=off memo
+            # keys stay byte-identical to a build without the model
+            key = key + ("cost", cost_model.stamp())
     try:
         hash(key)
     except TypeError:
@@ -254,20 +278,24 @@ def verify_region_crossrank(fn, *, comm, in_specs, out_specs,
     else:
         report = _run_region_pass(fn, comm, in_specs, out_specs,
                                   static_argnums, c, args, kwargs,
-                                  axis_sizes, world)
+                                  axis_sizes, world, cost_model)
         if report is None:
             return
         fresh = True
         if key is not None:
             cache[key] = report
-    if report.ok:
+    if report.ok and report.cost is None:
         return
     if fresh:
         # sink/warn once per verified program, not once per call — a
         # host loop over a dirty region must not inflate the CLI's
-        # finding counts with duplicates of the same report
+        # finding counts with duplicates of the same report.  A CLEAN
+        # report is sunk too when the cost pass ran: the CLI's --cost
+        # breakdown artifacts cover clean programs as well.
         _hook.sink_report(f"cross-rank pass over spmd region "
                           f"{getattr(fn, '__name__', fn)!s}", report)
+    if report.ok:
+        return
     if mode == "error":
         # every call refuses: the program must not run
         report.raise_if_findings()
@@ -280,7 +308,8 @@ def verify_region_crossrank(fn, *, comm, in_specs, out_specs,
 
 
 def _run_region_pass(fn, comm, in_specs, out_specs, static_argnums,
-                     c, args, kwargs, axis_sizes, world) -> Optional[Report]:
+                     c, args, kwargs, axis_sizes, world,
+                     cost_model=None) -> Optional[Report]:
     from ..parallel.region import spmd
 
     from . import _normalize_statics
@@ -290,7 +319,7 @@ def _run_region_pass(fn, comm, in_specs, out_specs, static_argnums,
     statics = _normalize_statics(static_argnums, len(args))
     watermark = uid_watermark()
     try:
-        per_rank, fatal, _ = trace_rank_schedules(
+        per_rank, fatal, closed = trace_rank_schedules(
             target, args, kwargs, statics, c.axes, axis_sizes,
             range(world))
     except Exception as e:  # pragma: no cover - defensive
@@ -304,8 +333,18 @@ def _run_region_pass(fn, comm, in_specs, out_specs, static_argnums,
         # the normal trace will raise the same tagged error with a full
         # traceback — do not pre-empt it with a partial cross-rank view
         return None
-    findings = cross_rank_findings(per_rank, world, watermark)
+    matched = match_rank_schedules(per_rank, world, watermark)
+    findings = cross_rank_findings(per_rank, world, matched=matched)
+    cost_report = None
+    if cost_model is not None:
+        from . import cost as _cost
+
+        meta = _hook.config_snapshot()
+        cost_report, cost_findings = _cost.run_cost_pass(
+            matched, model=cost_model,
+            host_of_rank=_cost.host_map_for(c), closed=closed, meta=meta)
+        findings.extend(cost_findings)
     first = per_rank.get(0, ())
     return Report(findings=tuple(findings), events=tuple(first),
                   meta=dict(_hook.config_snapshot(),
-                            ranks=list(range(world))))
+                            ranks=list(range(world))), cost=cost_report)

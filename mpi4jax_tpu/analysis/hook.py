@@ -60,8 +60,13 @@ def analysis_cache_token() -> tuple:
     """Folded into the compiled-program cache keys (ops/_base.py eager
     cache, parallel/region.py spmd cache): flipping the mode — or the
     cross-rank pass setting — must retrace; the verifier only sees
-    programs as they trace."""
-    return (effective_mode(), config.analyze_ranks())
+    programs as they trace.  The ambient cost pass folds in ONLY when
+    armed, so cost=off cache keys stay byte-identical to a build
+    without the cost model (pinned by tests/test_cost.py)."""
+    tok = (effective_mode(), config.analyze_ranks())
+    if config.analyze_cost_enabled():
+        tok = tok + ("cost", config.cost_model_path())
+    return tok
 
 
 class Recorder:
@@ -102,7 +107,7 @@ def config_snapshot() -> dict:
         megastep = tracing_megastep()
     except ImportError:
         megastep = False
-    return {
+    snap = {
         "collective_algo": config.collective_algo(),
         "ring_crossover_bytes": config.ring_crossover_bytes(),
         "dcn_crossover_bytes": config.dcn_crossover_bytes(),
@@ -113,6 +118,14 @@ def config_snapshot() -> dict:
         "pinned": pinned,
         "megastep": megastep,
     }
+    # measured crossovers from the cost-model tuning file (empty when
+    # MPI4JAX_TPU_COST_MODEL is unset, keeping the snapshot — and with
+    # it the MPX111/MPX113 advisory texts — byte-identical to a build
+    # without the cost model)
+    from .costmodel import measured_meta
+
+    snap.update(measured_meta())
+    return snap
 
 
 # explicit-analyze recorders (mpx.analyze); innermost wins

@@ -118,31 +118,61 @@ class _Simulation:
                     if op.kind == "send":
                         self._issue_send(r, op)
                         self.ptr[r] += 1
+                        self._retire_send(r, op)
                     elif op.kind == "recv":
                         if not self._recv_ready(r, op):
                             break
                         self._note_ambiguity(r, op)
                         self._consume_recv(r, op)
                         self.ptr[r] += 1
+                        self._retire_recv(r, op)
                     elif op.kind == "start":
                         # nonblocking issue: record it for the paired
                         # wait's readiness check and move on
                         self.started.setdefault(
                             inst_key(op), set()).add(r)
                         self.ptr[r] += 1
+                        self._retire_start(r, op)
                     elif op.kind == "coll":
                         key = inst_key(op)
                         if not self._coll_ready(key):
                             break
-                        for q in self.m.expected.get(key, (r,)):
+                        members = self.m.expected.get(key, (r,))
+                        for q in members:
                             self.ptr[q] += 1
+                        self._retire_coll(key, members)
                     elif op.kind == "wait":
                         if not self._wait_ready(inst_key(op)):
                             break
                         self.ptr[r] += 1
+                        self._retire_wait(r, op)
                     else:  # unknown kinds never block
                         self.ptr[r] += 1
                     moved = True
+
+    # -- retirement hooks --------------------------------------------------
+    # The timed (critical-path) simulation in analysis/cost.py subclasses
+    # this simulation and overrides these: each is invoked exactly once,
+    # at the moment the op retires under the SAME buffered-send execution
+    # semantics the deadlock verdicts use — so predicted timings and
+    # progress verdicts can never disagree about what runs when.  A coll
+    # retires all member ranks together (one call, ``members`` in rank
+    # order); everything else retires per rank.
+
+    def _retire_send(self, r: int, op: SchedOp) -> None:
+        pass
+
+    def _retire_recv(self, r: int, op: SchedOp) -> None:
+        pass
+
+    def _retire_start(self, r: int, op: SchedOp) -> None:
+        pass
+
+    def _retire_coll(self, key: Tuple, members) -> None:
+        pass
+
+    def _retire_wait(self, r: int, op: SchedOp) -> None:
+        pass
 
     def _note_ambiguity(self, r: int, op: SchedOp) -> None:
         """MPX110 replay (the single-trace FIFO-ambiguity advisory, which

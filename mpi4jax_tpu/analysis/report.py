@@ -224,6 +224,59 @@ CODES = {
             "mpx.compile; mpx.elastic.run re-pins step functions "
             "automatically).",
         ),
+        # --- static cost-model advisories (analysis/cost.py, the
+        # performance critic over the critical-path timing simulation):
+        # each is QUANTIFIED by the alpha-beta-gamma model
+        # (analysis/costmodel.py) — predicted microseconds and bytes, not
+        # heuristics — and only fires under mpx.analyze(..., cost=True) /
+        # MPI4JAX_TPU_ANALYZE_COST=on.
+        CodeInfo(
+            "MPX131", "overlap opportunity", ADVISORY,
+            "A blocking collective's result is consumed late enough "
+            "that the surrounding independent compute could hide a "
+            "substantial fraction of its predicted wire time: the "
+            "async split (*_start/*_wait, docs/overlap.md) would "
+            "overlap the phases.  The finding quantifies the hideable "
+            "microseconds from the cost model's critical-path "
+            "simulation.",
+        ),
+        CodeInfo(
+            "MPX132", "fusion opportunity (quantified)", ADVISORY,
+            "Adjacent fusable collectives whose coalescing the cost "
+            "model prices: one flat-buffer collective replaces N "
+            "per-collective alpha rounds, with the predicted savings "
+            "stated in bytes and microseconds — the quantified upgrade "
+            "of the MPX111 heuristic (set MPI4JAX_TPU_FUSION=auto, "
+            "docs/overlap.md).",
+        ),
+        CodeInfo(
+            "MPX133", "algorithm mispick", ADVISORY,
+            "The cost model predicts a different ring/butterfly/hier "
+            "lowering than resolve_algo chose for this payload, group "
+            "size, and host topology, by more than the mispick "
+            "threshold; the finding states the predicted delta.  "
+            "Usually a crossover flag "
+            "(MPI4JAX_TPU_RING_CROSSOVER_BYTES / _DCN_CROSSOVER_BYTES) "
+            "sitting far from the measured value — recalibrate with "
+            "benchmarks/micro.py --cost-calibrate.",
+        ),
+        CodeInfo(
+            "MPX134", "structural load imbalance", ADVISORY,
+            "Member ranks of one matched collective carry different "
+            "payload bytes, so the widest rank is a straggler BY "
+            "CONSTRUCTION — every other member waits out the predicted "
+            "delta each step.  Pad or re-shard the payload so matched "
+            "members ship equal bytes.",
+        ),
+        CodeInfo(
+            "MPX135", "serialized point-to-point chain", ADVISORY,
+            "An unpipelined send/recv ladder occupies the predicted "
+            "critical path: each hop waits for the previous stage's "
+            "full compute + transfer, so the chain's stages run "
+            "serially.  Split the batch into microbatches (GPipe-style) "
+            "so stage i+1's transfer overlaps stage i's compute — see "
+            "examples/pipeline_parallel.py.",
+        ),
         CodeInfo(
             "MPX130", "async span straddles a megastep loop boundary", ERROR,
             "An async *_start/*_wait span crosses a megastep loop "
@@ -315,11 +368,18 @@ class Report:
     """Result of one analysis pass: the findings, the event stream they
     were derived from (``events`` entries are
     :class:`~mpi4jax_tpu.analysis.graph.CollectiveEvent`), and the config
-    snapshot the checkers saw (``meta``: collective_algo, crossover)."""
+    snapshot the checkers saw (``meta``: collective_algo, crossover).
+
+    ``cost`` is the critical-path timing prediction
+    (:class:`~mpi4jax_tpu.analysis.cost.CostReport`) when the pass ran
+    with ``cost=True`` / ``MPI4JAX_TPU_ANALYZE_COST=on`` — ``None``
+    otherwise, keeping the report (and its JSON shape) byte-identical
+    to a build without the cost model."""
 
     findings: Tuple[Finding, ...] = ()
     events: Tuple = ()
     meta: Dict = field(default_factory=dict)
+    cost: Optional[object] = None
 
     @property
     def ok(self) -> bool:
@@ -335,12 +395,18 @@ class Report:
 
     def render(self) -> str:
         if not self.findings:
-            return (f"mpx.analyze: clean ({len(self.events)} collective(s) "
+            head = (f"mpx.analyze: clean ({len(self.events)} collective(s) "
                     "analyzed)")
+            if self.cost is not None:
+                head += "\n" + self.cost.render()
+            return head
         head = (f"mpx.analyze: {len(self.errors)} error(s), "
                 f"{len(self.advisories)} advisory(ies) over "
                 f"{len(self.events)} collective(s)")
-        return "\n".join([head] + [f.render() for f in self.findings])
+        lines = [head] + [f.render() for f in self.findings]
+        if self.cost is not None:
+            lines.append(self.cost.render())
+        return "\n".join(lines)
 
     def __str__(self) -> str:
         return self.render()
@@ -361,7 +427,7 @@ class Report:
                            else repr(x) for x in v]
             else:
                 meta[k] = repr(v)
-        return {
+        payload = {
             "ok": self.ok,
             "errors": len(self.errors),
             "advisories": len(self.advisories),
@@ -370,6 +436,11 @@ class Report:
             "meta": meta,
             "findings": [f.to_json() for f in self.findings],
         }
+        if self.cost is not None:
+            # only present when the cost pass ran: cost=off payloads stay
+            # byte-identical to a build without the cost model
+            payload["cost"] = self.cost.to_json()
+        return payload
 
     def raise_if_findings(self) -> None:
         if self.findings:

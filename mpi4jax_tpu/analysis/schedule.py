@@ -260,6 +260,15 @@ class SchedOp:
     event_index: int = -1
     fused: Optional[Tuple] = None
     hier: Optional[Tuple] = None
+    # cost-model inputs (analysis/cost.py): the dispatch-point payload
+    # bytes, the algorithm the selector picked, the host span the
+    # hierarchical layer annotated (None where no plan was derivable),
+    # and whether the op dispatched eagerly (outside any region — the
+    # MPX132 fusion critic mirrors MPX111's eager exclusion from it)
+    payload_bytes: int = 0
+    algo: Optional[str] = None
+    hosts: Optional[int] = None
+    eager: bool = False
     meta: Dict = field(default_factory=dict)
 
     def describe(self) -> str:
@@ -337,6 +346,7 @@ def build_schedule(events, rank: int, world: Optional[int] = None,
         ck = key_of(e.comm_uid)
         base = dict(rank=rank, pos=len(sched), op=e.op, comm_uid=e.comm_uid,
                     comm_key=ck, dtype=e.dtype, nelems=_nelems(e.shape),
+                    payload_bytes=e.payload_bytes, eager=e.eager,
                     event_index=e.index)
         if e.op in P2P_OPS:
             pairs = e.pairs
@@ -393,5 +403,6 @@ def build_schedule(events, rank: int, world: Optional[int] = None,
             kind = "coll"
         sched.append(SchedOp(kind=kind, seq=seq, participants=parts,
                              root=e.root, reduction=e.reduction,
-                             span=e.span, fused=fused, hier=e.hier, **base))
+                             span=e.span, fused=fused, hier=e.hier,
+                             algo=e.algo, hosts=e.hosts, **base))
     return sched

@@ -99,6 +99,26 @@ class Topology:
                 f"ranks_per_host={self.ranks_per_host})")
 
 
+def span_hosts(host_of_rank: Sequence[int],
+               members: Sequence[int]) -> int:
+    """How many hosts ``members`` (world-linear ranks) span under
+    ``host_of_rank`` — the link-class discriminator the static cost
+    model (analysis/costmodel.py) shares with the hierarchical plan
+    geometry: a group spanning one host prices on the ICI class, a
+    multi-host group on DCN."""
+    return len({host_of_rank[m] for m in members}) if members else 0
+
+
+def link_class(host_of_rank: Optional[Sequence[int]], a: int,
+               b: int) -> str:
+    """Link class of the (a, b) rank pair: ``"dcn"`` when the two live
+    on different hosts, ``"ici"`` otherwise (including when no topology
+    is derivable — the flat-fallback convention everywhere else)."""
+    if host_of_rank is None:
+        return "ici"
+    return "ici" if host_of_rank[a] == host_of_rank[b] else "dcn"
+
+
 def from_counts(counts: Sequence[int]) -> Topology:
     """Topology from per-host rank counts: ``(3, 5)`` -> ranks 0-2 on
     host 0, ranks 3-7 on host 1."""

@@ -213,6 +213,27 @@ FLAGS = {
              "``AnalysisError`` instead.  ``off`` (default) records "
              "nothing; the lowered HLO is byte-identical in every mode.",
              choices=ANALYZE_MODES),
+        Flag("MPI4JAX_TPU_COST_MODEL", "str", "",
+             "Tuning file for the static communication cost model "
+             "(analysis/costmodel.py): a JSON file with measured "
+             "alpha/beta parameters per link class (the "
+             "``benchmarks/micro.py --cost-calibrate`` output schema, "
+             "``mpx-cost-model/1``).  Empty (default) keeps the "
+             "documented analytic defaults.  When set, "
+             "``mpx.analyze(..., cost=True)`` predicts with measured "
+             "numbers and the MPX111/MPX113 advisories cite the "
+             "measured crossovers instead of the static env defaults "
+             "(docs/analysis.md 'Cost model')."),
+        Flag("MPI4JAX_TPU_ANALYZE_COST", "choice", "off",
+             "Cost pass of the ambient verifier "
+             "(``MPI4JAX_TPU_ANALYZE=warn|error`` + the analysis CLI's "
+             "``--cost``): ``on`` extends every cross-rank schedule "
+             "pass into the critical-path timing simulation and "
+             "attaches ``Report.cost`` (predicted step time, per-op / "
+             "per-link-class breakdown, MPX131-MPX135 advisories).  "
+             "``off`` (default) keeps reports, cache keys, and HLO "
+             "byte-identical to a build without the cost model.",
+             choices=("off", "on")),
         Flag("MPI4JAX_TPU_ANALYZE_RANKS", "str", "auto",
              "Cross-rank schedule verification (analysis/crossrank.py) "
              "under ``MPI4JAX_TPU_ANALYZE=warn|error``: each spmd "
@@ -633,6 +654,20 @@ def analyze_ranks():
             "be 'auto', 'off', or a positive integer rank cap"
         )
     return val
+
+
+def cost_model_path() -> str:
+    """Path of the cost-model tuning file (``MPI4JAX_TPU_COST_MODEL``;
+    '' = the documented analytic defaults — see analysis/costmodel.py
+    and docs/analysis.md 'Cost model')."""
+    return (_getenv("MPI4JAX_TPU_COST_MODEL") or "").strip()
+
+
+def analyze_cost_enabled() -> bool:
+    """Whether the ambient verifier's cross-rank pass also runs the
+    critical-path cost simulation (``MPI4JAX_TPU_ANALYZE_COST``; default
+    off — see analysis/cost.py)."""
+    return _parse_env_choice("MPI4JAX_TPU_ANALYZE_COST") == "on"
 
 
 def telemetry_mode() -> str:

@@ -44,7 +44,8 @@ def _load_isolated():
     for mod in ("utils.config", "ops._fusion", "analysis.report",
                 "analysis.graph", "analysis.checkers", "analysis.walker",
                 "analysis.hook", "analysis.schedule", "analysis.matcher",
-                "analysis.progress", "parallel.rankspec"):
+                "analysis.progress", "analysis.costmodel", "analysis.cost",
+                "parallel.rankspec"):
         importlib.import_module(f"{_ISO_NAME}.{mod}")
     return root
 
@@ -81,13 +82,19 @@ def test_catalog_is_fully_owned():
     # can ever witness one — mpx.analyze converts the raise)
     matcher = sys.modules[f"{_ISO_NAME}.analysis.matcher"]
     progress = sys.modules[f"{_ISO_NAME}.analysis.progress"]
+    cost = sys.modules[f"{_ISO_NAME}.analysis.cost"]
     crossrank_owned = set(matcher.CROSSRANK_CODES) | set(
         progress.CROSSRANK_CODES)
+    # MPX131-135 are owned by the cost-pass critic (analysis/cost.py):
+    # quantified advisories over the timed simulation, never emitted by
+    # a graph checker
+    cost_owned = set(cost.COST_CODES)
     raise_site_owned = {"MPX129"}
     assert (checkers.registered_codes() | {"MPX108"} | crossrank_owned
-            | raise_site_owned == set(report.CODES))
-    # the two registries never claim the same code
+            | cost_owned | raise_site_owned == set(report.CODES))
+    # the registries never claim the same code
     assert not crossrank_owned & checkers.registered_codes()
+    assert not cost_owned & (crossrank_owned | checkers.registered_codes())
 
 
 def test_codes_have_severity_and_docs():

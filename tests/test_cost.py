@@ -1,0 +1,247 @@
+"""Static cost model: the traced integration half (docs/analysis.md
+"Cost model").
+
+``cost=True`` through ``mpx.analyze`` and through the ambient env path
+(``MPI4JAX_TPU_ANALYZE_COST=on``) on the real 8-device mesh, the
+tuning-file route end to end, the HLO/report byte-identity pins with
+cost on vs off, and the seeded pipeline example
+(examples/pipeline_parallel.py): the naive ladder must report MPX135,
+its microbatched twin must not — and both must match the sequential
+reference numerically.  The pure formula/simulation matrix lives in
+tests/test_cost_pure.py.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.analysis import costmodel
+from helpers import ranks_arange, world
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "examples"))
+
+
+@pytest.fixture(autouse=True)
+def _reset_analysis(monkeypatch):
+    for var in ("MPI4JAX_TPU_ANALYZE", "MPI4JAX_TPU_ANALYZE_RANKS",
+                "MPI4JAX_TPU_ANALYZE_COST", "MPI4JAX_TPU_COST_MODEL"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+    mpx.set_analyze_mode(None)
+    mpx.clear_caches()
+
+
+def codes(report):
+    return [f.code for f in report.findings]
+
+
+def _step(comm):
+    def step(x):
+        out, tok = mpx.allreduce(x, comm=comm)
+        out2, _ = mpx.allreduce(mpx.varying(out * 0.5), comm=comm,
+                                token=tok)
+        return mpx.varying(out2)
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# cost=True through mpx.analyze
+# ---------------------------------------------------------------------------
+
+
+def test_cost_through_analyze():
+    comm, size = world()
+    report = mpx.analyze(_step(comm), ranks_arange((64,)), comm=comm,
+                         ranks="all", cost=True)
+    assert not report.errors
+    cost = report.cost
+    assert cost is not None
+    assert cost.total_us > 0
+    assert cost.path_us > 0 and cost.dispatch_us > 0
+    assert cost.ranks == tuple(range(size))
+    assert cost.per_op["allreduce"]["count"] == 2
+    assert cost.per_link["ici"]["bytes"] > 0  # single host: all ICI
+    assert cost.per_link["dcn"]["bytes"] == 0
+    assert cost.critical_path  # rendered rank by rank
+    payload = report.to_json()
+    assert payload["cost"]["total_us"] == pytest.approx(cost.total_us,
+                                                        rel=1e-6)
+    json.dumps(payload)  # CI-consumable end to end
+    assert "predicted step time" in report.render()
+    # compute estimate came from the per-rank jaxprs
+    assert max(cost.compute_us.values()) > 0
+
+
+def test_cost_implies_ranks_all():
+    comm, size = world()
+    report = mpx.analyze(_step(comm), ranks_arange((8,)), comm=comm,
+                         cost=True)
+    assert report.cost is not None
+    assert list(report.meta["ranks"]) == list(range(size))
+
+
+def test_cost_off_keeps_report_shape():
+    comm, _ = world()
+    report = mpx.analyze(_step(comm), ranks_arange((8,)), comm=comm,
+                         ranks="all")
+    assert report.cost is None
+    assert "cost" not in report.to_json()
+    assert "predicted step time" not in report.render()
+
+
+def test_cost_memo_distinct_from_plain():
+    # the cost=True report is memoized separately (the key grows a cost
+    # stamp ONLY when the pass runs), so the two can never cross-serve
+    comm, _ = world()
+    step = _step(comm)
+    x = ranks_arange((8,))
+    plain = mpx.analyze(step, x, comm=comm, ranks="all")
+    costed = mpx.analyze(step, x, comm=comm, ranks="all", cost=True)
+    assert plain.cost is None and costed.cost is not None
+    assert mpx.analyze(step, x, comm=comm, ranks="all") is plain
+    assert mpx.analyze(step, x, comm=comm, ranks="all", cost=True) is costed
+
+
+def test_tuning_file_through_analyze(tmp_path):
+    comm, _ = world()
+    payload = {
+        "schema": costmodel.SCHEMA,
+        "links": {"ici": {"alpha_us": 5.0, "gb_per_s": 10.0}},
+    }
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps(payload))
+    slow = mpx.analyze(_step(comm), ranks_arange((8,)), comm=comm,
+                       ranks="all", cost=True, cost_model=str(path))
+    fast = mpx.analyze(_step(comm), ranks_arange((8,)), comm=comm,
+                       ranks="all", cost=True)
+    assert slow.cost.source == str(path)
+    assert slow.cost.total_us > fast.cost.total_us  # 5 us alpha rounds
+    # a malformed file is a loud error, not a silent default
+    bad = tmp_path / "bad.json"
+    bad.write_text("[]")
+    with pytest.raises(ValueError, match="JSON object"):
+        mpx.analyze(_step(comm), ranks_arange((8,)), comm=comm,
+                    ranks="all", cost=True, cost_model=str(bad))
+
+
+# ---------------------------------------------------------------------------
+# the ambient env path
+# ---------------------------------------------------------------------------
+
+
+def test_env_mode_attaches_cost(monkeypatch):
+    from mpi4jax_tpu.analysis.hook import set_report_sink
+
+    comm, _ = world()
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE", "warn")
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_COST", "on")
+    mpx.clear_caches()
+    sink = []
+    set_report_sink(sink)
+    try:
+        @mpx.spmd(comm=comm)
+        def step(x):
+            out, _ = mpx.allreduce(x, comm=comm)
+            return mpx.varying(out)
+
+        step(ranks_arange((8,)))
+    finally:
+        set_report_sink(None)
+    # a CLEAN report is sunk too when the cost pass ran: the CLI's
+    # --cost breakdown artifacts cover clean programs
+    assert sink, "cost-armed ambient pass sank no report"
+    where, report = sink[-1]
+    assert report.ok and report.cost is not None
+    assert report.cost.total_us > 0
+
+
+def test_hlo_byte_identical_with_cost_pass_armed(monkeypatch):
+    # the cost pass is pure host-side arithmetic over the re-traced
+    # schedules: the lowered HLO must stay byte-identical with it off,
+    # on, and on-with-tuning-file (the acceptance pin)
+    from mpi4jax_tpu.parallel.region import spmd
+
+    comm, _ = world()
+    x = ranks_arange((8,))
+
+    def lower():
+        mpx.clear_caches()
+        twin = spmd(lambda v: mpx.varying(mpx.allreduce(v, comm=comm)[0]),
+                    comm=comm, jit=False)
+        return jax.jit(twin).lower(x).as_text()
+
+    mpx.set_analyze_mode("warn")
+    off = lower()
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_COST", "on")
+    on = lower()
+    assert off == on
+
+
+def test_cache_keys_identical_when_cost_off(monkeypatch):
+    # cost=off must not change the analysis token folded into the
+    # compiled-program cache keys; cost=on must (a flip retraces)
+    from mpi4jax_tpu.analysis.hook import analysis_cache_token
+
+    base = analysis_cache_token()
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_COST", "off")
+    assert analysis_cache_token() == base
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_COST", "on")
+    assert analysis_cache_token() != base
+
+
+# ---------------------------------------------------------------------------
+# the seeded pipeline example (MPX135 positive + its fix)
+# ---------------------------------------------------------------------------
+
+
+def _pipeline():
+    import pipeline_parallel as pp
+
+    comm, size = world()
+    return pp, comm, size
+
+
+def test_pipeline_ladder_matches_reference():
+    pp, comm, size = _pipeline()
+    batch, dim = 8, 16
+    rng = np.random.default_rng(0)
+    x = jnp.zeros((size, batch, dim), jnp.float32).at[0].set(
+        jnp.asarray(rng.normal(size=(batch, dim)), jnp.float32))
+    ws = jnp.asarray(rng.normal(size=(size, dim, dim)) * 0.5, jnp.float32)
+    fwd, fwd_mb = pp.make_pipeline(comm)
+    ref = pp.reference(x[0], ws)
+    np.testing.assert_allclose(fwd(x, ws)[-1], ref, rtol=1e-5, atol=1e-5)
+    m = pp.MICROBATCHES
+    mbs = jnp.zeros((size, m, batch // m, dim), jnp.float32).at[0].set(
+        x[0].reshape(m, batch // m, dim))
+    out = fwd_mb(mbs, ws)[-1].reshape(batch, dim)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_ladder_reports_mpx135_microbatched_does_not():
+    pp, comm, size = _pipeline()
+    batch, dim = 8, 16
+    x = jnp.zeros((size, batch, dim), jnp.float32)
+    ws = jnp.zeros((size, dim, dim), jnp.float32)
+    fwd, fwd_mb = pp.make_pipeline(comm)
+    report = mpx.analyze(fwd, x, ws, ranks="all", cost=True)
+    assert not report.errors, report.render()
+    assert "MPX135" in codes(report)
+    assert report.cost is not None and report.cost.total_us > 0
+    # without the cost pass the ladder verifies clean: correct, not fast
+    plain = mpx.analyze(fwd, x, ws, ranks="all")
+    assert plain.ok, plain.render()
+    # the GPipe fix: same math, no serialized chain on the critical path
+    m = pp.MICROBATCHES
+    mbs = jnp.zeros((size, m, batch // m, dim), jnp.float32)
+    report_mb = mpx.analyze(fwd_mb, mbs, ws, ranks="all", cost=True)
+    assert not report_mb.errors, report_mb.render()
+    assert "MPX135" not in codes(report_mb)

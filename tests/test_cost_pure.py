@@ -1,0 +1,652 @@
+"""Static cost model + critic: the pure-Python half (docs/analysis.md
+"Cost model").
+
+The formula matrix (13 ops x {ring, butterfly, vdg, hier} x link
+classes), the alpha-beta-gamma time arithmetic, tuning-file
+parse/accept/reject, the critical-path timing simulation on scripted
+schedules, and the MPX131-MPX135 positive/negative matrix — all loaded
+under a private package name (the tests/test_analysis_pure.py isolated
+loader) so everything here runs even where the installed JAX is below
+the package's floor.  The traced integration half — cost=True through
+``mpx.analyze`` and the ambient env path on the real 8-device mesh —
+lives in tests/test_cost.py.
+"""
+
+import importlib
+import json
+import pathlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_cost_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "analysis", "ops", "parallel"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    # ops._algos / ops._hierarchy import jax.numpy (importable on any
+    # JAX) — they are the pinned byte models the cost formulas reuse
+    for mod in ("utils.config", "ops._fusion", "ops._algos",
+                "ops._hierarchy", "analysis.report", "analysis.graph",
+                "analysis.checkers", "analysis.schedule",
+                "analysis.matcher", "analysis.progress",
+                "analysis.costmodel", "analysis.cost",
+                "parallel.topology"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+cm = sys.modules[f"{_ISO_NAME}.analysis.costmodel"]
+cost = sys.modules[f"{_ISO_NAME}.analysis.cost"]
+sched = sys.modules[f"{_ISO_NAME}.analysis.schedule"]
+matcher = sys.modules[f"{_ISO_NAME}.analysis.matcher"]
+algos = sys.modules[f"{_ISO_NAME}.ops._algos"]
+hierarchy = sys.modules[f"{_ISO_NAME}.ops._hierarchy"]
+topology = sys.modules[f"{_ISO_NAME}.parallel.topology"]
+
+S = sched.SchedOp
+MODEL = cm.CostModel()
+
+
+def t_us(c):
+    return MODEL.time_us(c)
+
+
+# ---------------------------------------------------------------------------
+# the formula matrix: rounds + bytes per link class, all 13 ops
+# ---------------------------------------------------------------------------
+
+N = 8192  # payload bytes; k = 8 -> chunk = 1024
+K = 8
+CHUNK = 1024
+
+
+def test_allreduce_butterfly_single_and_multi_host():
+    c = cm.collective_cost("allreduce", "butterfly", N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (6, 6 * N)
+    assert not c.dcn and c.gamma_bytes == N
+    # multi-host flat: every round gated on DCN (the MPX113 hazard)
+    c = cm.collective_cost("allreduce", "butterfly", N, K, hosts=2)
+    assert (c.dcn.rounds, c.dcn.nbytes) == (6, 6 * N)
+    assert not c.ici
+
+
+def test_allreduce_ring_and_order_preserving_pair():
+    c = cm.collective_cost("allreduce", "ring", N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (14, 7 * CHUNK * 2)
+    cp = cm.collective_cost("allreduce", "ring", N, K, preserve=True)
+    assert cp.ici.nbytes == 7 * CHUNK * 3  # lo/hi accumulator pair
+    # bytes agree with the pinned algorithmic model
+    assert c.ici.nbytes == algos.algorithm_bytes_per_rank("ring", N, K)
+
+
+def test_reduce_prices_like_allreduce():
+    a = cm.collective_cost("allreduce", "ring", N, K)
+    r = cm.collective_cost("reduce", "ring", N, K)
+    assert (r.ici, r.dcn, r.gamma_bytes) == (a.ici, a.dcn, a.gamma_bytes)
+
+
+def test_reduce_scatter_ring_butterfly():
+    c = cm.collective_cost("reduce_scatter", "ring", N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (7, 7 * CHUNK)
+    c = cm.collective_cost("reduce_scatter", "butterfly", N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (6, 6 * N)
+    assert c.gamma_bytes == N
+
+
+def test_bcast_doubling_and_vdg():
+    c = cm.collective_cost("bcast", "butterfly", N, K)  # doubling
+    assert (c.ici.rounds, c.ici.nbytes) == (3, 3 * N)
+    c = cm.collective_cost("bcast", "ring", N, K)  # van de Geijn
+    assert (c.ici.rounds, c.ici.nbytes) == (3 + 7, N + 7 * CHUNK)
+    assert c.gamma_bytes == 0  # no fold in a broadcast
+
+
+@pytest.mark.parametrize("kind", ["allreduce", "reduce_scatter", "bcast"])
+def test_hier_bytes_reuse_the_pinned_models(kind):
+    h, r = 2, 4
+    c = cm.collective_cost(kind, "hier", N, K, hosts=h, hier=(h, r))
+    intra_b, inter_b = hierarchy.hier_link_bytes(kind, N, h, r)
+    assert (c.ici.nbytes, c.dcn.nbytes) == (intra_b, inter_b)
+    assert c.ici.rounds > 0 and c.dcn.rounds > 0
+
+
+def test_hier_allreduce_rounds():
+    # 2 hosts x 4 ranks: intra ring rs+ag = 2*(r-1) = 6 ICI rounds;
+    # the 2048 B shard is far below the DCN crossover -> butterfly
+    # inter phase, 2*ceil(log2 2) = 2 DCN rounds
+    c = cm.collective_cost("allreduce", "hier", N, K, hosts=2, hier=(2, 4))
+    assert c.ici.rounds == 6
+    assert c.dcn.rounds == 2
+
+
+def test_dcn_algo_rule_matches_algos():
+    # the local restatement must never drift from resolve_dcn_algo
+    for shard in (1 << 10, 1 << 22, 1 << 23, 1 << 24):
+        for h in (2, 4, 8):
+            for ring_ok in (True, False):
+                assert cm._dcn_algo(shard, h, ring_ok) == \
+                    algos.resolve_dcn_algo(shard, h, ring_ok)
+
+
+def test_remaining_collectives():
+    c = cm.collective_cost("allgather", None, N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (7, 7 * N)
+    c = cm.collective_cost("alltoall", None, N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (7, 7 * CHUNK)
+    c = cm.collective_cost("gather", None, N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (3, 7 * N)
+    c = cm.collective_cost("scatter", None, N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (3, 7 * CHUNK)
+    c = cm.collective_cost("scan", None, N, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (3, 3 * N)
+    assert c.gamma_bytes == N
+    c = cm.collective_cost("barrier", None, 0, K)
+    assert (c.ici.rounds, c.ici.nbytes) == (3, 0)
+    # multi-host attribution for the canonical models
+    c = cm.collective_cost("allgather", None, N, K, hosts=2)
+    assert c.dcn.rounds == 7 and not c.ici
+
+
+def test_p2p_and_degenerate_cases():
+    c = cm.p2p_cost(N, same_host=True)
+    assert (c.ici.rounds, c.ici.nbytes) == (1, N)
+    c = cm.p2p_cost(N, same_host=False)
+    assert (c.dcn.rounds, c.dcn.nbytes) == (1, N)
+    assert cm.collective_cost("allreduce", "ring", N, 1) is cm.ZERO_COST
+    with pytest.raises(ValueError, match="point-to-point"):
+        cm.collective_cost("send", None, N, K)
+    with pytest.raises(ValueError, match="unmodeled"):
+        cm.collective_cost("frobnicate", None, N, K)
+
+
+def test_every_public_op_is_modeled():
+    for op in cm.MODELED_OPS:
+        if op in ("send", "recv", "sendrecv"):
+            assert t_us(cm.p2p_cost(N)) > 0
+        elif op == "barrier":
+            assert t_us(cm.collective_cost(op, None, 0, K)) > 0
+        else:
+            assert t_us(cm.collective_cost(op, None, N, K)) > 0
+
+
+# ---------------------------------------------------------------------------
+# time arithmetic + model selection
+# ---------------------------------------------------------------------------
+
+
+def test_time_arithmetic():
+    m = cm.CostModel({"links": {"ici": {"alpha_us": 2.0,
+                                        "gb_per_s": 1.0}},
+                      "gamma_gb_per_s": 1.0})
+    # 1 GB/s == 1000 bytes/us
+    c = cm.OpCost(ici=cm.LinkTerm(3, 5000), gamma_bytes=2000)
+    assert m.time_us(c) == pytest.approx(3 * 2.0 + 5.0 + 2.0)
+
+
+def test_best_algo_crossover_behavior():
+    # tiny payload: log-depth butterfly wins; huge payload: ring wins;
+    # multi-host huge payload: the two-level lowering wins (the
+    # flat-vs-hier sign the --hierarchy-sweep acceptance compares)
+    best, _ = cm.best_algo("allreduce", 1 << 10, 8, MODEL)
+    assert best == "butterfly"
+    best, _ = cm.best_algo("allreduce", 1 << 24, 8, MODEL)
+    assert best == "ring"
+    best, times = cm.best_algo("allreduce", 1 << 24, 8, MODEL,
+                               hosts=2, hier=(2, 4))
+    assert best == "hier"
+    assert times["hier"] < times["ring"] < times["butterfly"]
+
+
+def test_stamp_is_hashable_and_param_sensitive():
+    a = cm.CostModel().stamp()
+    b = cm.CostModel({"links": {"ici": {"alpha_us": 9.0}}}).stamp()
+    assert hash(a) != hash(b) or a != b
+    assert a == cm.CostModel().stamp()
+
+
+# ---------------------------------------------------------------------------
+# tuning-file parse / accept / reject
+# ---------------------------------------------------------------------------
+
+GOOD = {
+    "schema": "mpx-cost-model/1",
+    "source": "benchmarks/micro.py --cost-calibrate (cpu, 8 devices)",
+    "links": {"ici": {"alpha_us": 1.5, "gb_per_s": 42.0},
+              "dcn": {"alpha_us": 30.0, "gb_per_s": 9.0}},
+    "gamma_gb_per_s": 350.0,
+    "compute_gb_per_s": 250.0,
+    "dispatch_us": 100.0,
+    "measured": {"ring_crossover_bytes": 917504},
+}
+
+
+def test_tuning_file_roundtrip(tmp_path):
+    path = tmp_path / "model.json"
+    path.write_text(json.dumps(GOOD))
+    m = cm.model_from_file(str(path))
+    assert m.params["links"]["ici"]["gb_per_s"] == 42.0
+    assert m.params["links"]["dcn"]["alpha_us"] == 30.0
+    assert m.params["dispatch_us"] == 100.0
+    assert m.measured["ring_crossover_bytes"] == 917504
+    assert m.source == str(path)
+    # partial files keep defaults for what they omit
+    m = cm.model_from_dict({"links": {"ici": {"alpha_us": 0.5}}})
+    assert m.params["links"]["ici"]["gb_per_s"] == \
+        cm.DEFAULT_PARAMS["links"]["ici"]["gb_per_s"]
+
+
+@pytest.mark.parametrize("payload, match", [
+    ([1, 2], "JSON object"),
+    ({"schema": "mpx-cost-model/999"}, "schema"),
+    ({"links": {"nvlink": {"gb_per_s": 1}}}, "unknown"),
+    ({"links": {"ici": {"gb_per_s": 0}}}, "must be > 0"),
+    ({"links": {"ici": {"gb_per_s": -3}}}, "must be > 0"),
+    ({"links": {"ici": {"alpha_us": "fast"}}}, "number"),
+    ({"links": {"ici": {"beta": 1.0}}}, "unknown"),
+    ({"links": "fast"}, "object"),
+    ({"gamma_gb_per_s": 0}, "positive"),
+    ({"measured": {"ring_crossover_bytes": "1MiB"}}, "number"),
+])
+def test_tuning_rejects(payload, match):
+    with pytest.raises(ValueError, match=match):
+        cm.validate_model_dict(payload)
+
+
+def test_load_model_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_COST_MODEL", raising=False)
+    assert cm.load_model(None).source is None  # analytic defaults
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps(GOOD))
+    monkeypatch.setenv("MPI4JAX_TPU_COST_MODEL", str(path))
+    assert cm.load_model(None).params["links"]["ici"]["gb_per_s"] == 42.0
+    meta = cm.measured_meta()
+    assert meta["cost_model"] == str(path)
+    assert meta["measured_ring_crossover_bytes"] == 917504
+    # malformed file: analyze raises loudly, measured_meta warns + {}
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv("MPI4JAX_TPU_COST_MODEL", str(bad))
+    with pytest.raises(ValueError, match="not valid JSON"):
+        cm.load_model(None)
+    with pytest.warns(UserWarning, match="ignored"):
+        assert cm.measured_meta() == {}
+
+
+def test_calibrate_shaped_payload_loads_verbatim():
+    # the benchmarks/micro.py --cost-calibrate output shape (the traced
+    # half drives the real generator in tests/test_micro_bench.py)
+    m = cm.model_from_dict(GOOD)
+    assert "cost-calibrate" in m.source
+    # a FULL --save sweep capture (tuning payload embedded under
+    # "cost_model") is accepted whole: the artifact IS a tuning file
+    sweep = {"platform": "cpu", "n_devices": 8, "allreduce": [],
+             "cost_model": GOOD}
+    m = cm.model_from_dict(sweep)
+    assert m.params["links"]["ici"]["gb_per_s"] == 42.0
+    assert m.measured["ring_crossover_bytes"] == 917504
+
+
+# ---------------------------------------------------------------------------
+# measured crossovers reach the MPX111 / MPX113 texts
+# ---------------------------------------------------------------------------
+
+
+def test_mpx113_cites_measured_crossover():
+    checkers = sys.modules[f"{_ISO_NAME}.analysis.checkers"]
+    graph = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+    E, G = graph.CollectiveEvent, graph.CollectiveGraph
+    e = E(0, "allreduce", comm_uid=1, comm_size=8, payload_bytes=1 << 21,
+          dtype="float32", shape=(1,), algo="ring", hosts=2)
+    meta = {"ring_crossover_bytes": 1 << 20}
+    (f,) = checkers.run_checkers(G(events=[e], meta=dict(meta)))
+    assert f.code == "MPX113" and "measured" not in f.message
+    meta.update({"measured_ring_crossover_bytes": 1 << 21,
+                 "cost_model": "results/cost.json"})
+    (f,) = checkers.run_checkers(G(events=[e], meta=dict(meta)))
+    assert f.code == "MPX113"
+    assert "measured crossover" in f.message
+    assert "results/cost.json" in f.message
+    # the measured value is also the firing threshold: below it, clean
+    meta["measured_ring_crossover_bytes"] = 1 << 22
+    assert not checkers.run_checkers(G(events=[e], meta=dict(meta)))
+
+
+def test_mpx111_cites_measured_bucket():
+    checkers = sys.modules[f"{_ISO_NAME}.analysis.checkers"]
+    graph = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+    E, G = graph.CollectiveEvent, graph.CollectiveGraph
+    events = [
+        E(i, "allreduce", comm_uid=1, reduction="sum",
+          payload_bytes=1024, dtype="float32", shape=(256,))
+        for i in range(2)
+    ]
+    meta = {"fusion": "off", "fusion_bucket_bytes": 4 << 20,
+            "measured_fusion_bucket_bytes": 2048,
+            "cost_model": "results/cost.json"}
+    finds = [f for f in checkers.run_checkers(G(events=events,
+                                                meta=dict(meta)))
+             if f.code == "MPX111"]
+    assert len(finds) == 1
+    assert "measured 2048 B bucket" in finds[0].message
+    assert "results/cost.json" in finds[0].message
+    # the measured bucket gates too: payloads above it no longer bucket
+    meta["measured_fusion_bucket_bytes"] = 512
+    assert not [f for f in checkers.run_checkers(
+        G(events=events, meta=dict(meta))) if f.code == "MPX111"]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr traffic estimate (duck-typed fakes)
+# ---------------------------------------------------------------------------
+
+
+class FakeVar:
+    def __init__(self, shape, dtype="float32"):
+        self.aval = types.SimpleNamespace(shape=shape,
+                                          dtype=np.dtype(dtype))
+
+
+class FakeEqn:
+    def __init__(self, outs, params=None):
+        self.outvars = outs
+        self.params = params or {}
+
+
+class FakeJaxpr:
+    def __init__(self, eqns):
+        self.eqns = eqns
+
+
+def test_jaxpr_traffic_bytes():
+    j = FakeJaxpr([FakeEqn([FakeVar((16, 4))]), FakeEqn([FakeVar((8,))])])
+    assert cost.jaxpr_traffic_bytes(j) == 16 * 4 * 4 + 8 * 4
+    # a loop body counts ONCE, never x trip count: the event stream
+    # records a loop body's collectives once too (the body traces
+    # once), so compute and communication must cover the same window —
+    # multiplying by length would false-fire MPX131 on every unrolled
+    # megastep (compute priced for N steps, comm for 1)
+    body = FakeJaxpr([FakeEqn([FakeVar((10,))])])
+    loop = FakeJaxpr([FakeEqn([FakeVar((999,))],
+                              {"jaxpr": body, "length": 5})])
+    assert cost.jaxpr_traffic_bytes(loop) == 40
+    # cond counts its widest branch
+    b1 = FakeJaxpr([FakeEqn([FakeVar((1,))])])
+    b2 = FakeJaxpr([FakeEqn([FakeVar((100,))])])
+    swtch = FakeJaxpr([FakeEqn([FakeVar((1,))], {"branches": (b1, b2)})])
+    assert cost.jaxpr_traffic_bytes(swtch) == 400
+    assert cost.jaxpr_traffic_bytes(None) == 0
+
+
+def test_topology_helpers():
+    host_of_rank = (0, 0, 0, 0, 1, 1, 1, 1)
+    assert topology.span_hosts(host_of_rank, [0, 1, 2]) == 1
+    assert topology.span_hosts(host_of_rank, [0, 4]) == 2
+    assert topology.link_class(host_of_rank, 0, 1) == "ici"
+    assert topology.link_class(host_of_rank, 0, 4) == "dcn"
+    assert topology.link_class(None, 0, 4) == "ici"
+
+
+# ---------------------------------------------------------------------------
+# scripted schedules -> critical-path simulation
+# ---------------------------------------------------------------------------
+
+
+def coll(rank, pos, op="allreduce", seq=0, parts=(0, 1, 2, 3),
+         nbytes=1 << 20, algo="ring", **kw):
+    return S(rank=rank, pos=pos, kind="coll", op=op, comm_key=0, seq=seq,
+             participants=tuple(parts), payload_bytes=nbytes, algo=algo,
+             **kw)
+
+
+def ladder_schedules(ranks=4, nbytes=1 << 16):
+    schedules = {r: [] for r in range(ranks)}
+    for s in range(1, ranks):
+        schedules[s - 1].append(
+            S(rank=s - 1, pos=len(schedules[s - 1]), kind="send", op="send",
+              comm_key=0, src=s - 1, dst=s, tag=s, payload_bytes=nbytes))
+        schedules[s].append(
+            S(rank=s, pos=len(schedules[s]), kind="recv", op="recv",
+              comm_key=0, src=s - 1, dst=s, tag=s, payload_bytes=nbytes))
+    return schedules
+
+
+def run(schedules, **kw):
+    matched = matcher.match_schedules(schedules)
+    assert not matched.findings, matched.findings
+    return cost.run_cost_pass(matched, model=kw.pop("model", MODEL), **kw)
+
+
+def test_collective_sequence_times_and_breakdown():
+    # 4 ranks, 2 ring allreduces back to back: the path is exactly
+    # 2 x the instance time, every byte on the ICI class
+    schedules = {r: [coll(r, 0, seq=0), coll(r, 1, seq=1)]
+                 for r in range(4)}
+    rep, findings = run(schedules)
+    assert rep is not None
+    one = MODEL.time_us(cm.collective_cost("allreduce", "ring",
+                                           1 << 20, 4))
+    assert rep.path_us == pytest.approx(2 * one)
+    assert rep.total_us == pytest.approx(2 * one + MODEL.dispatch_us)
+    assert rep.per_op["allreduce"]["count"] == 2
+    assert rep.per_link["dcn"]["bytes"] == 0
+    assert rep.per_link["ici"]["bytes"] > 0
+    assert rep.amortization["megastep_per_step_host_us"]["8"] == \
+        pytest.approx(MODEL.dispatch_us / 8)
+    assert [n["op"] for n in rep.critical_path] == ["allreduce"] * 2
+    json.dumps(rep.to_json())  # CI-consumable
+    assert "predicted step time" in rep.render()
+
+
+def test_straggler_defines_collective_completion():
+    # the last-arriving member gates the collective: rank 3's slow
+    # compute (fat fake jaxpr) pushes every member's completion
+    schedules = {r: [coll(r, 0)] for r in range(4)}
+    closed = {3: FakeJaxpr([FakeEqn([FakeVar((1 << 22,))])])}
+    rep, _ = run(schedules, closed=closed)
+    slow = MODEL.compute_us(1 << 24) / 2  # one of two gaps
+    one = MODEL.time_us(cm.collective_cost("allreduce", "ring",
+                                           1 << 20, 4))
+    # missing ranks reuse the first available estimate, so every rank
+    # carries the same gap here — completion includes one gap + op
+    assert rep.path_us == pytest.approx(2 * slow + one)
+
+
+def test_deadlock_yields_no_cost_report():
+    # head-to-head recv-first exchange: progress residue -> no timing
+    schedules = {
+        0: [S(rank=0, pos=0, kind="recv", op="recv", comm_key=0, src=1,
+              dst=0, tag=0),
+            S(rank=0, pos=1, kind="send", op="send", comm_key=0, src=0,
+              dst=1, tag=1)],
+        1: [S(rank=1, pos=0, kind="recv", op="recv", comm_key=0, src=0,
+              dst=1, tag=1),
+            S(rank=1, pos=1, kind="send", op="send", comm_key=0, src=1,
+              dst=0, tag=0)],
+    }
+    matched = matcher.match_schedules(schedules)
+    rep, findings = cost.run_cost_pass(matched, model=MODEL)
+    assert rep is None and findings == []
+
+
+def test_start_wait_overlap_is_visible():
+    # start ... wait on 2 ranks: the wait completes at start-issue +
+    # op time; with no compute in the gap the whole op time is exposed
+    def sw(r):
+        return [
+            S(rank=r, pos=0, kind="start", op="allreduce_start",
+              comm_key=0, seq=0, participants=(0, 1),
+              payload_bytes=1 << 20, algo="butterfly", span=7),
+            S(rank=r, pos=1, kind="wait", op="allreduce_wait", comm_key=0,
+              seq=0, participants=(0, 1), payload_bytes=1 << 20,
+              algo="butterfly", span=7),
+        ]
+    rep, _ = run({0: sw(0), 1: sw(1)})
+    one = MODEL.time_us(cm.collective_cost("allreduce", "butterfly",
+                                           1 << 20, 2))
+    assert rep.path_us == pytest.approx(one)
+    # async spans account under the BASE op name: one collective type,
+    # one per-op row, blocking or split
+    assert rep.per_op["allreduce"]["count"] == 1
+    assert "allreduce_wait" not in rep.per_op
+
+
+# ---------------------------------------------------------------------------
+# the critic: MPX131-135 positive/negative
+# ---------------------------------------------------------------------------
+
+
+def test_mpx131_overlap_opportunity():
+    schedules = {r: [coll(r, 0)] for r in range(4)}
+    # big adjacent compute: the gap can hide most of the collective
+    closed = {r: FakeJaxpr([FakeEqn([FakeVar((1 << 22,))])])
+              for r in range(4)}
+    rep, findings = run(schedules, closed=closed)
+    f = [x for x in findings if x.code == "MPX131"]
+    assert len(f) == 1
+    assert "hide" in f[0].message and "us" in f[0].message
+    # negative: no compute to hide behind
+    _, findings = run(schedules)
+    assert not [x for x in findings if x.code == "MPX131"]
+
+
+def test_mpx132_fusion_savings_quantified():
+    schedules = {
+        r: [coll(r, 0, seq=0, nbytes=1 << 16, algo="butterfly",
+                 reduction="sum"),
+            coll(r, 1, seq=1, nbytes=1 << 16, algo="butterfly",
+                 reduction="sum")]
+        for r in range(4)
+    }
+    meta = {"fusion": "off", "fusion_bucket_bytes": 4 << 20}
+    rep, findings = run(schedules, meta=meta)
+    f = [x for x in findings if x.code == "MPX132"]
+    assert len(f) == 1
+    assert "us saved per step" in f[0].message
+    assert rep.amortization["fusion_savings_us"] > 0
+    # negative: fusion already on
+    _, findings = run(schedules, meta={"fusion": "auto"})
+    assert not [x for x in findings if x.code == "MPX132"]
+    # negative: payloads above the measured bucket cap never bucket
+    meta = {"fusion": "off", "fusion_bucket_bytes": 4 << 20,
+            "measured_fusion_bucket_bytes": 1024}
+    _, findings = run(schedules, meta=meta)
+    assert not [x for x in findings if x.code == "MPX132"]
+
+
+def test_mpx133_algorithm_mispick():
+    # 16 MiB on the butterfly: the model predicts the ring, loudly
+    schedules = {r: [coll(r, 0, nbytes=1 << 24, algo="butterfly")]
+                 for r in range(4)}
+    _, findings = run(schedules)
+    f = [x for x in findings if x.code == "MPX133"]
+    assert len(f) == 1
+    assert "'ring'" in f[0].message and "us/step faster" in f[0].message
+    assert "MPI4JAX_TPU_COLLECTIVE_ALGO=ring" in f[0].suggestion
+    # negative: the chosen algo IS the model's pick
+    schedules = {r: [coll(r, 0, nbytes=1 << 24, algo="ring")]
+                 for r in range(4)}
+    _, findings = run(schedules)
+    assert not [x for x in findings if x.code == "MPX133"]
+
+
+def test_mpx134_structural_imbalance():
+    schedules = {
+        r: [coll(r, 0, nbytes=(1 << 20) * (2 if r == 3 else 1))]
+        for r in range(4)
+    }
+    _, findings = run(schedules)
+    f = [x for x in findings if x.code == "MPX134"]
+    assert len(f) == 1
+    assert f[0].rank == 3 and "straggler by construction" in f[0].message
+    # negative: uniform payloads
+    _, findings = run({r: [coll(r, 0)] for r in range(4)})
+    assert not [x for x in findings if x.code == "MPX134"]
+
+
+def test_mpx135_serialized_chain_positive_negative():
+    _, findings = run(ladder_schedules(ranks=4))
+    f = [x for x in findings if x.code == "MPX135"]
+    assert len(f) == 1
+    assert "microbatch" in f[0].suggestion
+    assert "critical path" in f[0].message
+    # negative: a 2-rank ping-pong never spans enough ranks
+    schedules = {
+        0: [S(rank=0, pos=0, kind="send", op="send", comm_key=0, src=0,
+              dst=1, tag=0, payload_bytes=64),
+            S(rank=0, pos=1, kind="recv", op="recv", comm_key=0, src=1,
+              dst=0, tag=1, payload_bytes=64)],
+        1: [S(rank=1, pos=0, kind="recv", op="recv", comm_key=0, src=0,
+              dst=1, tag=0, payload_bytes=64),
+            S(rank=1, pos=1, kind="send", op="send", comm_key=0, src=1,
+              dst=0, tag=1, payload_bytes=64)],
+    }
+    _, findings = run(schedules)
+    assert not [x for x in findings if x.code == "MPX135"]
+
+
+def test_wildcard_recv_skips_sends_consumed_by_specific_recvs():
+    # rank 2 receives from rank 0 BY SOURCE, then from anyone: the
+    # wildcard must pair with rank 1's still-unconsumed send (a DCN
+    # hop here), exactly as the untimed simulation pairs them — not
+    # with rank 0's already-consumed one (regression: the timed pool
+    # must drain on specific recvs too)
+    schedules = {
+        0: [S(rank=0, pos=0, kind="send", op="send", comm_key=0, src=0,
+              dst=2, tag=0, payload_bytes=1 << 16)],
+        1: [S(rank=1, pos=0, kind="send", op="send", comm_key=0, src=1,
+              dst=2, tag=0, payload_bytes=1 << 16)],
+        2: [S(rank=2, pos=0, kind="recv", op="recv", comm_key=0, src=0,
+              dst=2, tag=0, payload_bytes=1 << 16),
+            S(rank=2, pos=1, kind="recv", op="recv", comm_key=0, src=None,
+              dst=2, tag=0, payload_bytes=1 << 16)],
+    }
+    host_of_rank = (0, 1, 0)  # rank 1 lives across the DCN
+    rep, _ = run(schedules, host_of_rank=host_of_rank)
+    assert rep.per_link["ici"]["rounds"] == 1  # 0 -> 2, by source
+    assert rep.per_link["dcn"]["rounds"] == 1  # 1 -> 2, wildcard
+
+
+def test_mpx132_never_fires_on_eager_ops():
+    # an eager op never enters the fusion queue (MPX111's rule): the
+    # quantified twin must mirror the exclusion
+    schedules = {
+        r: [coll(r, 0, seq=0, nbytes=1 << 16, algo="butterfly",
+                 reduction="sum", eager=True),
+            coll(r, 1, seq=1, nbytes=1 << 16, algo="butterfly",
+                 reduction="sum", eager=True)]
+        for r in range(4)
+    }
+    _, findings = run(schedules,
+                      meta={"fusion": "off",
+                            "fusion_bucket_bytes": 4 << 20})
+    assert not [x for x in findings if x.code == "MPX132"]
+
+
+def test_multi_host_ladder_prices_on_dcn():
+    host_of_rank = (0, 0, 1, 1)
+    rep, _ = run(ladder_schedules(ranks=4), host_of_rank=host_of_rank)
+    # hops 0->1 and 2->3 are ICI, 1->2 crosses hosts
+    assert rep.per_link["dcn"]["rounds"] == 1
+    assert rep.per_link["ici"]["rounds"] == 2
+
+
+def test_cost_codes_are_advisory():
+    report = sys.modules[f"{_ISO_NAME}.analysis.report"]
+    for code in cost.COST_CODES:
+        assert report.CODES[code].severity == report.ADVISORY

@@ -154,3 +154,66 @@ def test_save_results_roundtrip(tmp_path):
     assert os.path.basename(path).startswith("micro_cpu_8dev_")
     with open(path) as f:
         assert json.load(f) == payload
+
+
+def test_fit_alpha_beta_exact_line():
+    # a perfect alpha-beta line fits back exactly: 2 us + bytes at
+    # 1 GB/s (== 1000 bytes/us)
+    pts = [(b, 2.0 + b / 1e3) for b in (1e3, 1e4, 1e5, 1e6)]
+    alpha, bw = micro.fit_alpha_beta(pts)
+    assert abs(alpha - 2.0) < 1e-6
+    assert abs(bw - 1.0) < 1e-6
+
+
+def test_fit_alpha_beta_clamps_degenerate():
+    # a tiny sweep can fit a negative intercept / non-positive slope;
+    # the result must still be loadable (alpha >= 0, bw > 0)
+    alpha, bw = micro.fit_alpha_beta([(16.0, 5.0), (32.0, 4.0)])
+    assert alpha >= 0 and bw > 0
+
+
+def test_measured_ring_crossover_interpolates():
+    rows = [
+        {"size_mb": 0.1, "butterfly_us": 10.0, "ring_us": 20.0,
+         "ring_speedup": 0.5},
+        {"size_mb": 1.0, "butterfly_us": 40.0, "ring_us": 30.0,
+         "ring_speedup": 1.33},
+    ]
+    x = micro.measured_ring_crossover(rows)
+    # delta goes -10 -> +10 over 0.1..1 MB: crossover at the midpoint
+    assert x is not None and 0.5e6 < x < 0.6e6
+    # one-device sweeps (speedup None) yield no crossover
+    assert micro.measured_ring_crossover(
+        [{"size_mb": 1.0, "butterfly_us": 1, "ring_us": 1,
+          "ring_speedup": None}]) is None
+
+
+def test_cost_calibrate_schema_loads_verbatim(tmp_path):
+    # the --cost-calibrate output IS the MPI4JAX_TPU_COST_MODEL tuning
+    # file: build it from real (tiny) sweep rows, save it, and load it
+    # through the cost-model loader — schema drift fails here, fast
+    from mpi4jax_tpu.analysis import costmodel
+
+    comm = _world_comm()
+    pp = micro.bench_sendrecv_ring(comm, sizes_kb=[0.004, 4], iters=2)
+    al = micro.bench_allreduce_algos(comm, sizes_mb=[0.0001], iters=2)
+    cm = micro.build_cost_model("cpu", comm.Get_size(), pp, al)
+    assert cm["schema"] == costmodel.SCHEMA
+    assert set(cm["links"]) == {"ici", "dcn"}
+    path = micro.save_cost_model(cm, outdir=str(tmp_path))
+    assert os.path.basename(path).startswith("cost_model_cpu_")
+    model = costmodel.model_from_file(path)
+    assert model.params["links"]["ici"]["gb_per_s"] > 0
+    assert model.source == path
+    # and the env-flag route resolves the same file
+    saved = os.environ.get("MPI4JAX_TPU_COST_MODEL")
+    os.environ["MPI4JAX_TPU_COST_MODEL"] = path
+    try:
+        loaded = costmodel.load_model(None)
+        assert loaded.params["links"]["ici"] == \
+            model.params["links"]["ici"]
+    finally:
+        if saved is None:
+            os.environ.pop("MPI4JAX_TPU_COST_MODEL", None)
+        else:
+            os.environ["MPI4JAX_TPU_COST_MODEL"] = saved
