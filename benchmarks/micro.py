@@ -465,6 +465,25 @@ def bench_dispatch_unroll(comm, unrolls=(1, 8, 64), size_kb=0.004,
     }
 
 
+# saved-sweep schema version: bumped when the --save payload shape
+# changes, so the autotune fitter (mpi4jax_tpu/autotune/) can reject
+# captures it does not understand instead of misreading them
+MICRO_SCHEMA = "mpx-micro-bench/1"
+
+
+def provenance_block(platform, n_devices):
+    """The self-description every ``--save`` capture carries: jax/jaxlib
+    versions, the topology the rows were measured under, and a content
+    stamp of the whole declared-flag surface — so a saved sweep is a
+    self-describing input to the autotune fitter (no more guessing what
+    configuration produced which number).  One implementation serves
+    every emitted artifact: this delegates to the canonical
+    ``mpi4jax_tpu.autotune.runner.provenance_block``."""
+    from mpi4jax_tpu.autotune.runner import provenance_block as _pb
+
+    return _pb(platform, n_devices)
+
+
 def fit_alpha_beta(points):
     """Least-squares fit of the alpha-beta line ``t_us = alpha_us +
     bytes / (gb_per_s * 1e3)`` over ``points`` = [(bytes, us), ...].
@@ -490,28 +509,25 @@ def measured_ring_crossover(algo_rows):
     sweep points — the measured twin of
     ``MPI4JAX_TPU_RING_CROSSOVER_BYTES`` the MPX109/111/113 advisories
     cite when a tuning file is loaded.  ``None`` when the ring never
-    wins in the sweep (or the sweep ran on one device)."""
-    prev = None
-    for row in algo_rows:
-        if row.get("ring_speedup") is None:
-            return None
-        nbytes = row["size_mb"] * 1e6
-        delta = row["butterfly_us"] - row["ring_us"]  # >0: ring wins
-        if delta >= 0:
-            if prev is None:
-                return int(nbytes)
-            p_bytes, p_delta = prev
-            span = delta - p_delta
-            frac = (-p_delta / span) if span > 0 else 0.0
-            return int(p_bytes + frac * (nbytes - p_bytes))
-        prev = (nbytes, delta)
-    return None
+    wins in the sweep (or the sweep ran on one device — marked by a
+    ``None`` speedup).  The interpolation itself is the canonical
+    ``autotune.fit.measured_crossover`` (one copy of the math)."""
+    from mpi4jax_tpu.autotune.fit import measured_crossover
+
+    if any(row.get("ring_speedup") is None for row in algo_rows):
+        return None  # 1-device sweep: no crossover is meaningful
+    return measured_crossover(algo_rows, "size_mb", "butterfly_us",
+                              "ring_us")
 
 
 def build_cost_model(platform, n_devices, sendrecv_rows, algo_rows):
-    """The ``--cost-calibrate`` payload: a complete ``mpx-cost-model/1``
-    tuning file (analysis/costmodel.py schema) that
-    ``MPI4JAX_TPU_COST_MODEL`` loads verbatim.
+    """The ``--cost-calibrate`` payload: a complete ``mpx-tuning/1``
+    file — the SUPERSET schema (mpi4jax_tpu/autotune/schema.py) that
+    both ``MPI4JAX_TPU_COST_MODEL`` (the cost model keeps accepting
+    plain ``mpx-cost-model/1`` files too — documented alias, no
+    breaking change) and the ``MPI4JAX_TPU_TUNING`` config layer load
+    verbatim: one calibration capture feeds the selector and the cost
+    model alike (docs/autotune.md).
 
     ICI alpha/beta are fit by least squares over the sendrecv ring
     latency sweep (one hop = one alpha + payload/bandwidth — exactly
@@ -531,7 +547,7 @@ def build_cost_model(platform, n_devices, sendrecv_rows, algo_rows):
     dcn_bw_ratio = (defaults["links"]["dcn"]["gb_per_s"]
                     / defaults["links"]["ici"]["gb_per_s"])
     payload = {
-        "schema": costmodel.SCHEMA,
+        "schema": costmodel.TUNING_SCHEMA,
         "source": (f"benchmarks/micro.py --cost-calibrate ({platform}, "
                    f"{n_devices} devices; dcn scaled from the ici fit "
                    "by the analytic ratios)"),
@@ -549,8 +565,17 @@ def build_cost_model(platform, n_devices, sendrecv_rows, algo_rows):
     crossover = measured_ring_crossover(algo_rows)
     if crossover is not None:
         payload["measured"] = {"ring_crossover_bytes": crossover}
-    # the emitted file must load verbatim — validate before anyone saves
-    costmodel.validate_model_dict(payload)
+        # the superset's tuned section: the config layer serves this
+        # value to resolve_algo when the file loads as MPI4JAX_TPU_TUNING
+        payload["tuned"] = {"ring_crossover_bytes": crossover}
+    payload["provenance"] = provenance_block(platform, n_devices)
+    # the emitted file must load verbatim through BOTH consumers —
+    # validate against the superset schema (which delegates the
+    # cost-model section to the cost model's own rules) before anyone
+    # saves it
+    from mpi4jax_tpu.autotune.schema import validate_tuning_dict
+
+    validate_tuning_dict(payload)
     return payload
 
 
@@ -727,8 +752,12 @@ def main():
           if args.dispatch_sweep else None)
 
     payload = {
+        "schema": MICRO_SCHEMA,
         "platform": devices[0].platform,
         "n_devices": n,
+        # self-description (jax/jaxlib, topology, config stamp): saved
+        # sweeps are fitter inputs, so they must say what produced them
+        "provenance": provenance_block(devices[0].platform, n),
         # honesty marker (docs/microbenchmarks.md): with a single
         # device there is no interconnect to measure, and dispatch/
         # attach overhead can dominate the timings — never read 1-device

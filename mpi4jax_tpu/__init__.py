@@ -100,6 +100,14 @@ from .aot import (  # noqa: F401
 )
 from . import telemetry  # noqa: F401
 from .telemetry import set_telemetry_mode  # noqa: F401
+# the tuning layer (docs/autotune.md): mpx.autotune() measures, the
+# config layer serves (default < tuning < env).  NOTE this rebinds the
+# package attribute `mpi4jax_tpu.autotune` to the FUNCTION — the
+# callable is the public API; the subpackage stays reachable through
+# the path-based forms only (`python -m mpi4jax_tpu.autotune`,
+# `from mpi4jax_tpu.autotune import ...`), never via attribute access
+from .autotune import TuningFile, autotune  # noqa: F401
+from .utils.config import active_tuning, load_tuning  # noqa: F401
 from .utils.profiling import ProfileSummary, profile_ops  # noqa: F401
 
 # JAX version advisory at import (ref mpi4jax/_src/__init__.py:6-8).

@@ -288,8 +288,7 @@ def check_unfused_adjacent(graph: CollectiveGraph) -> List[Finding]:
     measured = graph.meta.get("measured_fusion_bucket_bytes")
     cap = measured or graph.meta.get("fusion_bucket_bytes", 0)
     cap_cite = (
-        f"the measured {measured} B bucket "
-        f"(cost model {graph.meta.get('cost_model')})"
+        f"the measured {measured} B bucket ({_calibration_cite(graph.meta)})"
         if measured else "the fusion bucket cap"
     )
     findings: List[Finding] = []
@@ -597,7 +596,7 @@ def check_flat_over_dcn(graph: CollectiveGraph) -> List[Finding]:
     if not crossover:
         return []
     cite = (
-        f"measured crossover, cost model {graph.meta.get('cost_model')}"
+        f"measured crossover, {_calibration_cite(graph.meta)}"
         if measured else "ring crossover"
     )
     findings: List[Finding] = []
@@ -629,16 +628,37 @@ def check_flat_over_dcn(graph: CollectiveGraph) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
+def _calibration_cite(meta: dict) -> str:
+    """Provenance of a measured threshold in an advisory text: the
+    tuning layer's content stamp (``tuned@<stamp>`` — docs/autotune.md)
+    when one is active, else the cost-model file path
+    (``MPI4JAX_TPU_COST_MODEL``)."""
+    stamp = meta.get("tuned_stamp")
+    if stamp:
+        return f"tuned@{stamp}"
+    return f"cost model {meta.get('cost_model')}"
+
+
 @checker("MPX109")
 def check_crossover_proximity(graph: CollectiveGraph) -> List[Finding]:
     """Payload within 2x of the ring/butterfly crossover under algo=auto:
     shape-polymorphic retraces straddling the threshold silently flip the
-    lowering (same math, different perf) between traces."""
+    lowering (same math, different perf) between traces.  With an active
+    tuning layer the crossover in the snapshot IS the measured value
+    (the config layer serves it), and the text carries the
+    ``tuned@<stamp>`` provenance."""
     if graph.meta.get("collective_algo", "auto") != "auto":
         return []
     crossover = graph.meta.get("ring_crossover_bytes")
     if not crossover:
         return []
+    # cite measured provenance only when the effective crossover IS the
+    # layer's measured value — a file that tunes other knobs, or an env
+    # override shadowing the file, must not be presented as "measured"
+    measured = graph.meta.get("measured_ring_crossover_bytes")
+    cite = (f"measured ring crossover, {_calibration_cite(graph.meta)}"
+            if graph.meta.get("tuned_stamp") and measured == crossover
+            else "ring crossover")
     findings: List[Finding] = []
     for e in graph.events:
         if e.op not in ALGO_OPS or e.algo in (None, "native"):
@@ -650,7 +670,7 @@ def check_crossover_proximity(graph: CollectiveGraph) -> List[Finding]:
             findings.append(Finding(
                 code="MPX109", op=e.op, index=e.index,
                 message=(f"{e.op} payload ({e.payload_bytes} B) is within "
-                         f"2x of the ring crossover ({crossover} B) under "
+                         f"2x of the {cite} ({crossover} B) under "
                          "algo=auto: retraces at nearby shapes may pick "
                          f"different lowerings (this trace chose "
                          f"'{e.algo}')"),

@@ -558,6 +558,16 @@ def run_cost_pass(matched: MatchedProgram, *, model: Optional[CostModel]
 # ---------------------------------------------------------------------------
 
 
+def _model_provenance(model) -> str:
+    """Advisory-text provenance of a tuning-layer-sourced model
+    (``tuned@<stamp>`` — docs/autotune.md): the MPX131-133 texts then
+    cite MEASURED parameters, not the analytic defaults.  Empty for
+    defaults and plain cost-model files (whose path already rides
+    ``Report.cost``)."""
+    stamp = getattr(model, "tuned_stamp", None)
+    return f" [model tuned@{stamp}]" if stamp else ""
+
+
 def _check_overlap(sim: _TimedSimulation,
                    matched: MatchedProgram) -> List[Finding]:
     """MPX131: blocking collectives whose predicted wire time the
@@ -590,7 +600,8 @@ def _check_overlap(sim: _TimedSimulation,
             message=(f"{v['count']} blocking {name} collective(s) on comm "
                      f"{comm_uid} predict {v['total']:.1f} us of wire "
                      f"time while the adjacent compute could hide "
-                     f"{v['hideable']:.1f} us (~{pct:.0f}%) of it"),
+                     f"{v['hideable']:.1f} us (~{pct:.0f}%) of it"
+                     + _model_provenance(sim.model)),
             suggestion=(f"split them with {name}_start/{name}_wait and "
                         "issue the independent compute between the two "
                         "(mpx.overlap() pairs automatically) — "
@@ -653,7 +664,7 @@ def _check_fusion(sim: _TimedSimulation, matched: MatchedProgram,
                      f"coalesce into one flat-buffer collective: the "
                      f"cost model predicts {separate:.1f} us separate "
                      f"vs {fused:.1f} us fused — {savings:.1f} us "
-                     "saved per step"),
+                     "saved per step" + _model_provenance(sim.model)),
             suggestion=("set MPI4JAX_TPU_FUSION=auto (or "
                         "mpx.set_fusion_mode('auto')) and consume "
                         "results after issuing the whole batch — "
@@ -714,7 +725,8 @@ def _check_mispick(sim: _TimedSimulation,
                      f"{k} rank(s)) lowered as '{chosen}' "
                      f"({times[chosen]:.1f} us predicted) but the cost "
                      f"model predicts '{best}' at {times[best]:.1f} us "
-                     f"— {delta:.1f} us/step faster"),
+                     f"— {delta:.1f} us/step faster"
+                     + _model_provenance(sim.model)),
             suggestion=(f"force MPI4JAX_TPU_COLLECTIVE_ALGO={best} for "
                         "an A/B run, or recalibrate the crossover flags "
                         "with benchmarks/micro.py --cost-calibrate"),

@@ -59,6 +59,14 @@ LINK_CLASSES = (ICI, DCN)
 
 SCHEMA = "mpx-cost-model/1"
 
+# the tuning superset (autotune/schema.py): an ``mpx-tuning/1`` file
+# carries the same links/gamma/compute/dispatch/measured sections plus
+# the config-layer knobs, so EITHER schema feeds this model —
+# ``benchmarks/micro.py --cost-calibrate`` now emits the superset and
+# ``MPI4JAX_TPU_COST_MODEL`` keeps accepting both (docs/autotune.md)
+TUNING_SCHEMA = "mpx-tuning/1"
+ACCEPTED_SCHEMAS = (SCHEMA, TUNING_SCHEMA)
+
 # ops whose lowering folds operands locally (the gamma term)
 REDUCTION_OPS = ("allreduce", "reduce", "reduce_scatter", "scan")
 
@@ -130,11 +138,17 @@ class CostModel:
     analytic defaults); ``measured`` carries the calibrated crossovers
     the checker texts cite (MPX111/MPX113)."""
 
-    __slots__ = ("params", "source", "measured")
+    __slots__ = ("params", "source", "measured", "tuned_stamp")
 
     def __init__(self, params: Optional[dict] = None,
                  source: Optional[str] = None,
-                 measured: Optional[dict] = None):
+                 measured: Optional[dict] = None,
+                 tuned_stamp: Optional[str] = None):
+        # provenance of a tuning-layer-sourced model: the mpx-tuning/1
+        # content stamp the MPX131-133 advisory texts cite as
+        # ``tuned@<stamp>`` (None for files loaded via the cost-model
+        # flag or the analytic defaults)
+        self.tuned_stamp = tuned_stamp
         base = {
             "links": {
                 lc: dict(DEFAULT_PARAMS["links"][lc]) for lc in LINK_CLASSES
@@ -184,7 +198,7 @@ class CostModel:
         )
         return (links, self.params["gamma_gb_per_s"],
                 self.params["compute_gb_per_s"], self.params["dispatch_us"],
-                self.source)
+                self.source, self.tuned_stamp)
 
     def to_json(self) -> dict:
         out = {"schema": SCHEMA, "links": {
@@ -225,10 +239,10 @@ def validate_model_dict(payload) -> Tuple[dict, dict]:
         # sweep artifact IS a valid MPI4JAX_TPU_COST_MODEL file
         payload = payload["cost_model"]
     schema = payload.get("schema", SCHEMA)
-    if schema != SCHEMA:
+    if schema not in ACCEPTED_SCHEMAS:
         raise ValueError(
             f"cost-model tuning file declares schema {schema!r}; this "
-            f"build reads {SCHEMA!r}"
+            f"build reads {ACCEPTED_SCHEMAS}"
         )
     params: dict = {}
     links = payload.get("links")
@@ -296,6 +310,16 @@ def model_from_dict(payload, source: Optional[str] = None) -> CostModel:
     return CostModel(params, source=source, measured=measured)
 
 
+def model_from_tuning(tf) -> CostModel:
+    """A model sourced from the active tuning layer (an
+    ``autotune.schema.TuningFile``): same parameter extraction as a
+    direct file load, plus the ``tuned@<stamp>`` provenance the
+    MPX131-133 texts cite."""
+    params, measured = validate_model_dict(tf.payload)
+    return CostModel(params, source=tf.path or "<tuning layer>",
+                     measured=measured, tuned_stamp=tf.stamp)
+
+
 def model_from_file(path: str) -> CostModel:
     try:
         with open(path) as f:
@@ -329,6 +353,12 @@ def load_model(spec=None) -> CostModel:
     path = spec if isinstance(spec, str) and spec else \
         config.cost_model_path()
     if not path:
+        # the unification bridge (docs/autotune.md): with no cost-model
+        # flag, an active tuning layer that carries the links section
+        # feeds the model — one file serves selector and cost model
+        tf = config.active_tuning()
+        if tf is not None and tf.has_links():
+            return model_from_tuning(tf)
         return CostModel()
     try:
         mtime = os.path.getmtime(path)
@@ -358,7 +388,21 @@ def measured_meta() -> dict:
     the same error loudly)."""
     path = config.cost_model_path()
     if not path:
-        return {}
+        # the tuning layer's measured section feeds the same advisory
+        # texts, tagged with its content stamp (``tuned@<stamp>``)
+        try:
+            tf = config.active_tuning()
+        except ValueError as e:
+            warnings.warn(f"MPI4JAX_TPU_TUNING ignored for advisory "
+                          f"texts: {e}", stacklevel=2)
+            return {}
+        if tf is None:
+            return {}
+        out = {"cost_model": tf.path or "<tuning layer>",
+               "tuned_stamp": tf.stamp}
+        for k, v in tf.measured().items():
+            out[f"measured_{k}"] = v
+        return out
     try:
         model = load_model(path)
     except ValueError as e:

@@ -1,0 +1,70 @@
+"""Feedback-directed autotuning of the performance knobs.
+
+``mpx.autotune(comm=..., budget_s=..., save=...)`` measures every
+load-bearing magic number on the ACTUAL mesh — ring crossover, DCN
+crossover, fusion bucket bytes, overlap chunk counts, cost-model
+alpha/beta per link class, commit pack throughput — by running the
+microbench sweeps as a library, fits the per-(payload-bucket,
+topology, link-class) optima, and emits an ``mpx-tuning/1`` file the
+config layer serves between defaults and environment
+(``MPI4JAX_TPU_TUNING`` / ``mpx.load_tuning``; docs/autotune.md).
+
+Offline (fleet pre-tuning)::
+
+    python -m mpi4jax_tpu.autotune --budget-s 60 --save tuning.json
+
+This ``__init__`` imports only the stdlib halves (schema + fitters) so
+the isolated-loader tests — and ``utils/config.py``'s lazy tuning-layer
+imports — work under any installed JAX; the measuring runner (which
+needs jax and a mesh) loads on first call.
+"""
+
+from .fit import (  # noqa: F401
+    analytic_crossover,
+    auto_commit_interval,
+    chunk_buckets,
+    measured_crossover,
+    pick_min,
+)
+from .schema import (  # noqa: F401
+    COST_SCHEMA,
+    KNOB_FLAGS,
+    SCHEMA,
+    TuningFile,
+    load_tuning_file,
+    stamp_of,
+    validate_tuning_dict,
+)
+
+__all__ = [
+    "SCHEMA",
+    "COST_SCHEMA",
+    "KNOB_FLAGS",
+    "TuningFile",
+    "load_tuning_file",
+    "stamp_of",
+    "validate_tuning_dict",
+    "measured_crossover",
+    "analytic_crossover",
+    "pick_min",
+    "chunk_buckets",
+    "auto_commit_interval",
+    "autotune",
+    "AutotuneResult",
+]
+
+
+def autotune(*args, **kwargs):
+    """See :func:`mpi4jax_tpu.autotune.runner.autotune` (lazy: the
+    runner needs jax + the microbench library)."""
+    from .runner import autotune as _autotune
+
+    return _autotune(*args, **kwargs)
+
+
+def __getattr__(name):
+    if name == "AutotuneResult":
+        from .runner import AutotuneResult
+
+        return AutotuneResult
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

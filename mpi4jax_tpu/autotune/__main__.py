@@ -1,0 +1,76 @@
+"""Offline autotuning CLI (fleet pre-tuning — docs/autotune.md).
+
+Usage::
+
+    python -m mpi4jax_tpu.autotune --budget-s 60 --save tuning.json
+        [--topologies 2x4 4x2] [--json]
+
+Runs the full measurement loop on the current mesh (the same sweeps
+``mpx.autotune()`` runs in-process) and writes the ``mpx-tuning/1``
+file a fleet scheduler ships to every job via ``MPI4JAX_TPU_TUNING``.
+
+Exit codes (the analysis CLI's contract):
+
+- ``0`` — every knob fitted; the saved file validates;
+- ``1`` — partial: the file was still written, but some knobs are
+  untuned (e.g. a 1-device mesh has no crossover to measure) — usable,
+  listed on stderr;
+- ``2`` — usage error, or the mesh/sweeps failed outright (no file).
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.autotune",
+        description="measure the perf knobs on the actual mesh and emit "
+                    "an mpx-tuning/1 file (docs/autotune.md)")
+    p.add_argument("--budget-s", type=float, default=60.0,
+                   help="wall-clock measurement budget in seconds "
+                        "(default 60; each sweep climbs its payload "
+                        "ladder while time remains)")
+    p.add_argument("--save", default="tuning.json",
+                   help="output path for the tuning file "
+                        "(default tuning.json)")
+    p.add_argument("--topologies", nargs="*", default=[],
+                   help="MPI4JAX_TPU_TOPOLOGY specs to sweep per-topology "
+                        "crossover overrides for (e.g. 2x4 4x2); specs "
+                        "not covering the mesh are skipped with a note")
+    p.add_argument("--json", action="store_true",
+                   help="print the emitted payload to stdout as JSON")
+    args = p.parse_args(argv)
+    if args.budget_s <= 0:
+        print("autotune: --budget-s must be > 0", file=sys.stderr)
+        return 2
+
+    try:
+        from .runner import autotune
+
+        result = autotune(budget_s=args.budget_s, save=args.save,
+                          load=False, topologies=tuple(args.topologies),
+                          verbose=True)
+    except Exception as e:
+        # ANY failed run is exit 2 — a crash must never be confused
+        # with exit 1 ("partial fit, file still written"), which fleet
+        # scripts treat as a usable tune
+        print(f"autotune: failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(result.payload))
+    print(f"autotune: tuned@{result.stamp} -> {result.path} "
+          f"({len(result.fitted)} knob(s) fitted, "
+          f"{result.elapsed_s:.1f}s)", file=sys.stderr)
+    if result.unfitted:
+        print("autotune: untuned knob(s): "
+              + ", ".join(result.unfitted), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

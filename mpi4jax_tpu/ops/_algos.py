@@ -67,9 +67,20 @@ def algo_cache_token() -> tuple:
     ``MPI4JAX_TPU_COLLECTIVE_ALGO`` — or the topology override / DCN
     crossover the hierarchical layer reads — retraces instead of silently
     serving the old program.  (The mesh-derived half of the topology is
-    already in both cache keys via the mesh itself.)"""
-    return (config.collective_algo(), config.ring_crossover_bytes(),
+    already in both cache keys via the mesh itself.)
+
+    The tuning layer's content stamp (``config.tuning_stamp()``,
+    docs/autotune.md) folds in whenever a layer is active — every
+    ``mpx.load_tuning`` of new content retraces even where its values
+    happen to match the defaults (the env route pins a file's content
+    at first read per process, so an in-place edit needs the explicit
+    ``load_tuning(path)`` refresh); with no layer the token is exactly
+    the pre-tuning 4-tuple, so cache keys stay byte-identical (pinned
+    by tests/test_autotune.py)."""
+    base = (config.collective_algo(), config.ring_crossover_bytes(),
             config.dcn_crossover_bytes(), config.topology_spec())
+    stamp = config.tuning_stamp()
+    return base if stamp is None else base + (("tuning", stamp),)
 
 
 def static_group_size(comm):

@@ -271,9 +271,12 @@ def allreduce_start(x, op=None, *, comm: Optional[Comm] = None,
         handle.plan = plan if use_hier else None
         xl = as_varying(xl, comm.axes)
         flat = xl.reshape(-1)
-        sizes = overlap_chunk_split(flat.shape[0], config.overlap_chunks())
-        handle.sizes = sizes
         nbytes = flat.shape[0] * xl.dtype.itemsize
+        # payload-aware chunk count: a tuning layer may bucket it by
+        # payload bytes (docs/autotune.md); env flag still wins
+        sizes = overlap_chunk_split(flat.shape[0],
+                                    config.overlap_chunks(nbytes))
+        handle.sizes = sizes
         if use_hier:
             link = _hierarchy.hier_link_bytes("allreduce", nbytes, plan.h,
                                               plan.r)
@@ -421,9 +424,10 @@ def reduce_scatter_start(x, op=None, *, comm: Optional[Comm] = None,
         handle.mode = "ring"
         handle.algo = "hier" if use_hier else "ring"
         blocks = xl.reshape(size, -1)
-        sizes = overlap_chunk_split(blocks.shape[1], config.overlap_chunks())
-        handle.sizes = sizes
         nbytes = xl.size * xl.dtype.itemsize
+        sizes = overlap_chunk_split(blocks.shape[1],
+                                    config.overlap_chunks(nbytes))
+        handle.sizes = sizes
         if use_hier:
             link = _hierarchy.hier_link_bytes("reduce_scatter", nbytes,
                                               plan.h, plan.r)

@@ -1852,6 +1852,26 @@ def align_commit_every(commit_every: int, unroll: int) -> int:
     return ((commit_every + unroll - 1) // unroll) * unroll
 
 
+def resolve_auto_commit_interval(step_time_s: float,
+                                 commit_cost_s: float) -> int:
+    """The ``commit_every='auto'`` decision (ROADMAP item 4c): the
+    smallest interval that keeps the MEASURED commit (``ShardStore``
+    pack) cost at or under the target fraction of the MEASURED step
+    time (autotune/fit.auto_commit_interval).  The target comes from
+    the active tuning layer's ``tuned.commit.target_overhead``
+    (docs/autotune.md) when one is loaded, else the 5% default."""
+    from ..autotune.fit import auto_commit_interval
+
+    target = None
+    try:
+        tf = config.active_tuning()
+    except ValueError:  # malformed env tuning file: keep the default
+        tf = None
+    if tf is not None:
+        target = tf.commit_param("target_overhead")
+    return auto_commit_interval(step_time_s, commit_cost_s, target)
+
+
 def run(step_fn, state, store: ShardStore, *, steps: int,
         start_step: int = 0, commit_every: int = 1,
         claim_watchdog: bool = True, drain_on_sigterm: bool = True):
@@ -1884,7 +1904,16 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
     (resized, new-epoch) comm and the step re-traces at the new size.
     ``commit_every`` bounds the recovery replay window; the initial
     state is committed before step ``start_step`` so a first-step
-    failure is recoverable.  ``claim_watchdog=True`` installs the
+    failure is recoverable.  ``commit_every='auto'`` measures instead
+    of guessing (ROADMAP item 4c): the loop commits every boundary
+    until it has timed one post-warmup step (the first call is
+    skipped — it carries trace+compile) and one ``ShardStore`` pack,
+    then
+    locks in the smallest interval keeping commit overhead under the
+    target fraction of step time
+    (:func:`resolve_auto_commit_interval`; the target reads the active
+    tuning layer's ``tuned.commit.target_overhead`` —
+    docs/autotune.md).  ``claim_watchdog=True`` installs the
     elastic expiry handler (``resilience.set_on_timeout``) for the
     duration of the loop, so an expiry becomes a recovery instead of a
     process kill — the detection path a hung (not dead) peer needs.
@@ -1896,6 +1925,22 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
 
     if steps < 0:
         raise ValueError(f"steps must be >= 0, got {steps}")
+    # commit_every='auto': pick the interval from measured step time vs
+    # measured ShardStore pack cost (resolve_auto_commit_interval) —
+    # the loop commits every boundary until both measurements exist
+    # (the first step and the first commit), then locks the interval in
+    auto_commit: Optional[dict] = None
+    if isinstance(commit_every, str):
+        if commit_every != "auto":
+            raise ValueError(
+                f"commit_every must be an int >= 1 or 'auto', got "
+                f"{commit_every!r}"
+            )
+        # warm=False skips the FIRST step's timing: for a jit/spmd step
+        # it includes trace+compile, which would inflate step_s by
+        # orders of magnitude and lock the interval at 1 forever
+        auto_commit = {"step_s": None, "commit_s": None, "warm": False}
+        commit_every = 1
     if commit_every < 1:
         raise ValueError(f"commit_every must be >= 1, got {commit_every}")
 
@@ -1957,17 +2002,43 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
             prev_sigterm = install_preemption_handler()
         _restart_elastic_servers(servers, store)
         if store.committed_step is None:
+            # deliberately NOT timed for commit_every='auto': the first
+            # pack carries one-time costs (first-touch allocation) that
+            # would overestimate commit_s — the first IN-LOOP commit is
+            # the warmed measurement, symmetric with the step warmup
             store.commit(start_step, state)
         step = start_step
         while step < steps:
             try:
+                t0 = time.perf_counter()
                 state = step_fn(state, step, store.comm)
                 _block_on(state)
+                if auto_commit is not None and auto_commit["step_s"] is None:
+                    if auto_commit["warm"]:
+                        # per-STEP time: a megastep covers stride steps
+                        auto_commit["step_s"] = \
+                            (time.perf_counter() - t0) / stride
+                    else:
+                        auto_commit["warm"] = True  # first call compiles
                 step += stride
                 committed = False
                 if (step - start_step) % commit_every == 0 or step == steps:
+                    t0 = time.perf_counter()
                     store.commit(step, state)
+                    if (auto_commit is not None
+                            and auto_commit["commit_s"] is None):
+                        auto_commit["commit_s"] = time.perf_counter() - t0
                     committed = True
+                if (auto_commit is not None
+                        and auto_commit["step_s"] is not None
+                        and auto_commit["commit_s"] is not None):
+                    commit_every = align_commit_every(
+                        resolve_auto_commit_interval(
+                            auto_commit["step_s"],
+                            auto_commit["commit_s"]),
+                        stride)
+                    auto_commit = None  # locked in for the rest of the run
+                    _meter("elastic.auto_commits")
                 outcome = _boundary_actions(
                     store, step, steps, state, committed,
                     start_step, commit_every, servers)

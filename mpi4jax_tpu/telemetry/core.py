@@ -401,6 +401,15 @@ def snapshot(include_events: bool = False) -> dict:
                 or any(v for k, v in cc["disk_cache"].items()
                        if isinstance(v, int))):
             snap["compile_cache"] = cc
+    # the active tuning layer (docs/autotune.md): stamp + per-knob
+    # tuned-vs-default values, so report() renders what this process is
+    # actually running with.  Absent entirely when no layer is loaded —
+    # the snapshot stays byte-identical to a build without autotune.
+    from ..utils import config as _config
+
+    tuning = _config.tuning_snapshot()
+    if tuning:
+        snap["tuning"] = tuning
     if include_events:
         snap["events"] = journal.snapshot_events()
     return snap

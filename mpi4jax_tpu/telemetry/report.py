@@ -215,6 +215,38 @@ def render(snaps: List[dict]) -> str:
         else:
             lines.append("  disk: persistent tier disabled "
                          "(MPI4JAX_TPU_COMPILE_CACHE_DIR unset)")
+    # the active tuning layer (docs/autotune.md): the stamp every
+    # advisory cites as tuned@<stamp>, plus each knob's tuned value
+    # against the static default (and whether an explicit env flag is
+    # overriding the file — default < tuning < env)
+    tunings = {}
+    for snap in snaps:
+        t = snap.get("tuning")
+        if t:
+            tunings.setdefault(str(t.get("stamp")), t)
+    if tunings:
+        lines.append("")
+        lines.append("tuning:")
+        for stamp in sorted(tunings):
+            t = tunings[stamp]
+            src = t.get("path") or "<in-memory>"
+            lines.append(f"  tuned@{stamp}  ({src})")
+            for name in sorted(t.get("knobs", {})):
+                row = t["knobs"][name]
+                if row.get("tuned") is None:
+                    continue
+                mark = ("  [env wins: "
+                        f"{row.get('effective')}]"
+                        if row.get("env_wins") else "")
+                lines.append(
+                    f"    {name:<22} tuned {str(row['tuned']):>10}  "
+                    f"(default {row.get('default')}){mark}"
+                )
+            commit = t.get("commit") or {}
+            if commit:
+                parts = ", ".join(f"{k}={v}" for k, v in
+                                  sorted(commit.items()))
+                lines.append(f"    commit: {parts}")
     epochs = {}
     for snap in snaps:
         for rec in snap.get("epochs", ()):

@@ -213,6 +213,22 @@ FLAGS = {
              "``AnalysisError`` instead.  ``off`` (default) records "
              "nothing; the lowered HLO is byte-identical in every mode.",
              choices=ANALYZE_MODES),
+        Flag("MPI4JAX_TPU_TUNING", "str", "",
+             "Tuning layer (mpi4jax_tpu/autotune/, docs/autotune.md): a "
+             "``mpx-tuning/1`` JSON file — what ``mpx.autotune()`` / "
+             "``python -m mpi4jax_tpu.autotune`` emits — loaded as a "
+             "configuration layer between the static defaults and the "
+             "environment: a knob's tuned value applies unless its own "
+             "flag is explicitly set (default < tuning < env).  Serves "
+             "measured ring/DCN crossovers, fusion bucket bytes, and "
+             "overlap chunk counts per (payload, topology) bucket, plus "
+             "the cost-model alpha/beta section when "
+             "``MPI4JAX_TPU_COST_MODEL`` is unset.  The file's content "
+             "stamp folds into every compiled-program cache key, so "
+             "loading or changing a file retraces; empty (default) "
+             "keeps cache keys and HLO byte-identical to a build "
+             "without the tuning layer.  ``mpx.load_tuning(path)`` is "
+             "the programmatic form (it wins over this flag)."),
         Flag("MPI4JAX_TPU_COST_MODEL", "str", "",
              "Tuning file for the static communication cost model "
              "(analysis/costmodel.py): a JSON file with measured "
@@ -368,6 +384,137 @@ def config_stamp() -> tuple:
     configuration against this and the parsing cost leaves the per-call
     dispatch path."""
     return (_config_epoch, env_fingerprint())
+
+# ---------------------------------------------------------------------------
+# the tuning layer (feedback-directed configuration — docs/autotune.md)
+# ---------------------------------------------------------------------------
+#
+# ``mpx.autotune()`` measures the perf knobs on the actual mesh and emits
+# an ``mpx-tuning/1`` file (autotune/schema.py); this layer serves its
+# values BETWEEN the static defaults and the environment:
+#
+#     default  <  tuning file  <  explicitly-set env flag
+#
+# so a fleet pre-tuned file never overrides an operator's deliberate
+# override.  The active file resolves from ``load_tuning()`` (wins) or
+# ``MPI4JAX_TPU_TUNING``; its content stamp folds into
+# ``ops/_algos.algo_cache_token()`` — and through it into both
+# compiled-program cache keys — so loading or changing a file retraces.
+# With no file active every getter below returns exactly its pre-layer
+# value and the stamp contributes nothing: cache keys and HLO stay
+# byte-identical (pinned by tests/test_autotune.py).
+
+_tuning_override = None  # autotune.schema.TuningFile set by load_tuning()
+
+
+def load_tuning(spec=None):
+    """Install a tuning layer programmatically: ``spec`` is a file path,
+    a parsed ``mpx-tuning/1`` payload dict, or a ``TuningFile``.
+    ``None`` clears the programmatic layer (an ``MPI4JAX_TPU_TUNING``
+    env file, if set, becomes active again).  Returns the installed
+    ``TuningFile`` (or ``None``).  Bumps the config epoch so every
+    stamp-memoized consumer — and with it both program caches —
+    retraces."""
+    global _tuning_override
+    if spec is None:
+        _tuning_override = None
+        bump_config_epoch()
+        return None
+    from ..autotune.schema import as_tuning
+
+    # fresh=True: a path is RE-READ even if the env route memoized it —
+    # this call is the documented way to pick up an edited file, and
+    # the epoch bump below retraces every consumer consistently
+    tf = as_tuning(spec, fresh=True)
+    _tuning_override = tf
+    bump_config_epoch()
+    try:  # meter the load (no-op when telemetry is off)
+        from ..telemetry.core import meter
+
+        meter("autotune.loads")
+    except ImportError:  # isolated loaders without the telemetry package
+        pass
+    return tf
+
+
+def active_tuning():
+    """The active ``TuningFile``, or ``None`` when no layer is loaded.
+    Raises ``ValueError`` on a malformed ``MPI4JAX_TPU_TUNING`` file —
+    a typo'd path must not silently run untuned."""
+    if _tuning_override is not None:
+        return _tuning_override
+    path = (_getenv("MPI4JAX_TPU_TUNING") or "").strip()
+    if not path:
+        return None
+    from ..autotune.schema import load_tuning_file_memo
+
+    return load_tuning_file_memo(path)
+
+
+def tuning_stamp() -> Optional[str]:
+    """Content stamp of the active tuning layer (the ``tuned@<stamp>``
+    provenance tag), or ``None`` when inactive — the cache-key
+    contribution (ops/_algos.algo_cache_token)."""
+    tf = active_tuning()
+    return tf.stamp if tf is not None else None
+
+
+def _tuned_knob(name: str, payload_bytes: Optional[int] = None):
+    """The active layer's value for one knob (``None`` = untuned),
+    resolved per the current topology override and payload bucket.
+    Callers apply the env-wins precedence BEFORE consulting this."""
+    tf = active_tuning()
+    if tf is None:
+        return None
+    return tf.knob(name, topology=topology_spec() or None,
+                   payload_bytes=payload_bytes)
+
+
+def tuning_snapshot() -> Optional[dict]:
+    """JSON-able view of the active layer for telemetry
+    (telemetry/core.snapshot -> report's "tuning" section): stamp,
+    source path, and per-knob tuned / default / effective values with
+    an ``env_wins`` marker where an explicit flag overrides the file.
+    ``None`` when the layer is inactive (the snapshot then carries no
+    tuning payload at all)."""
+    try:
+        tf = active_tuning()
+    except ValueError:
+        return None
+    if tf is None:
+        return None
+    from ..autotune.schema import KNOB_FLAGS
+
+    defaults = {
+        "ring_crossover_bytes": DEFAULT_RING_CROSSOVER_BYTES,
+        "dcn_crossover_bytes": DEFAULT_DCN_CROSSOVER_BYTES,
+        "fusion_bucket_bytes": DEFAULT_FUSION_BUCKET_BYTES,
+        "overlap_chunks": DEFAULT_OVERLAP_CHUNKS,
+    }
+    getters = {
+        "ring_crossover_bytes": ring_crossover_bytes,
+        "dcn_crossover_bytes": dcn_crossover_bytes,
+        "fusion_bucket_bytes": fusion_bucket_bytes,
+        "overlap_chunks": overlap_chunks,
+    }
+    knobs = {}
+    for name, flag in KNOB_FLAGS.items():
+        raw = _getenv(flag)
+        env_wins = raw is not None and bool(raw.strip())
+        tuned = tf.knob(name, topology=topology_spec() or None)
+        knobs[name] = {
+            "tuned": tuned,
+            "default": defaults[name],
+            "effective": getters[name](),
+            "env_wins": env_wins,
+        }
+    return {
+        "stamp": tf.stamp,
+        "path": tf.path,
+        "knobs": knobs,
+        "commit": dict(tf.payload.get("tuned", {}).get("commit", {})),
+    }
+
 
 TRUTHY = ("true", "1", "on", "yes")
 FALSY = ("false", "0", "off", "no", "")
@@ -558,9 +705,14 @@ def collective_algo() -> str:
 
 def ring_crossover_bytes() -> int:
     """Payload bytes at which ``auto`` prefers the ring lowerings
-    (``MPI4JAX_TPU_RING_CROSSOVER_BYTES``; default 1 MiB)."""
+    (``MPI4JAX_TPU_RING_CROSSOVER_BYTES``; default 1 MiB; a tuning
+    layer's measured value applies when the flag is not explicitly
+    set — docs/autotune.md)."""
     raw = _getenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES")
     if raw is None or not raw.strip():
+        tuned = _tuned_knob("ring_crossover_bytes")
+        if tuned is not None:
+            return tuned
         return DEFAULT_RING_CROSSOVER_BYTES
     try:
         val = int(raw)
@@ -577,12 +729,29 @@ def ring_crossover_bytes() -> int:
     return val
 
 
+def _env_or_tuned(name: str, knob: str, static_default: int,
+                  minimum: int = 0,
+                  payload_bytes: Optional[int] = None) -> int:
+    """One tuned int knob under the default < tuning < env precedence:
+    an explicitly set (non-empty) env flag wins WITHOUT consulting the
+    tuning layer at all — so a malformed tuning file can never mask a
+    deliberate override, and the env fast path skips the knob lookup —
+    else the active layer's value, else the static default."""
+    raw = _getenv(name)
+    if raw is not None and raw.strip():
+        return _parse_env_positive_int(name, static_default, minimum)
+    tuned = _tuned_knob(knob, payload_bytes=payload_bytes)
+    return tuned if tuned is not None else static_default
+
+
 def dcn_crossover_bytes() -> int:
     """Shard bytes at which the hierarchical lowerings' inter-host (DCN)
     phase prefers the ring (``MPI4JAX_TPU_DCN_CROSSOVER_BYTES``; default
-    4 MiB — see docs/topology.md)."""
-    return _parse_env_positive_int(
-        "MPI4JAX_TPU_DCN_CROSSOVER_BYTES", DEFAULT_DCN_CROSSOVER_BYTES
+    4 MiB — see docs/topology.md; a tuning layer's measured value
+    applies when the flag is not explicitly set)."""
+    return _env_or_tuned(
+        "MPI4JAX_TPU_DCN_CROSSOVER_BYTES", "dcn_crossover_bytes",
+        DEFAULT_DCN_CROSSOVER_BYTES,
     )
 
 
@@ -710,17 +879,24 @@ def fusion_mode() -> str:
 
 def fusion_bucket_bytes() -> int:
     """Byte cap per (dtype-segregated) fusion bucket
-    (``MPI4JAX_TPU_FUSION_BUCKET_BYTES``; default 4 MiB)."""
-    return _parse_env_positive_int(
-        "MPI4JAX_TPU_FUSION_BUCKET_BYTES", DEFAULT_FUSION_BUCKET_BYTES
+    (``MPI4JAX_TPU_FUSION_BUCKET_BYTES``; default 4 MiB; a tuning
+    layer's measured value applies when the flag is not explicitly
+    set)."""
+    return _env_or_tuned(
+        "MPI4JAX_TPU_FUSION_BUCKET_BYTES", "fusion_bucket_bytes",
+        DEFAULT_FUSION_BUCKET_BYTES,
     )
 
 
-def overlap_chunks() -> int:
+def overlap_chunks(payload_bytes: Optional[int] = None) -> int:
     """Chunk count for the async start/wait collectives
-    (``MPI4JAX_TPU_OVERLAP_CHUNKS``; default 2, minimum 1)."""
-    return _parse_env_positive_int(
-        "MPI4JAX_TPU_OVERLAP_CHUNKS", DEFAULT_OVERLAP_CHUNKS, minimum=1
+    (``MPI4JAX_TPU_OVERLAP_CHUNKS``; default 2, minimum 1).  A tuning
+    layer may bucket the value by payload: callers that know their
+    payload pass it (ops/_async.py) and get the bucket's chunk count;
+    the flag, when explicitly set, still wins everywhere."""
+    return _env_or_tuned(
+        "MPI4JAX_TPU_OVERLAP_CHUNKS", "overlap_chunks",
+        DEFAULT_OVERLAP_CHUNKS, minimum=1, payload_bytes=payload_bytes,
     )
 
 

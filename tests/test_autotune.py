@@ -1,0 +1,271 @@
+"""Autotune + tuning layer: the traced integration half
+(docs/autotune.md).
+
+On the real 8-device mesh: loading a tuning file retraces BOTH program
+caches (the stamp is in every key) for eager and spmd alike; with no
+file the dynamic cache token and the lowered HLO are byte-identical to
+a build without the layer; ``resolve_algo`` flips lowerings at a seeded
+measured crossover; the MPX113 advisory carries ``tuned@<stamp>``
+provenance; ``mpx.elastic.run(commit_every='auto')`` completes a real
+single-process run; telemetry meters/snapshot/report carry the tuning
+section; and (slow) a live ``mpx.autotune()`` with a small budget emits
+a file that validates, loads, and round-trips the offline CLI.  The
+pure half (schema, fitters, precedence, commit math) is
+tests/test_autotune_pure.py.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.autotune import SCHEMA, validate_tuning_dict
+from mpi4jax_tpu.ops._base import dynamic_cache_token
+from helpers import ranks_arange, world
+
+
+def _tuning_payload(**over):
+    base = {
+        "schema": SCHEMA,
+        "links": {"ici": {"alpha_us": 0.5, "gb_per_s": 50.0}},
+        "tuned": {"ring_crossover_bytes": 64,
+                  "fusion_bucket_bytes": 2 << 20},
+        "measured": {"ring_crossover_bytes": 64},
+    }
+    base.update(over)
+    return base
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("MPI4JAX_TPU_TUNING", "MPI4JAX_TPU_COST_MODEL",
+                "MPI4JAX_TPU_RING_CROSSOVER_BYTES",
+                "MPI4JAX_TPU_COLLECTIVE_ALGO"):
+        monkeypatch.delenv(var, raising=False)
+    mpx.load_tuning(None)
+    yield
+    mpx.load_tuning(None)
+    mpx.set_analyze_mode(None)
+    mpx.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# cache-token + HLO identity with no file (pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_no_file_token_and_hlo_identity():
+    """With no tuning layer the dynamic cache token and the lowered HLO
+    must be byte-identical to a build without autotune: load+clear must
+    round-trip to the exact same token VALUE and program text."""
+    import jax
+
+    comm, _ = world()
+    x = ranks_arange((4,))
+
+    def lower_text():
+        from jax.sharding import PartitionSpec as P
+
+        from mpi4jax_tpu.parallel.region import make_region_body
+
+        def step(v):
+            return mpx.varying(mpx.allreduce(v, op=mpx.PROD)[0])
+
+        body = make_region_body(step, comm, (), (), (), 1,
+                                squeeze_in=True, squeeze_out=True)
+        sm = jax.jit(jax.shard_map(
+            body, mesh=comm.mesh, in_specs=P(comm.axes[0]),
+            out_specs=P(comm.axes[0])))
+        return sm.lower(x).as_text()
+
+    tok0 = dynamic_cache_token()
+    base = lower_text()
+    tf = mpx.load_tuning(_tuning_payload())
+    assert mpx.active_tuning() is tf
+    tok1 = dynamic_cache_token()
+    assert tok1 != tok0  # the stamp (and tuned crossover) moved the key
+    mpx.load_tuning(None)
+    assert dynamic_cache_token() == tok0  # exact VALUE round trip
+    assert lower_text() == base
+
+
+def test_stamp_retraces_eager_program():
+    comm, _ = world()
+    mpx.clear_caches()
+    x = ranks_arange((4,))
+    mpx.allreduce(x, op=mpx.PROD)
+    # values identical to the defaults — ONLY the stamp moves the key
+    mpx.load_tuning({"schema": SCHEMA})
+    mpx.allreduce(x, op=mpx.PROD)                 # miss: retrace
+    mpx.load_tuning({"schema": SCHEMA, "source": "v2"})
+    mpx.allreduce(x, op=mpx.PROD)                 # changed file: retrace
+    mpx.load_tuning(None)
+    mpx.allreduce(x, op=mpx.PROD)                 # back: hit
+    s = mpx.cache_stats()
+    assert s["misses"] == 3 and s["hits"] == 1
+    mpx.clear_caches()
+
+
+def test_stamp_retraces_spmd_program():
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode("counters")
+    try:
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.allreduce(xl, op=mpx.PROD)
+            return res
+
+        x = ranks_arange((4,))
+        f(x)
+        f(x)                                      # hit
+        mpx.load_tuning({"schema": SCHEMA})
+        f(x)                                      # miss: retrace
+        meters = mpx.telemetry.snapshot()["meters"]
+        assert meters.get("spmd_cache.misses") == 2
+        assert meters.get("spmd_cache.hits") == 1
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# the selector follows the measured values
+# ---------------------------------------------------------------------------
+
+
+def _algo_of(fn, *args, comm):
+    report = mpx.analyze(fn, *args, comm=comm)
+    (evt,) = [e for e in report.events if e.op == "allreduce"]
+    return evt.algo
+
+
+def test_resolve_algo_flips_at_seeded_crossover(monkeypatch):
+    comm, size = world()
+    if size < 4:
+        pytest.skip("ring needs >= 4 ranks")
+
+    def step(v):
+        return mpx.varying(mpx.allreduce(v, op=mpx.PROD)[0])
+
+    x = ranks_arange((64,))  # 256 B/rank payload, PROD: no native HLO
+    assert _algo_of(step, x, comm=comm) == "butterfly"  # default 1 MiB
+    mpx.load_tuning(_tuning_payload())  # measured crossover: 64 B
+    assert _algo_of(step, x, comm=comm) == "ring"
+    # the env flag still wins over the file
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", str(1 << 20))
+    assert _algo_of(step, x, comm=comm) == "butterfly"
+
+
+def test_mpx113_advisory_carries_tuned_provenance(monkeypatch):
+    comm, size = world()
+    if size < 4 or size % 2:
+        pytest.skip("needs an even multi-host-fakeable mesh")
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "ring")
+    tf = mpx.load_tuning(_tuning_payload())
+
+    def step(v):
+        return mpx.varying(mpx.allreduce(v, op=mpx.PROD)[0])
+
+    report = mpx.analyze(step, ranks_arange((64,)), comm=comm)
+    (f,) = [x for x in report.findings if x.code == "MPX113"]
+    assert f"tuned@{tf.stamp}" in f.message
+    assert "measured crossover" in f.message
+
+
+# ---------------------------------------------------------------------------
+# elastic commit_every='auto' end to end (single process, real store)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_run_auto_commit():
+    import numpy as np
+
+    comm, _ = world()
+    store = mpx.ShardStore(comm)
+
+    def step(state, i, c):
+        return {"p": state["p"] * 0.5 + 1.0}
+
+    state0 = {"p": np.arange(32, dtype=np.float32)}
+    out = mpx.elastic.run(step, state0, store, steps=3,
+                          commit_every="auto")
+    np.testing.assert_allclose(out["p"],
+                               ((state0["p"] * 0.5 + 1) * 0.5 + 1)
+                               * 0.5 + 1)
+    assert store.committed_step == 3  # the final commit always lands
+
+
+# ---------------------------------------------------------------------------
+# telemetry: meters + snapshot + report section
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_tuning_section():
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode("counters")
+    try:
+        snap0 = mpx.telemetry.snapshot()
+        assert "tuning" not in snap0  # inactive layer: no payload at all
+        tf = mpx.load_tuning(_tuning_payload())
+        meters = mpx.telemetry.snapshot()["meters"]
+        assert meters.get("autotune.loads") == 1
+        snap = mpx.telemetry.snapshot()
+        assert snap["tuning"]["stamp"] == tf.stamp
+        knob = snap["tuning"]["knobs"]["ring_crossover_bytes"]
+        assert knob["tuned"] == 64 and knob["effective"] == 64
+        text = mpx.telemetry.report(comm=None)
+        assert f"tuned@{tf.stamp}" in text
+        assert "ring_crossover_bytes" in text
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# the live loop + offline CLI (slow: runs real sweeps)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_live_autotune_roundtrip(tmp_path):
+    path = tmp_path / "tuning.json"
+    result = mpx.autotune(budget_s=5.0, save=str(path), load=True)
+    payload = json.loads(path.read_text())
+    validate_tuning_dict(payload)  # the emitted file validates
+    assert payload["schema"] == SCHEMA
+    assert payload["links"]["ici"]["gb_per_s"] > 0
+    assert payload["provenance"]["n_devices"] >= 1
+    assert "fusion_bucket_bytes" in payload["tuned"]
+    assert "commit" in payload["tuned"]
+    # load=True installed the layer: the stamp is live
+    assert mpx.active_tuning() is not None
+    assert mpx.active_tuning().stamp == result.stamp
+    meters = None
+    mpx.set_telemetry_mode("counters")
+    try:
+        mpx.autotune(budget_s=2.0, load=False)
+        meters = mpx.telemetry.snapshot()["meters"]
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+    assert meters.get("autotune.runs") == 1
+    assert meters.get("autotune.fits", 0) >= 3
+
+
+@pytest.mark.slow
+def test_offline_cli_contract(tmp_path):
+    out = tmp_path / "t.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.autotune",
+         "--budget-s", "5", "--save", str(out), "--json"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode in (0, 1), proc.stderr  # partial is legal
+    payload = json.loads(proc.stdout)
+    validate_tuning_dict(payload)
+    assert out.exists()
+    assert "tuned@" in proc.stderr

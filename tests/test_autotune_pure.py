@@ -1,0 +1,806 @@
+"""Autotune + tuning layer: the pure-Python half (docs/autotune.md).
+
+Schema parse/accept/reject, the content stamp, knob lookup per
+(payload-bucket, topology), the config layer's default < tuning < env
+precedence, the cache-token fold, the pure fitters (crossover
+interpolation, alpha-beta closed form, candidate argmin, chunk
+buckets, commit-interval math), the cost-model unification
+(``mpx-tuning/1`` accepted alongside ``mpx-cost-model/1``), the
+``tuned@<stamp>`` advisory provenance, and
+``mpx.elastic.run(commit_every='auto')`` control flow on a scripted
+store — all loaded under a private package name (the isolated-loader
+idiom of tests/test_cost_pure.py) so everything runs even where the
+installed JAX is below the package's floor.
+
+The traced half — retrace pins, HLO byte-identity with no file, the
+live ``mpx.autotune()`` loop on the 8-device mesh — is
+tests/test_autotune.py (needs jax >= the package floor).
+"""
+
+import importlib
+import json
+import os
+import pathlib
+import sys
+import time
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_autotune_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "analysis", "ops", "parallel", "resilience"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "autotune", "autotune.schema",
+                "autotune.fit", "autotune.runner", "ops._fusion",
+                "ops._algos", "ops._hierarchy", "analysis.report",
+                "analysis.graph", "analysis.checkers",
+                "analysis.schedule", "analysis.matcher",
+                "analysis.progress", "analysis.costmodel",
+                "analysis.cost", "parallel.topology",
+                "resilience.faultinject", "resilience.retry",
+                "resilience.watchdog", "resilience.elastic",
+                "resilience.runtime"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+config = ISO.utils.config
+schema = sys.modules[f"{_ISO_NAME}.autotune.schema"]
+fit = sys.modules[f"{_ISO_NAME}.autotune.fit"]
+runner = sys.modules[f"{_ISO_NAME}.autotune.runner"]
+algos = sys.modules[f"{_ISO_NAME}.ops._algos"]
+cm = sys.modules[f"{_ISO_NAME}.analysis.costmodel"]
+graph = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+checkers = sys.modules[f"{_ISO_NAME}.analysis.checkers"]
+el = ISO.resilience.elastic
+
+E = graph.CollectiveEvent
+G = graph.CollectiveGraph
+
+
+@pytest.fixture(autouse=True)
+def _clean_layer(monkeypatch):
+    """Every test starts and ends with no tuning layer and none of the
+    tuned flags set (env is process-global; the iso config's override
+    cell is module state)."""
+    for flag in list(schema.KNOB_FLAGS.values()) + [
+            "MPI4JAX_TPU_TUNING", "MPI4JAX_TPU_TOPOLOGY",
+            "MPI4JAX_TPU_COST_MODEL"]:
+        monkeypatch.delenv(flag, raising=False)
+    config.load_tuning(None)
+    yield
+    config.load_tuning(None)
+
+
+def _payload(**over):
+    base = {
+        "schema": schema.SCHEMA,
+        "links": {"ici": {"alpha_us": 0.5, "gb_per_s": 50.0},
+                  "dcn": {"alpha_us": 20.0, "gb_per_s": 10.0}},
+        "tuned": {
+            "ring_crossover_bytes": 4096,
+            "dcn_crossover_bytes": 1 << 16,
+            "fusion_bucket_bytes": 2 << 20,
+            "overlap_chunks": [
+                {"max_bytes": 1 << 20, "chunks": 1},
+                {"max_bytes": None, "chunks": 4},
+            ],
+            "commit": {"pack_gb_per_s": 3.5, "target_overhead": 0.05},
+        },
+        "measured": {"ring_crossover_bytes": 4096,
+                     "fusion_bucket_bytes": 2 << 20},
+        "topologies": {"2x4": {"ring_crossover_bytes": 9999}},
+        "provenance": {"jax": "0.0-test", "topology": "1x8"},
+    }
+    base.update(over)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# schema: accept / reject / stamp
+# ---------------------------------------------------------------------------
+
+
+def test_minimal_and_full_payloads_validate():
+    tf = schema.TuningFile({"schema": schema.SCHEMA})
+    assert len(tf.stamp) == 12 and int(tf.stamp, 16) >= 0
+    full = schema.TuningFile(_payload())
+    assert full.knobs()["ring_crossover_bytes"] == 4096
+    assert full.has_links()
+    assert not schema.TuningFile({"schema": schema.SCHEMA}).has_links()
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ([], "JSON object"),
+    ({"schema": "mpx-tuning/999"}, "schema"),
+    ({"schema": schema.COST_SCHEMA}, "schema"),  # subset is NOT a layer
+    ({"schema": schema.SCHEMA, "tuned": {"bogus_knob": 1}}, "unknown"),
+    ({"schema": schema.SCHEMA, "tuned": {"ring_crossover_bytes": "x"}},
+     "positive integer"),
+    ({"schema": schema.SCHEMA, "tuned": {"ring_crossover_bytes": True}},
+     "positive integer"),
+    ({"schema": schema.SCHEMA, "tuned": {"ring_crossover_bytes": 0}},
+     "positive integer"),
+    ({"schema": schema.SCHEMA, "tuned": {"ring_crossover_bytes": 1.5}},
+     "positive integer"),
+    ({"schema": schema.SCHEMA, "tuned": {"commit": {"bogus": 1}}},
+     "unknown"),
+    ({"schema": schema.SCHEMA, "tuned": {"commit":
+                                         {"pack_gb_per_s": 0}}},
+     "positive"),
+    ({"schema": schema.SCHEMA, "tuned": {"overlap_chunks": []}},
+     "bucket"),
+    ({"schema": schema.SCHEMA,
+      "tuned": {"overlap_chunks": [{"max_bytes": 1}]}}, "exactly"),
+    ({"schema": schema.SCHEMA,
+      "tuned": {"overlap_chunks": [{"max_bytes": None, "chunks": 2},
+                                   {"max_bytes": 4, "chunks": 1}]}},
+     "open-ended"),
+    ({"schema": schema.SCHEMA,
+      "tuned": {"overlap_chunks": [{"max_bytes": 8, "chunks": 2},
+                                   {"max_bytes": 4, "chunks": 1}]}},
+     "ascending"),
+    ({"schema": schema.SCHEMA,
+      "topologies": {"2x4": {"commit": {"target_overhead": 0.02}}}},
+     "only valid in"),
+    ({"schema": schema.SCHEMA, "topologies": []}, "object"),
+    ({"schema": schema.SCHEMA, "topologies": {"": {}}}, "non-empty"),
+    ({"schema": schema.SCHEMA, "provenance": 3}, "object"),
+    ({"schema": schema.SCHEMA,
+      "links": {"ici": {"gb_per_s": -1}}}, "gb_per_s"),
+])
+def test_reject_matrix(bad, needle):
+    with pytest.raises(ValueError) as ei:
+        schema.validate_tuning_dict(bad)
+    assert needle in str(ei.value)
+
+
+def test_stamp_is_content_addressed():
+    a = schema.stamp_of(_payload())
+    assert a == schema.stamp_of(_payload())  # deterministic
+    assert a != schema.stamp_of(_payload(source="other"))
+    # key order does not matter (canonical JSON)
+    p = _payload()
+    rev = dict(reversed(list(p.items())))
+    assert schema.stamp_of(rev) == a
+
+
+def test_knob_lookup_topology_and_buckets():
+    tf = schema.TuningFile(_payload())
+    assert tf.knob("ring_crossover_bytes") == 4096
+    assert tf.knob("ring_crossover_bytes", topology="2x4") == 9999
+    assert tf.knob("ring_crossover_bytes", topology="4x2") == 4096
+    # bucketed overlap chunks: boundary inclusive, open tail, no-payload
+    assert tf.knob("overlap_chunks", payload_bytes=1) == 1
+    assert tf.knob("overlap_chunks", payload_bytes=1 << 20) == 1
+    assert tf.knob("overlap_chunks", payload_bytes=(1 << 20) + 1) == 4
+    assert tf.knob("overlap_chunks") == 4
+    # untuned knob on a sparse file
+    sparse = schema.TuningFile({"schema": schema.SCHEMA,
+                                "tuned": {"fusion_bucket_bytes": 1024}})
+    assert sparse.knob("ring_crossover_bytes") is None
+    with pytest.raises(KeyError):
+        tf.knob("bogus")
+
+
+def test_commit_params():
+    tf = schema.TuningFile(_payload())
+    assert tf.commit_param("pack_gb_per_s") == 3.5
+    assert tf.commit_param("target_overhead") == 0.05
+    assert schema.TuningFile(
+        {"schema": schema.SCHEMA}).commit_param("pack_gb_per_s") is None
+    with pytest.raises(KeyError):
+        tf.commit_param("bogus")
+
+
+def test_file_loading_and_memo(tmp_path):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_payload()))
+    tf = schema.load_tuning_file_memo(str(path))
+    assert tf.path == str(path)
+    # content pinned at first read: same object back, even after an
+    # in-place edit (cached programs cannot see the edit, so silently
+    # re-reading would mix old and new lowerings in one process)
+    assert schema.load_tuning_file_memo(str(path)) is tf
+    path.write_text(json.dumps(_payload(source="v2")))
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    assert schema.load_tuning_file_memo(str(path)) is tf
+    # the explicit refresh (the mpx.load_tuning(path) route) re-reads
+    tf2 = schema.refresh_tuning_file(str(path))
+    assert tf2.stamp != tf.stamp
+    assert schema.load_tuning_file_memo(str(path)) is tf2
+    with pytest.raises(ValueError, match="could not be read"):
+        schema.load_tuning_file(str(tmp_path / "missing.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        schema.load_tuning_file(str(bad))
+
+
+def test_as_tuning_coercions():
+    tf = schema.TuningFile(_payload())
+    assert schema.as_tuning(tf) is tf
+    assert schema.as_tuning(_payload()).stamp == tf.stamp
+    with pytest.raises(TypeError):
+        schema.as_tuning(42)
+
+
+def test_knob_flags_match_the_registry():
+    # every knob's shadowed flag must exist in the config registry (the
+    # env-wins precedence reads it) — schema/registry drift fails here
+    for flag in schema.KNOB_FLAGS.values():
+        assert flag in config.FLAGS, flag
+
+
+# ---------------------------------------------------------------------------
+# the config layer: default < tuning < env
+# ---------------------------------------------------------------------------
+
+
+def test_layer_precedence_ring_crossover(monkeypatch):
+    assert config.ring_crossover_bytes() == config.DEFAULT_RING_CROSSOVER_BYTES
+    config.load_tuning(_payload())
+    assert config.ring_crossover_bytes() == 4096
+    # an explicitly set env flag wins over the file
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "777")
+    assert config.ring_crossover_bytes() == 777
+    # an EMPTY env value counts as unset: tuning applies
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "")
+    assert config.ring_crossover_bytes() == 4096
+    config.load_tuning(None)
+    assert config.ring_crossover_bytes() == config.DEFAULT_RING_CROSSOVER_BYTES
+
+
+def test_layer_serves_every_knob(monkeypatch):
+    config.load_tuning(_payload())
+    assert config.dcn_crossover_bytes() == 1 << 16
+    assert config.fusion_bucket_bytes() == 2 << 20
+    assert config.overlap_chunks() == 4
+    assert config.overlap_chunks(100) == 1
+    assert config.overlap_chunks(2 << 20) == 4
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", "8")
+    assert config.overlap_chunks(100) == 8  # env wins over buckets too
+
+
+def test_layer_topology_scope(monkeypatch):
+    config.load_tuning(_payload())
+    assert config.ring_crossover_bytes() == 4096
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", "2x4")
+    assert config.ring_crossover_bytes() == 9999
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", "4x2")
+    assert config.ring_crossover_bytes() == 4096
+
+
+def test_cache_token_folds_the_stamp():
+    tok0 = algos.algo_cache_token()
+    assert len(tok0) == 4  # no layer: exactly the pre-tuning token
+    tf = config.load_tuning(_payload())
+    tok1 = algos.algo_cache_token()
+    assert tok1[-1] == ("tuning", tf.stamp)
+    assert tok1[:2] != tok0[:2] or tok1 != tok0  # tuned crossover moved
+    # CHANGING the file content moves the token even when the knob
+    # values stay identical (the stamp is content-addressed)
+    tf2 = config.load_tuning(_payload(source="recalibrated"))
+    tok2 = algos.algo_cache_token()
+    assert tok2 != tok1 and tok2[-1] == ("tuning", tf2.stamp)
+    config.load_tuning(None)
+    assert algos.algo_cache_token() == tok0
+
+
+def test_env_route_and_programmatic_override(tmp_path, monkeypatch):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_payload()))
+    monkeypatch.setenv("MPI4JAX_TPU_TUNING", str(path))
+    assert config.active_tuning().path == str(path)
+    assert config.ring_crossover_bytes() == 4096
+    # load_tuning() wins over the env file
+    over = config.load_tuning(_payload(
+        tuned={"ring_crossover_bytes": 1234}))
+    assert config.active_tuning() is over
+    assert config.ring_crossover_bytes() == 1234
+    config.load_tuning(None)  # back to the env file
+    assert config.ring_crossover_bytes() == 4096
+    # a malformed env file raises loudly, never silently untuned
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    monkeypatch.setenv("MPI4JAX_TPU_TUNING", str(bad))
+    with pytest.raises(ValueError, match="schema"):
+        config.active_tuning()
+
+
+def test_env_flag_wins_without_touching_a_malformed_file(tmp_path,
+                                                         monkeypatch):
+    # an explicitly set knob flag must win WITHOUT consulting the layer
+    # at all: a malformed MPI4JAX_TPU_TUNING file cannot mask a
+    # deliberate override (it still raises loudly for untuned reads)
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    monkeypatch.setenv("MPI4JAX_TPU_TUNING", str(bad))
+    monkeypatch.setenv("MPI4JAX_TPU_FUSION_BUCKET_BYTES", "8388608")
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", "3")
+    monkeypatch.setenv("MPI4JAX_TPU_DCN_CROSSOVER_BYTES", "4096")
+    monkeypatch.setenv("MPI4JAX_TPU_RING_CROSSOVER_BYTES", "2048")
+    assert config.fusion_bucket_bytes() == 8388608
+    assert config.overlap_chunks() == 3
+    assert config.dcn_crossover_bytes() == 4096
+    assert config.ring_crossover_bytes() == 2048
+    monkeypatch.delenv("MPI4JAX_TPU_FUSION_BUCKET_BYTES")
+    with pytest.raises(ValueError, match="schema"):
+        config.fusion_bucket_bytes()  # unset flag: the bad file is loud
+
+
+def test_config_epoch_bumps_on_load():
+    e0 = config.config_epoch()
+    config.load_tuning(_payload())
+    assert config.config_epoch() > e0
+    e1 = config.config_epoch()
+    config.load_tuning(None)
+    assert config.config_epoch() > e1
+
+
+def test_tuning_snapshot_shape(monkeypatch):
+    assert config.tuning_snapshot() is None
+    tf = config.load_tuning(_payload())
+    monkeypatch.setenv("MPI4JAX_TPU_FUSION_BUCKET_BYTES", "512")
+    snap = config.tuning_snapshot()
+    assert snap["stamp"] == tf.stamp and snap["path"] is None
+    k = snap["knobs"]
+    assert k["ring_crossover_bytes"]["tuned"] == 4096
+    assert k["ring_crossover_bytes"]["effective"] == 4096
+    assert not k["ring_crossover_bytes"]["env_wins"]
+    assert k["fusion_bucket_bytes"]["env_wins"]
+    assert k["fusion_bucket_bytes"]["effective"] == 512
+    assert snap["commit"]["pack_gb_per_s"] == 3.5
+
+
+# ---------------------------------------------------------------------------
+# fitters
+# ---------------------------------------------------------------------------
+
+
+def test_measured_crossover_interpolates_and_edges():
+    rows = [{"mb": 0.1, "a": 10.0, "b": 20.0},
+            {"mb": 1.0, "a": 40.0, "b": 30.0}]
+    x = fit.measured_crossover(rows, "mb", "a", "b")
+    assert 0.5e6 < x < 0.6e6  # delta -10 -> +10: midpoint
+    # B wins immediately: first row's payload
+    assert fit.measured_crossover(
+        [{"mb": 0.5, "a": 5.0, "b": 1.0}], "mb", "a", "b") == 500000
+    # B never wins / missing timing / empty sweep -> None
+    assert fit.measured_crossover(
+        [{"mb": 1.0, "a": 1.0, "b": 2.0}], "mb", "a", "b") is None
+    assert fit.measured_crossover(
+        [{"mb": 1.0, "a": 1.0}], "mb", "a", "b") is None
+    assert fit.measured_crossover([], "mb", "a", "b") is None
+
+
+def test_analytic_crossover_closed_form():
+    x8 = fit.analytic_crossover(1.0, 100.0, 8)
+    assert x8 is not None and x8 > 0
+    # more per-round latency pushes the crossover up proportionally
+    assert fit.analytic_crossover(2.0, 100.0, 8) == pytest.approx(
+        2 * x8, rel=0.01)
+    # below the ring's minimum group the ring never wins
+    assert fit.analytic_crossover(1.0, 100.0, 3) is None
+    assert fit.analytic_crossover(-1.0, 100.0, 8) is None
+    assert fit.analytic_crossover(1.0, 0.0, 8) is None
+    # exact check at k=4: lat_gap=2(3)-2(2)=2, byte_gap=4-1.5=2.5
+    assert fit.analytic_crossover(1.0, 1.0, 4) == \
+        int(-(-2 * 1.0 * 1e3 // 2.5))
+
+
+def test_pick_min_and_chunk_buckets():
+    rows = [{"c": 1, "t": 5.0}, {"c": 2, "t": 3.0}, {"c": 4, "t": 3.0}]
+    assert fit.pick_min(rows, "c", "t") == (2, 3.0)  # tie -> earlier
+    assert fit.pick_min([], "c", "t") is None
+    assert fit.pick_min([{"c": 1}], "c", "t") is None
+    assert fit.chunk_buckets([(1 << 20, 2), (4 << 20, 2)]) == 2
+    assert fit.chunk_buckets([(1 << 20, 1), (4 << 20, 4)]) == [
+        {"max_bytes": 1 << 20, "chunks": 1},
+        {"max_bytes": None, "chunks": 4},
+    ]
+    # adjacent same-winner buckets merge before the open tail
+    assert fit.chunk_buckets([(1, 1), (2, 1), (3, 4)]) == [
+        {"max_bytes": 2, "chunks": 1}, {"max_bytes": None, "chunks": 4}]
+    assert fit.chunk_buckets([]) is None
+    # the bucketed emit loads back through the schema
+    schema.validate_tuning_dict({
+        "schema": schema.SCHEMA,
+        "tuned": {"overlap_chunks":
+                  fit.chunk_buckets([(1 << 20, 1), (4 << 20, 4)])},
+    })
+
+
+def test_auto_commit_interval_math():
+    # 5% target: a 1 s commit over 0.1 s steps -> every 200 steps
+    assert fit.auto_commit_interval(0.1, 1.0) == 200
+    assert fit.auto_commit_interval(0.1, 1.0, target_overhead=0.5) == 20
+    assert fit.auto_commit_interval(1.0, 0.0) == 1        # free commits
+    assert fit.auto_commit_interval(0.0, 1.0) == 1        # unmeasurable
+    assert fit.auto_commit_interval(1e-9, 3600.0) == \
+        fit.MAX_COMMIT_INTERVAL                            # clamped
+
+
+# ---------------------------------------------------------------------------
+# selector + cost-model integration
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_algo_flips_at_seeded_crossover():
+    config.load_tuning(_payload())  # ring crossover tuned to 4096
+    assert algos.resolve_algo("auto", 4096, 8, ring_ok=True) == "ring"
+    assert algos.resolve_algo("auto", 4095, 8, ring_ok=True) == "butterfly"
+    # the hier pick follows the same tuned threshold on multi-host comms
+    assert algos.resolve_algo("auto", 4096, 8, ring_ok=True,
+                              hier_ok=True) == "hier"
+    config.load_tuning(None)
+    assert algos.resolve_algo("auto", 4096, 8, ring_ok=True) == "butterfly"
+
+
+def test_resolve_dcn_algo_follows_tuned_crossover():
+    config.load_tuning(_payload())  # dcn crossover tuned to 64 KiB
+    assert algos.resolve_dcn_algo(1 << 16, 8) == "ring"
+    assert algos.resolve_dcn_algo((1 << 16) - 1, 8) == "butterfly"
+    config.load_tuning(None)
+    assert algos.resolve_dcn_algo(1 << 16, 8) == "butterfly"
+
+
+def test_costmodel_accepts_both_schemas():
+    cm.validate_model_dict({"schema": cm.SCHEMA,
+                            "links": {"ici": {"alpha_us": 1.0}}})
+    cm.validate_model_dict(_payload())  # the superset loads whole
+    with pytest.raises(ValueError, match="schema"):
+        cm.validate_model_dict({"schema": "mpx-cost-model/999"})
+
+
+def test_costmodel_reads_the_tuning_layer(tmp_path, monkeypatch):
+    tf = config.load_tuning(_payload())
+    model = cm.load_model(None)
+    assert model.tuned_stamp == tf.stamp
+    assert model.params["links"]["ici"]["alpha_us"] == 0.5
+    assert model.measured["ring_crossover_bytes"] == 4096
+    meta = cm.measured_meta()
+    assert meta["tuned_stamp"] == tf.stamp
+    assert meta["measured_ring_crossover_bytes"] == 4096
+    # an explicit MPI4JAX_TPU_COST_MODEL file still wins over the layer
+    other = tmp_path / "cm.json"
+    other.write_text(json.dumps({
+        "schema": cm.SCHEMA,
+        "links": {"ici": {"alpha_us": 9.0, "gb_per_s": 9.0}}}))
+    monkeypatch.setenv("MPI4JAX_TPU_COST_MODEL", str(other))
+    model2 = cm.load_model(None)
+    assert model2.tuned_stamp is None
+    assert model2.params["links"]["ici"]["alpha_us"] == 9.0
+
+
+def test_cost_advisory_provenance_suffix():
+    cost_mod = sys.modules[f"{_ISO_NAME}.analysis.cost"]
+    tuned = cm.CostModel(tuned_stamp="feedbeef0123")
+    assert cost_mod._model_provenance(tuned) == " [model tuned@feedbeef0123]"
+    assert cost_mod._model_provenance(cm.CostModel()) == ""
+
+
+def test_costmodel_defaults_without_any_layer():
+    model = cm.load_model(None)
+    assert model.tuned_stamp is None and model.source is None
+    assert model.params["links"]["ici"] == \
+        cm.DEFAULT_PARAMS["links"]["ici"]
+
+
+# ---------------------------------------------------------------------------
+# advisory provenance: tuned@<stamp> in MPX109/111/113 texts
+# ---------------------------------------------------------------------------
+
+_TUNED_META = {
+    "collective_algo": "auto",
+    "ring_crossover_bytes": 4096,
+    "fusion_bucket_bytes": 2 << 20,
+    "fusion": "off",
+    "measured_ring_crossover_bytes": 4096,
+    "measured_fusion_bucket_bytes": 2 << 20,
+    "cost_model": "<tuning layer>",
+    "tuned_stamp": "abc123def456",
+}
+
+
+def _findings(events, meta):
+    return checkers.run_checkers(G(events=events, meta=dict(meta)))
+
+
+def test_mpx113_cites_tuned_stamp():
+    evs = [E(0, "allreduce", comm_uid=1, comm_size=8,
+             payload_bytes=8192, algo="ring", hosts=2)]
+    meta = dict(_TUNED_META, collective_algo="ring")
+    (f,) = [x for x in _findings(evs, meta) if x.code == "MPX113"]
+    assert "tuned@abc123def456" in f.message
+    assert "measured crossover" in f.message
+    # without the stamp the cite falls back to the cost-model path
+    meta2 = dict(meta)
+    meta2.pop("tuned_stamp")
+    meta2["cost_model"] = "/tmp/cm.json"
+    (f2,) = [x for x in _findings(evs, meta2) if x.code == "MPX113"]
+    assert "cost model /tmp/cm.json" in f2.message
+    assert "tuned@" not in f2.message
+
+
+def test_mpx111_cites_tuned_stamp():
+    evs = [E(i, "allreduce", comm_uid=1, reduction="sum",
+             payload_bytes=64) for i in range(2)]
+    (f,) = [x for x in _findings(evs, _TUNED_META) if x.code == "MPX111"]
+    assert "tuned@abc123def456" in f.message
+    assert f"measured {2 << 20} B bucket" in f.message
+
+
+def test_mpx109_cites_tuned_stamp():
+    evs = [E(0, "allreduce", comm_uid=1, comm_size=8,
+             payload_bytes=4096, algo="ring")]
+    (f,) = [x for x in _findings(evs, _TUNED_META) if x.code == "MPX109"]
+    assert "tuned@abc123def456" in f.message
+    # untouched text without a layer (the pre-autotune wording)
+    meta0 = {"collective_algo": "auto", "ring_crossover_bytes": 4096}
+    (f0,) = [x for x in _findings(evs, meta0) if x.code == "MPX109"]
+    assert "tuned@" not in f0.message and "ring crossover" in f0.message
+    # a layer that does NOT actually supply the effective crossover —
+    # other knobs tuned, or an env override shadowing the file — must
+    # not claim "measured" provenance for it
+    meta1 = dict(_TUNED_META, ring_crossover_bytes=1 << 20)
+    evs1 = [E(0, "allreduce", comm_uid=1, comm_size=8,
+              payload_bytes=1 << 20, algo="ring")]
+    (f1,) = [x for x in _findings(evs1, meta1) if x.code == "MPX109"]
+    assert "tuned@" not in f1.message
+    meta2 = dict(meta0, tuned_stamp="abc123def456")  # no measured_* key
+    (f2,) = [x for x in _findings(evs, meta2) if x.code == "MPX109"]
+    assert "tuned@" not in f2.message
+
+
+# ---------------------------------------------------------------------------
+# commit_every='auto'
+# ---------------------------------------------------------------------------
+
+
+class _FakeComm:
+    _uids = iter(range(50_000, 60_000))
+
+    def __init__(self, size):
+        self._size = size
+        self.uid = next(self._uids)
+
+    def world_size(self):
+        return self._size
+
+
+class _FakeStore:
+    def __init__(self, world=4):
+        self.redundancy = 1
+        self.bootstrap = {}
+        self.comm = _FakeComm(world)
+        self.commits = []
+        self._committed = None
+        self.drained = False
+
+    @property
+    def committed_step(self):
+        return self._committed and self._committed[0]
+
+    def commit(self, step, state):
+        self._committed = (step, state)
+        self.commits.append(step)
+
+    def multiprocess(self):
+        return False
+
+    def restore(self, failed=(), force_exchange=False):
+        return self._committed
+
+
+def test_resolve_auto_commit_interval_reads_tuned_target():
+    assert el.resolve_auto_commit_interval(0.1, 1.0) == 200  # 5% default
+    config.load_tuning(_payload(tuned={"commit":
+                                       {"target_overhead": 0.5}}))
+    assert el.resolve_auto_commit_interval(0.1, 1.0) == 20
+    config.load_tuning(None)
+    assert el.resolve_auto_commit_interval(0.1, 1.0) == 200
+
+
+def test_run_auto_commit_locks_an_interval():
+    store = _FakeStore()
+
+    def step_fn(state, step, comm):
+        time.sleep(0.002)  # step time >> (scripted) commit cost
+        return state + 1
+
+    out = el.run(step_fn, 0, store, steps=4, commit_every="auto")
+    assert out == 4
+    # initial commit, then: commits every boundary until both
+    # measurements exist, then the locked interval (commit cost on the
+    # scripted store is microseconds against a 2 ms step -> interval 1)
+    assert store.commits[0] == 0 and store.commits[-1] == 4
+    assert store.commits == [0, 1, 2, 3, 4]
+
+
+def test_run_rejects_unknown_commit_strings():
+    with pytest.raises(ValueError, match="auto"):
+        el.run(lambda s, i, c: s, 0, _FakeStore(), steps=1,
+               commit_every="never")
+
+
+# ---------------------------------------------------------------------------
+# the whole measurement->fit->emit pipeline on a scripted microbench
+# ---------------------------------------------------------------------------
+
+
+class _SweepComm:
+    mesh = None
+    axes = ("x",)
+
+    def Get_size(self):
+        return 8
+
+    def world_size(self):
+        return 8
+
+
+def _scripted_micro():
+    """A fake ``benchmarks/micro.py`` with deterministic sweep rows —
+    drives the ENTIRE autotune pipeline (budget loop, fitters, schema
+    emission, layer load) without jax or a mesh."""
+    mod = types.ModuleType("micro")
+
+    def bench_sendrecv_ring(comm, sizes_kb, iters):
+        # a perfect alpha-beta line: 2 us + bytes at 1 GB/s
+        return [{"size_kb": kb, "hop_us": 2.0 + kb * 1e3 / 1e3,
+                 "link_gb_s": 1.0} for kb in sizes_kb]
+
+    def bench_allreduce_algos(comm, sizes_mb, iters):
+        # ring wins at >= 0.5 MB
+        return [{"size_mb": mb,
+                 "butterfly_us": 10.0 * mb * 2,
+                 "ring_us": 10.0 * mb + 5.0,
+                 "ring_speedup": (10.0 * mb * 2) / (10.0 * mb + 5.0)}
+                for mb in sizes_mb]
+
+    def bench_hierarchy(comm, sizes_mb, topologies, iters):
+        return [{"size_mb": mb, "topology": t,
+                 "flat_us": 10.0 * mb, "hier_us": 4.0 + 2.0 * mb,
+                 "hier_speedup": None}
+                for t in topologies for mb in sizes_mb]
+
+    def bench_fusion(comm, counts, size_kb, iters):
+        # 1 MiB bucket is the scripted sweet spot
+        cap = int(os.environ["MPI4JAX_TPU_FUSION_BUCKET_BYTES"])
+        best = 1 << 20
+        cost = 1.0 + abs(cap - best) / best
+        return [{"count": counts[0], "size_kb": size_kb,
+                 "unfused_us_per_op": 10.0, "fused_us_per_op": cost,
+                 "fused_speedup": 10.0 / cost}]
+
+    def bench_overlap(comm, sizes_mb, iters, compute_dim):
+        # small payloads want 1 chunk, large want 4
+        chunks = int(os.environ["MPI4JAX_TPU_OVERLAP_CHUNKS"])
+        mb = sizes_mb[0]
+        want = 1 if mb < 1 else 4
+        return [{"size_mb": mb, "chunks": chunks,
+                 "monolithic_us": 10.0,
+                 "overlap_us": 5.0 + abs(chunks - want),
+                 "overlap_speedup": 1.0}]
+
+    def fit_alpha_beta(points):
+        return 2.0, 1.0
+
+    def measured_ring_crossover(rows):
+        prev = None
+        for r in rows:
+            delta = r["butterfly_us"] - r["ring_us"]
+            if delta >= 0:
+                return int((prev if prev is not None else r["size_mb"])
+                           * 1e6)
+            prev = r["size_mb"]
+        return None
+
+    for fn in (bench_sendrecv_ring, bench_allreduce_algos,
+               bench_hierarchy, bench_fusion, bench_overlap,
+               fit_alpha_beta, measured_ring_crossover):
+        setattr(mod, fn.__name__, fn)
+    return mod
+
+
+def test_autotune_pipeline_on_scripted_sweeps(tmp_path, monkeypatch):
+    monkeypatch.setitem(sys.modules, "micro", _scripted_micro())
+    path = tmp_path / "tuning.json"
+    result = runner.autotune(comm=_SweepComm(), budget_s=30.0,
+                             save=str(path), load=True,
+                             topologies=("2x4",))
+    payload = json.loads(path.read_text())
+    schema.validate_tuning_dict(payload)
+    assert payload["schema"] == schema.SCHEMA
+    # the scripted ici fit came through verbatim
+    assert payload["links"]["ici"] == {"alpha_us": 2.0, "gb_per_s": 1.0}
+    assert payload["links"]["dcn"]["gb_per_s"] > 0
+    # ring crossover from the scripted sweep (ring wins at 0.5 MB)
+    assert 0 < payload["tuned"]["ring_crossover_bytes"] <= int(5e5)
+    # dcn crossover from the closed form over the scaled dcn class
+    assert payload["tuned"]["dcn_crossover_bytes"] > 0
+    # fusion bucket: the scripted sweet spot
+    assert payload["tuned"]["fusion_bucket_bytes"] == 1 << 20
+    # overlap chunks bucketed: small payload 1, large 4
+    chunks = payload["tuned"]["overlap_chunks"]
+    assert chunks == [{"max_bytes": 250000, "chunks": 1},
+                      {"max_bytes": None, "chunks": 4}]
+    # pack throughput measured on the synthetic state
+    assert payload["tuned"]["commit"]["pack_gb_per_s"] > 0
+    # per-topology override from the scripted hier sweep
+    assert payload["topologies"]["2x4"]["ring_crossover_bytes"] > 0
+    # provenance self-description
+    prov = payload["provenance"]
+    assert prov["n_devices"] == 8 and prov["budget_s"] == 30.0
+    assert len(prov["config_stamp"]) == 12
+    # load=True installed the layer in the iso config
+    assert config.active_tuning() is not None
+    assert config.active_tuning().stamp == result.stamp
+    assert config.ring_crossover_bytes() == \
+        payload["tuned"]["ring_crossover_bytes"]
+    assert result.unfitted == ()
+    assert "links" in result.fitted and "commit" in result.fitted
+
+
+def test_autotune_rejects_bad_budget():
+    with pytest.raises(ValueError, match="budget_s"):
+        runner.autotune(comm=_SweepComm(), budget_s=0)
+
+
+# ---------------------------------------------------------------------------
+# runner scaffolding (the jax-free parts) + CLI usage errors
+# ---------------------------------------------------------------------------
+
+
+def test_env_patch_restores(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_FUSION_BUCKET_BYTES", "123")
+    with runner._EnvPatch(MPI4JAX_TPU_FUSION_BUCKET_BYTES=456,
+                          MPI4JAX_TPU_OVERLAP_CHUNKS=7):
+        assert os.environ["MPI4JAX_TPU_FUSION_BUCKET_BYTES"] == "456"
+        assert os.environ["MPI4JAX_TPU_OVERLAP_CHUNKS"] == "7"
+    assert os.environ["MPI4JAX_TPU_FUSION_BUCKET_BYTES"] == "123"
+    assert "MPI4JAX_TPU_OVERLAP_CHUNKS" not in os.environ
+
+
+def test_budget_polling():
+    b = runner._Budget(1000.0)
+    assert b.ok() and b.elapsed() < 1000.0
+    b2 = runner._Budget(1e-9)
+    time.sleep(0.001)
+    assert not b2.ok()
+
+
+def test_cli_rejects_bad_budget():
+    main = importlib.import_module(f"{_ISO_NAME}.autotune.__main__").main
+    assert main(["--budget-s", "0"]) == 2
+    assert main(["--budget-s", "-5"]) == 2
+
+
+def test_cli_any_crash_is_exit_2(monkeypatch, tmp_path, capsys):
+    # a crashed run must NEVER exit 1 ("partial fit, file written"):
+    # any exception class maps to the failure code 2
+    main = importlib.import_module(f"{_ISO_NAME}.autotune.__main__").main
+
+    def boom(**kw):
+        raise KeyError("missing sweep key")
+
+    monkeypatch.setattr(runner, "autotune", boom)
+    rc = main(["--budget-s", "5", "--save", str(tmp_path / "t.json")])
+    assert rc == 2
+    assert "KeyError" in capsys.readouterr().err

@@ -188,18 +188,44 @@ def test_measured_ring_crossover_interpolates():
           "ring_speedup": None}]) is None
 
 
+def test_provenance_block_schema():
+    # every --save payload carries the self-description the autotune
+    # fitter needs: versions, topology, and the config stamp
+    prov = micro.provenance_block("cpu", 8)
+    assert set(prov) >= {"jax", "jaxlib", "platform", "n_devices",
+                         "topology", "config_stamp"}
+    assert prov["platform"] == "cpu" and prov["n_devices"] == 8
+    assert len(prov["config_stamp"]) == 12
+    int(prov["config_stamp"], 16)  # hex content stamp
+    assert "x" in prov["topology"]
+    assert micro.MICRO_SCHEMA == "mpx-micro-bench/1"
+
+
 def test_cost_calibrate_schema_loads_verbatim(tmp_path):
-    # the --cost-calibrate output IS the MPI4JAX_TPU_COST_MODEL tuning
-    # file: build it from real (tiny) sweep rows, save it, and load it
-    # through the cost-model loader — schema drift fails here, fast
+    # the --cost-calibrate output IS the tuning file: build it from
+    # real (tiny) sweep rows, save it, and load it through BOTH
+    # consumers — the cost-model loader (superset schema accepted) and
+    # the config tuning layer — schema drift fails here, fast
     from mpi4jax_tpu.analysis import costmodel
+    from mpi4jax_tpu.autotune import validate_tuning_dict
 
     comm = _world_comm()
     pp = micro.bench_sendrecv_ring(comm, sizes_kb=[0.004, 4], iters=2)
     al = micro.bench_allreduce_algos(comm, sizes_mb=[0.0001], iters=2)
     cm = micro.build_cost_model("cpu", comm.Get_size(), pp, al)
-    assert cm["schema"] == costmodel.SCHEMA
+    assert cm["schema"] == costmodel.TUNING_SCHEMA
     assert set(cm["links"]) == {"ici", "dcn"}
+    assert cm["provenance"]["n_devices"] == comm.Get_size()
+    validate_tuning_dict(cm)  # loads whole as an MPI4JAX_TPU_TUNING file
+    if "measured" in cm:
+        # the measured crossover doubles as the tuned knob value
+        assert cm["tuned"]["ring_crossover_bytes"] == \
+            cm["measured"]["ring_crossover_bytes"]
+    tf = mpx.load_tuning(cm)
+    try:
+        assert tf.has_links()
+    finally:
+        mpx.load_tuning(None)
     path = micro.save_cost_model(cm, outdir=str(tmp_path))
     assert os.path.basename(path).startswith("cost_model_cpu_")
     model = costmodel.model_from_file(path)
