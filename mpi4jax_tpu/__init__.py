@@ -100,6 +100,9 @@ from .aot import (  # noqa: F401
 )
 from . import telemetry  # noqa: F401
 from .telemetry import set_telemetry_mode  # noqa: F401
+# the serving runtime (docs/serving.md): continuous batching under a
+# p99 latency SLO on the pinned megastep decode path
+from . import serving  # noqa: F401
 # the tuning layer (docs/autotune.md): mpx.autotune() measures, the
 # config layer serves (default < tuning < env).  NOTE this rebinds the
 # package attribute `mpi4jax_tpu.autotune` to the FUNCTION — the
@@ -190,6 +193,8 @@ __all__ = [
     # runtime telemetry (docs/observability.md)
     "telemetry",
     "set_telemetry_mode",
+    # serving runtime (docs/serving.md)
+    "serving",
     # resilience (docs/resilience.md)
     "set_watchdog_timeout",
     "set_fault_spec",

@@ -568,6 +568,54 @@ def check_megastep_span_straddle(graph: CollectiveGraph) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# serving bucket advisory (MPX136)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX136")
+def check_unbucketed_batch(graph: CollectiveGraph) -> List[Finding]:
+    """A traced collective whose leading (batch) dimension is not in the
+    DECLARED serving bucket set (``graph.meta["serving_buckets"]``,
+    recorded by ``hook.config_snapshot`` from
+    ``mpx.serving.declare_buckets``; the engine scopes a declaration
+    around its serving loop): under the serving runtime's
+    one-program-per-(bucket, phase) rule, such a shape forces an
+    unpinned retrace per request count.  Fires once per distinct
+    offending batch size.  Inert — and the snapshot key absent —
+    whenever no serving runtime has declared a table, so non-serving
+    programs are never flagged (their leading dimensions are not batch
+    sizes)."""
+    buckets = graph.meta.get("serving_buckets")
+    if not buckets:
+        return []
+    declared = set(buckets)
+    findings: List[Finding] = []
+    flagged: set = set()
+    for e in graph.events:
+        if e.eager or not e.shape:
+            continue
+        batch = e.shape[0]
+        if batch in declared or batch in flagged:
+            continue
+        flagged.add(batch)
+        findings.append(Finding(
+            code="MPX136", op=e.op, index=e.index,
+            message=(f"{e.op} payload has leading (batch) dimension "
+                     f"{batch}, which is not in the declared serving "
+                     f"bucket set {tuple(sorted(declared))}: each "
+                     "distinct request batch shape traces and pins a "
+                     "separate program — an unpinned retrace per "
+                     "request count"),
+            suggestion=("pad the live batch to its covering bucket "
+                        "before dispatch (BucketTable.bucket_for / "
+                        ".pad — the serving engine does this "
+                        "automatically), or declare the shape in "
+                        "MPI4JAX_TPU_SERVING_BUCKETS — docs/serving.md"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # topology advisory (MPX113)
 # ---------------------------------------------------------------------------
 

@@ -107,6 +107,19 @@ def config_snapshot() -> dict:
         megastep = tracing_megastep()
     except ImportError:
         megastep = False
+    # the declared serving bucket table (serving/buckets.py), the MPX136
+    # gate: key present only when a serving runtime declared one, so
+    # every non-serving snapshot stays byte-identical to a build without
+    # the serving package (guarded like the aot import above).
+    serving_buckets = None
+    try:
+        from ..serving.buckets import declared_buckets
+
+        table = declared_buckets()
+        if table is not None:
+            serving_buckets = tuple(table.buckets)
+    except ImportError:
+        pass
     snap = {
         "collective_algo": config.collective_algo(),
         "ring_crossover_bytes": config.ring_crossover_bytes(),
@@ -118,6 +131,8 @@ def config_snapshot() -> dict:
         "pinned": pinned,
         "megastep": megastep,
     }
+    if serving_buckets is not None:
+        snap["serving_buckets"] = serving_buckets
     # measured crossovers from the cost-model tuning file (empty when
     # MPI4JAX_TPU_COST_MODEL is unset, keeping the snapshot — and with
     # it the MPX111/MPX113 advisory texts — byte-identical to a build

@@ -278,6 +278,21 @@ CODES = {
             "examples/pipeline_parallel.py.",
         ),
         CodeInfo(
+            "MPX136", "batch dimension outside the serving bucket set",
+            ADVISORY,
+            "A serving bucket table is declared "
+            "(mpx.serving.declare_buckets — the serving engine scopes "
+            "one around its serving loop) but a traced collective's "
+            "leading (batch) "
+            "dimension is not one of the declared buckets: every "
+            "distinct request batch shape traces, compiles, and pins a "
+            "SEPARATE program, so serving pays an unpinned retrace per "
+            "request count instead of one program per (bucket, phase).  "
+            "Pad the live batch up to its covering bucket "
+            "(BucketTable.bucket_for / pad) before dispatch "
+            "(docs/serving.md).",
+        ),
+        CodeInfo(
             "MPX130", "async span straddles a megastep loop boundary", ERROR,
             "An async *_start/*_wait span crosses a megastep loop "
             "boundary (mpx.compile/mpx.spmd unroll=N, "

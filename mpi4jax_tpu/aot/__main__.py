@@ -1,13 +1,25 @@
-"""AOT CLI: ``python -m mpi4jax_tpu.aot warm manifest.json``.
+"""AOT CLI: ``python -m mpi4jax_tpu.aot warm [--emit-manifest] ...``.
 
 Pre-populates the persistent compiled-program cache
 (``MPI4JAX_TPU_COMPILE_CACHE_DIR``) from a program manifest — the fleet
 cold-start recipe of docs/aot.md run ahead of the fleet, so the first
 real boot of every rank deserializes instead of lowering.
 
-Exit codes: 0 = every program warmed; 1 = some program failed to
-import/pin (the rest were still attempted; failures are listed); 2 =
-the manifest is unreadable/malformed or the cache dir is unset.
+``--emit-manifest`` writes the manifest instead of consuming one: the
+serving runtime's bucket table (docs/serving.md) expands into one entry
+per (bucket, phase) program — prefill and decode megastep at every
+declared batch bucket — so a single ``emit`` + ``warm`` pair pre-compiles
+EVERYTHING a serving fleet will ever ask for and the first serving run
+reports ``disk_cache.misses == 0`` (asserted by the CI serving lane)::
+
+    python -m mpi4jax_tpu.aot warm --emit-manifest serving.json --world 8
+    MPI4JAX_TPU_COMPILE_CACHE_DIR=... \\
+      python -m mpi4jax_tpu.aot warm serving.json
+
+Exit codes: 0 = every program warmed (or the manifest was emitted); 1 =
+some program failed to import/pin (the rest were still attempted;
+failures are listed); 2 = the manifest is unreadable/malformed, the
+cache dir is unset, or the serving config cannot be emitted.
 """
 
 from __future__ import annotations
@@ -15,6 +27,40 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def _emit_manifest(args) -> int:
+    from ..serving.engine import ServingConfig, warm_manifest
+
+    overrides = {}
+    if args.max_batch:
+        overrides["max_batch"] = args.max_batch
+    if args.unroll:
+        overrides["unroll"] = args.unroll
+    try:
+        cfg = ServingConfig.from_env(**overrides)
+        world = args.world
+        if world is None:
+            import jax
+
+            world = jax.device_count()
+        manifest = warm_manifest(cfg, world)
+        with open(args.manifest, "w") as f:
+            json.dump(manifest, f, indent=2)
+            f.write("\n")
+    except (ValueError, RuntimeError, OSError) as e:
+        # any emit failure — bad config, unshardable world, unwritable
+        # output path — is the "unusable manifest" exit (2), never the
+        # partial-warm code (1)
+        print(f"warm --emit-manifest: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"manifest": args.manifest, "world": world,
+                          "programs": len(manifest["programs"])}))
+    else:
+        print(f"emitted {len(manifest['programs'])} serving program(s) "
+              f"(world {world}) to {args.manifest}")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -26,12 +72,33 @@ def main(argv=None) -> int:
     warm_p = sub.add_parser(
         "warm",
         help="pre-populate MPI4JAX_TPU_COMPILE_CACHE_DIR from a program "
-             "manifest (fn import path + abstract shapes per program)",
+             "manifest (fn import path + abstract shapes per program), "
+             "or --emit-manifest one from the serving bucket table",
     )
-    warm_p.add_argument("manifest", help="path to the manifest JSON")
+    warm_p.add_argument("manifest",
+                        help="path to the manifest JSON (the OUTPUT path "
+                             "under --emit-manifest)")
     warm_p.add_argument("--json", action="store_true",
                         help="machine-readable result payload on stdout")
+    warm_p.add_argument("--emit-manifest", action="store_true",
+                        help="write the serving-fleet manifest (one entry "
+                             "per (bucket, phase) program from the "
+                             "MPI4JAX_TPU_SERVING_* config — "
+                             "docs/serving.md) to MANIFEST and exit")
+    warm_p.add_argument("--world", type=int, default=None,
+                        help="--emit-manifest: tensor-parallel world size "
+                             "the fleet runs at (default: this host's "
+                             "device count)")
+    warm_p.add_argument("--max-batch", type=int, default=0,
+                        help="--emit-manifest: override "
+                             "MPI4JAX_TPU_SERVING_MAX_BATCH")
+    warm_p.add_argument("--unroll", type=int, default=0,
+                        help="--emit-manifest: override "
+                             "MPI4JAX_TPU_SERVING_UNROLL")
     args = parser.parse_args(argv)
+
+    if args.emit_manifest:
+        return _emit_manifest(args)
 
     from .warm import warm_from_manifest
 

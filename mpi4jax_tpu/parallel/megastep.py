@@ -53,9 +53,51 @@ from __future__ import annotations
 
 import itertools
 
-__all__ = ["megastep_loop", "tracing_megastep", "validate_unroll"]
+__all__ = ["megastep_loop", "register_boundary_hook",
+           "run_boundary_hooks", "tracing_megastep", "validate_unroll"]
 
 _loop_ids = itertools.count(1)
+
+# ---------------------------------------------------------------------------
+# megastep boundary hooks (host-side)
+# ---------------------------------------------------------------------------
+#
+# A megastep's BOUNDARY — the host-side gap between two device-resident
+# dispatches — is the only point where anything outside the program can
+# act: the serving runtime admits/evicts requests there
+# (mpi4jax_tpu/serving/engine.py), the elastic layer executes planned
+# drains there, tests observe cadence there.  The registry keeps those
+# consumers decoupled from the loops that own the boundary: a driver
+# calls ``run_boundary_hooks(step, **info)`` once per boundary and every
+# registered hook fires in registration order.  Pure host Python — never
+# traced, never in the program.
+
+_boundary_hooks: list = []   # (name, fn)
+
+
+def register_boundary_hook(name: str, fn):
+    """Register ``fn(step, **info)`` to run at every megastep boundary a
+    driver publishes.  Returns a zero-argument unregister callable.
+    Hook exceptions propagate to the driver — a boundary consumer that
+    fails must stop the loop, not be silently dropped."""
+    if not callable(fn):
+        raise TypeError(f"boundary hook {name!r} must be callable")
+    entry = (str(name), fn)
+    _boundary_hooks.append(entry)
+
+    def unregister():
+        try:
+            _boundary_hooks.remove(entry)
+        except ValueError:
+            pass
+
+    return unregister
+
+
+def run_boundary_hooks(step: int, **info) -> list:
+    """Fire every registered hook for boundary ``step``; returns
+    ``[(name, result), ...]`` in registration order."""
+    return [(name, fn(step, **info)) for name, fn in list(_boundary_hooks)]
 
 # nesting depth of megastep loop-body traces (the config-snapshot twin
 # of aot.pinning's _pinning_depth; the checker-facing discriminator is

@@ -1824,12 +1824,23 @@ def revoke_epoch(failed: Iterable[int], *, rank: int = 0,
     _wd.drain_registry()
     # drop the eager program cache (entries pin revoked meshes) — via
     # sys.modules so the isolated pure-test loader, which never loads the
-    # ops stack, does not pull it in here
+    # ops stack, does not pull it in here.  clear_caches may itself
+    # import siblings (aot, analysis) that a PARTIAL isolated loader —
+    # one that pulled the ops package in through a lazy byte-model
+    # import, say — has only stubbed; a revocation must still succeed
+    # there (nothing is cached under such loaders anyway).
     import sys
 
     ops = sys.modules.get(__package__.rsplit(".", 1)[0] + ".ops")
-    if ops is not None:
-        ops.clear_caches()
+    clear = getattr(ops, "clear_caches", None)
+    if callable(clear):
+        try:
+            clear()
+        except ImportError:
+            # a PARTIAL isolated loader (ops pulled in through a lazy
+            # byte-model import, sibling packages stubbed): nothing is
+            # cached there, so the revocation proceeds
+            pass
     _incident(
         "elastic.epoch_changes", "epoch_change", rank,
         f"epoch {new_epoch - 1} -> {new_epoch}: {detail}",
@@ -2322,6 +2333,73 @@ def _execute_grow(store: ShardStore, step: int, state, committed: bool,
         _restart_elastic_servers(servers, store)
         _meter("elastic.resumes")
     return "continue", new_step, new_state
+
+
+class BoundaryControl:
+    """Planned-reconfiguration polling for an EXTERNAL loop.
+
+    ``mpx.elastic.run`` owns a fixed step budget; loops that do not — the
+    serving runtime (mpi4jax_tpu/serving/engine.py), whose iteration
+    count depends on traffic — still need the same between-step boundary
+    semantics: a SIGTERM/preemption notice becomes a drain request, the
+    leaver announces its boundary with acks, every rank executes the
+    planned shrink at that boundary (one ``drain`` incident, no watchdog
+    expiry, no gossip), and pending joiners are admitted at committed
+    boundaries.  This wraps exactly the helper ``run`` drives
+    (:func:`_boundary_actions`) plus the listener lifecycle::
+
+        with BoundaryControl(store) as bc:
+            while serving:
+                ...one megastep...
+                outcome = bc.poll(step, state, committed=True)
+                if outcome is not None:
+                    kind, step, state = outcome
+                    if kind == "leave":
+                        break            # we drained out
+                    rebuild_programs()   # world changed, keep going
+
+    ``poll`` returns ``None`` (nothing happened), ``("continue", step,
+    state)`` (the world changed — the store's comm is rebuilt), or
+    ``("leave", step, state)`` (this rank was drained out;
+    ``store.drained`` is set)."""
+
+    def __init__(self, store: ShardStore, *, drain_on_sigterm: bool = True):
+        self.store = store
+        self.servers: dict = {}
+        self._prev_sigterm = None
+        self._drain_on_sigterm = drain_on_sigterm
+        self._entered = False
+
+    def __enter__(self) -> "BoundaryControl":
+        if self._drain_on_sigterm and self.store.multiprocess():
+            self._prev_sigterm = install_preemption_handler()
+        _restart_elastic_servers(self.servers, self.store)
+        self._entered = True
+        return self
+
+    def poll(self, step: int, state, *, committed: bool = True):
+        """Run the boundary actions for step ``step`` (drain execution,
+        join admission).  ``committed=False`` makes a drain force-commit
+        ``state`` before the shrink (pass True when the caller's
+        committed state is already current — e.g. static serving
+        parameters committed once up front)."""
+        return _boundary_actions(self.store, step, step + 1, state,
+                                 committed, 0, 1, self.servers)
+
+    def __exit__(self, *exc) -> bool:
+        if not self._entered:
+            return False
+        self._entered = False
+        _stop_elastic_servers(self.servers)
+        if self._prev_sigterm is not None:
+            import signal as _signal
+
+            try:
+                _signal.signal(_signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigterm = None
+        return False
 
 
 def join_and_run(step_fn, store: ShardStore, *, steps: int,

@@ -1,0 +1,58 @@
+"""Serving runtime: continuous batching under a p99 latency SLO.
+
+The serving workload (ROADMAP item 1, docs/serving.md): a
+tensor-parallel transformer decode loop behind an iteration-level
+(continuous) batching scheduler — requests admitted and evicted BETWEEN
+decode megasteps against a bucketed batch-shape table, a KV slot
+budget, and a p99 latency objective, with every ``(bucket, phase)``
+program pinned once through ``mpx.compile`` and decode driven as a
+device-resident megastep.  ``examples/serving/serve.py`` is the
+runnable deployment + benchmark + elastic drain drill; the serving
+number (tokens/s/chip at the p99 bound, continuous vs static) lands in
+``BENCH_serving.json``.
+
+Every module here imports jax LAZILY (inside the methods that trace or
+dispatch), so the isolated test loaders — and the
+``aot warm --emit-manifest`` path — load the whole package, config and
+manifest emission included, under any installed JAX.
+"""
+
+from .buckets import (  # noqa: F401
+    BucketTable,
+    bucket_payload_bytes,
+    clear_declared_buckets,
+    declare_buckets,
+    declared_buckets,
+    powers_of_two,
+)
+from .engine import ServingConfig, ServingEngine, warm_manifest  # noqa: F401
+from .kvcache import SlotAllocator  # noqa: F401
+from .metrics import BENCH_SCHEMA, bench_payload, summarize  # noqa: F401
+from .scheduler import (  # noqa: F401
+    ContinuousScheduler,
+    Request,
+    Sequence,
+    StaticScheduler,
+    poisson_trace,
+)
+
+__all__ = [
+    "BENCH_SCHEMA",
+    "BucketTable",
+    "ContinuousScheduler",
+    "Request",
+    "Sequence",
+    "ServingConfig",
+    "ServingEngine",
+    "SlotAllocator",
+    "StaticScheduler",
+    "bench_payload",
+    "bucket_payload_bytes",
+    "clear_declared_buckets",
+    "declare_buckets",
+    "declared_buckets",
+    "poisson_trace",
+    "powers_of_two",
+    "summarize",
+    "warm_manifest",
+]

@@ -1,0 +1,105 @@
+"""Sharded KV-cache management: slot pool + device-resident updates.
+
+The KV cache is the serving runtime's only long-lived device state: one
+tensor pair per rank, shaped ``[slots + 1, max_len, local_heads,
+head_dim]`` — the head axis SHARDED over the tensor-parallel group (each
+rank holds ``heads / k`` heads, the ``comm.Split``/Megatron layout), the
+slot axis a fixed pool of sequence rows.  Admission binds a sequence to
+a free slot; eviction frees the integer — the tensors never change
+shape, so the pinned per-bucket programs survive arbitrary admit/evict
+churn (slot ids enter the program as a tiny dynamic ``int32`` array and
+all writes are scatter updates at ``[slot, position]``).
+
+Row ``slots`` (the +1) is the SCRATCH row: padding lanes of a bucketed
+batch point their writes there, so padded compute can never corrupt a
+live sequence (several padding lanes may collide on it — its content is
+garbage by design).
+
+:class:`SlotAllocator` is the pure half (isolated-loader tested); the
+jax helpers below import lazily so this module loads under any JAX.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+__all__ = ["SlotAllocator", "kv_shape", "scatter_prefill", "scatter_step"]
+
+
+class SlotAllocator:
+    """A deterministic free-list over ``capacity`` KV slots (lowest id
+    first, so every rank of a lockstep host loop allocates identically)."""
+
+    __slots__ = ("capacity", "_free", "_used")
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._free: List[int] = list(range(capacity))
+        self._used: set = set()
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError(
+                f"KV slot pool exhausted ({self.capacity} slots in use); "
+                "admission must check free() first"
+            )
+        slot = self._free.pop(0)
+        self._used.add(slot)
+        return slot
+
+    def free_slot(self, slot: int) -> None:
+        if slot not in self._used:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._used.remove(slot)
+        # keep the free list sorted: allocation order stays deterministic
+        # regardless of eviction order
+        self._free.append(slot)
+        self._free.sort()
+
+    def free(self) -> int:
+        return len(self._free)
+
+    def used(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._used))
+
+    def reset(self) -> None:
+        self._free = list(range(self.capacity))
+        self._used.clear()
+
+    @property
+    def scratch(self) -> int:
+        """The scratch row's slot id (the ``+1`` row padding lanes write
+        to — outside the allocatable pool by construction)."""
+        return self.capacity
+
+
+def kv_shape(slots: int, max_len: int, local_heads: int,
+             head_dim: int) -> Tuple[int, int, int, int]:
+    """Per-rank KV tensor shape — ``slots + 1`` rows (pool + scratch)."""
+    return (slots + 1, max_len, local_heads, head_dim)
+
+
+# ---------------------------------------------------------------------------
+# device-resident updates (lazy jax: traced inside the serving programs)
+# ---------------------------------------------------------------------------
+
+
+def scatter_step(kv, slots, lens, new):
+    """Write one decode step's K (or V) rows at ``[slot, len]`` per lane:
+    ``kv [S+1, L, H, d]``, ``slots``/``lens`` ``int32 [B]``, ``new``
+    ``[B, H, d]``.  Pure scatter — the program shape is independent of
+    which slots are live."""
+    return kv.at[slots, lens].set(new)
+
+
+def scatter_prefill(kv, slots, new):
+    """Write a whole prompt's K (or V) rows: ``new [B, P, H, d]`` lands
+    at ``kv[slot, 0:P]`` per lane (positions beyond the live prompt
+    carry garbage that is masked by the length array and overwritten as
+    the sequence grows — docs/serving.md)."""
+    import jax.numpy as jnp
+
+    pos = jnp.arange(new.shape[1], dtype=jnp.int32)
+    return kv.at[slots[:, None], pos[None, :]].set(new)

@@ -1,0 +1,125 @@
+"""Cost-model-driven serving replay: the scheduler under a virtual clock.
+
+The replay runs the REAL scheduler (serving/scheduler.py — the same
+admission/eviction code the engine drives) against the real arrival
+trace, but replaces each device dispatch with its predicted latency from
+the static communication cost model (analysis/costmodel.py: alpha-beta
+per link class + roofline compute + host dispatch): per decode step, the
+two tensor-parallel allreduces of ``[bucket, dim]`` plus the attention/
+MLP math; per megastep, ONE host dispatch amortized over ``unroll``
+steps — so the continuous-vs-static comparison measures exactly the
+scheduling policy, on a clock that is deterministic and runs anywhere
+(no accelerator, no jax).
+
+This is the capture path of the committed ``BENCH_serving.json`` in
+containers without an accelerator (docs/serving.md "Capture protocol");
+the CI serving lane runs the REAL engine on the 8-device mesh and
+uploads its measured payload alongside.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .engine import ServingConfig
+from .kvcache import SlotAllocator
+from .metrics import bench_payload, summarize
+from .scheduler import ContinuousScheduler, Request, StaticScheduler
+
+__all__ = ["replay", "replay_bench", "step_costs_us"]
+
+
+def step_costs_us(cfg: ServingConfig, k: int, model=None) -> Dict[str, float]:
+    """Predicted per-dispatch costs (microseconds) at world size ``k``:
+    ``decode_step(bucket)`` (one token step: 2 allreduces + compute),
+    ``dispatch`` (host cost per megastep), ``prefill(bucket)``."""
+    from ..analysis import costmodel
+
+    m = model if model is not None else costmodel.load_model()
+    weights = (cfg.vocab * cfg.dim + 3 * cfg.dim * cfg.dim
+               + cfg.dim * cfg.dim + 2 * cfg.dim * cfg.ffn) * 4
+    out: Dict[str, float] = {"dispatch": m.dispatch_us}
+    for bucket in cfg.table().buckets:
+        nbytes = cfg.collective_payload_bytes(bucket)
+        if k > 1:
+            wire = 2 * m.time_us(costmodel.collective_cost(
+                "allreduce", None, nbytes, k))
+        else:
+            wire = 0.0
+        # roofline compute: the weight streaming dominates at tiny
+        # batches (every step reads all local weights), KV read scales
+        # with bucket * max_len
+        kv_read = bucket * cfg.max_len * cfg.heads // k * cfg.head_dim * 4 * 2
+        compute = m.compute_us(weights // k + kv_read)
+        out[f"decode.b{bucket}"] = wire + compute
+        # prefill: the same pattern over the padded prompt width at once
+        width = cfg.max_prompt
+        pre_wire = 2 * m.time_us(costmodel.collective_cost(
+            "allreduce", None, nbytes * width, k)) if k > 1 else 0.0
+        out[f"prefill.b{bucket}"] = (
+            pre_wire + m.compute_us(weights // k
+                                    + bucket * width * cfg.dim * 4)
+        )
+    return out
+
+
+def replay(cfg: ServingConfig, trace: List[Request], *, k: int,
+           scheduler: str = "continuous", model=None) -> Dict:
+    """One scheduler policy over ``trace`` on the virtual clock; returns
+    the serving metric block (metrics.summarize schema)."""
+    costs = step_costs_us(cfg, k, model=model)
+    table = cfg.table()
+    sched_cls = (ContinuousScheduler if scheduler == "continuous"
+                 else StaticScheduler)
+    sched = sched_cls(table, SlotAllocator(cfg.slots()))
+    for r in trace:
+        cfg.budget_check(r.prompt_len, r.max_new_tokens)
+
+    now = 0.0
+    boundaries = 0
+    guard = 200_000
+    while not sched.idle(trace) and boundaries < guard:
+        sched.offer(trace, now)
+        new = sched.admit(now)
+        if new:
+            bucket = table.bucket_for(len(new))
+            now += (costs[f"prefill.b{bucket}"] + costs["dispatch"]) * 1e-6
+            for s in new:
+                s.record([0], now)   # the prefill's first sampled token
+        if sched.running:
+            bucket = table.bucket_for(len(sched.running))
+            now += (cfg.unroll * costs[f"decode.b{bucket}"]
+                    + costs["dispatch"]) * 1e-6
+            for s in sched.running:
+                s.record([0] * cfg.unroll, now)
+        elif not sched.waiting:
+            nxt = sched.next_arrival_s(trace)
+            if nxt is None:
+                break
+            now = max(now, nxt)
+        sched.finish_ready(now)
+        boundaries += 1
+    finished = sched.finished
+    out = summarize(finished, wall_s=now, chips=k,
+                    slo_p99_ms=cfg.slo_p99_ms,
+                    failed=len(trace) - len(finished), scheduler=scheduler)
+    out["boundaries"] = boundaries
+    return out
+
+
+def replay_bench(cfg: ServingConfig, trace: List[Request], *, k: int,
+                 trace_meta: Dict, model=None,
+                 environment: Optional[str] = None) -> Tuple[Dict, Dict, Dict]:
+    """Both policies over one trace -> the BENCH_serving payload."""
+    cont = replay(cfg, trace, k=k, scheduler="continuous", model=model)
+    stat = replay(cfg, trace, k=k, scheduler="static", model=model)
+    payload = bench_payload(
+        workload=cfg.workload_meta(k), trace_meta=trace_meta, chips=k,
+        continuous=cont, static=stat,
+        environment=environment or (
+            "simulated: cost-model-driven replay of the shipped "
+            "scheduler (analysis/costmodel.py); capture protocol in "
+            "docs/serving.md"
+        ),
+    )
+    return payload, cont, stat

@@ -164,6 +164,16 @@ def record_latency(key: str, seconds: float) -> None:
     _counters.record_latency(key, seconds)
 
 
+def count_host_op(key: str, nbytes: int) -> None:
+    """Count one HOST-level phase execution into the per-op table — the
+    serving runtime's prefill/decode brackets (serving/engine.py), which
+    wrap a whole pinned dispatch rather than one collective.  Gated like
+    :func:`meter` (no-op when telemetry is off)."""
+    if effective_mode() == "off":
+        return
+    _counters.count_op(key, nbytes)
+
+
 # ---------------------------------------------------------------------------
 # dispatch-point op records
 # ---------------------------------------------------------------------------

@@ -247,6 +247,25 @@ def render(snaps: List[dict]) -> str:
                 parts = ", ".join(f"{k}={v}" for k, v in
                                   sorted(commit.items()))
                 lines.append(f"    commit: {parts}")
+    # serving section (docs/serving.md): the request-level story the
+    # per-phase op rows above (serving.prefill / serving.decode, with
+    # p50/p99 and — in the events tier — cross-rank skew + straggler)
+    # do not carry: admissions, completions, failures, tokens, megastep
+    # count, and elastic re-admissions, summed across processes
+    srv = {name[len("serving."):]: n for name, n in total_meters.items()
+           if name.startswith("serving.")}
+    if srv:
+        lines.append("")
+        lines.append("serving:")
+        for label, key in (("requests admitted", "requests_admitted"),
+                           ("requests completed", "requests_completed"),
+                           ("requests failed", "requests_failed"),
+                           ("tokens generated", "tokens_generated"),
+                           ("prefill dispatches", "prefills"),
+                           ("decode megasteps", "megasteps"),
+                           ("drain re-admissions", "readmissions")):
+            if key in srv:
+                lines.append(f"  {label:<22} {srv[key]:>10}")
     epochs = {}
     for snap in snaps:
         for rec in snap.get("epochs", ()):

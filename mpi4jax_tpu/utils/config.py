@@ -83,6 +83,18 @@ DEFAULT_DRAIN_GRACE_S = 5.0
 # once the cap is crossed (docs/aot.md).
 DEFAULT_COMPILE_CACHE_MAX_BYTES = 1 << 30
 
+# serving-runtime defaults (mpi4jax_tpu/serving/, docs/serving.md): the
+# continuous-batching scheduler admits/evicts between decode megasteps
+# against a bucketed batch-shape table (powers of two up to the max
+# batch), a KV slot budget, and a p99 latency objective.  Every knob
+# here only parameterizes the serving engine's own programs — none of
+# them shapes a non-serving trace, so none folds into the generic
+# cache tokens (a serving pin captures them through the world stamp
+# like every other flag).
+DEFAULT_SERVING_MAX_BATCH = 8
+DEFAULT_SERVING_UNROLL = 4
+DEFAULT_SERVING_SLO_P99_MS = 1000.0
+
 # default ring/butterfly crossover: 1 MiB — below it the butterfly's
 # ~2·log2(k) rounds beat the ring's ~2·(k-1) per-round latencies; above it
 # the ring's O(size) vs O(size·log k) byte volume dominates.  Measured per
@@ -325,6 +337,40 @@ FLAGS = {
              "execution').  1 (default) disables the rewrite — the "
              "traced body and HLO are byte-identical to a build "
              "without the megastep layer."),
+        Flag("MPI4JAX_TPU_SERVING_MAX_BATCH", "int",
+             DEFAULT_SERVING_MAX_BATCH,
+             "Serving runtime (mpi4jax_tpu/serving/, docs/serving.md): "
+             "the continuous-batching scheduler's decode batch cap — the "
+             "largest bucket in the batch-shape table, and the most "
+             "sequences resident in one decode megastep.  Default 8."),
+        Flag("MPI4JAX_TPU_SERVING_BUCKETS", "str", "",
+             "Explicit serving batch-bucket table: comma-separated "
+             "ascending batch sizes (e.g. ``1,2,4,8``); every live batch "
+             "is padded UP to the smallest covering bucket so each "
+             "(bucket, phase) maps to exactly ONE pinned program.  Empty "
+             "(default) uses powers of two up to "
+             "MPI4JAX_TPU_SERVING_MAX_BATCH (docs/serving.md)."),
+        Flag("MPI4JAX_TPU_SERVING_KV_SLOTS", "int", 0,
+             "KV-cache slot budget of the serving runtime: how many "
+             "sequences can hold device KV state at once (admission "
+             "blocks when no slot is free; eviction frees slots without "
+             "reshaping the pinned programs — slots are scatter-updated "
+             "rows).  0 (default) sizes the pool at twice the max "
+             "batch."),
+        Flag("MPI4JAX_TPU_SERVING_UNROLL", "int", DEFAULT_SERVING_UNROLL,
+             "Decode megastep trip count of the serving runtime: each "
+             "pinned decode call runs this many device-resident token "
+             "steps (mpx.compile unroll=N), and the scheduler "
+             "admits/evicts only at megastep boundaries — the "
+             "granularity/dispatch-amortization trade of docs/serving.md. "
+             " Default 4."),
+        Flag("MPI4JAX_TPU_SERVING_SLO_P99_MS", "float",
+             DEFAULT_SERVING_SLO_P99_MS,
+             "The serving latency objective: the p99 request latency "
+             "bound (milliseconds) the serving metric is reported "
+             "against (tokens/s/chip AT this p99 bound — "
+             "BENCH_serving.json), and the bound the CI serving lane "
+             "asserts.  Default 1000."),
         Flag("MPI4JAX_TPU_CPP_DISPATCH", "bool", True,
              "Drive pinned executables (``mpx.compile`` -> "
              "``PinnedProgram``) through jax's C++ fast-path dispatch "
@@ -930,6 +976,50 @@ def cpp_dispatch() -> bool:
     available (``MPI4JAX_TPU_CPP_DISPATCH``; default on — see
     mpi4jax_tpu/aot/fastpath.py)."""
     return parse_env_bool("MPI4JAX_TPU_CPP_DISPATCH", True)
+
+
+def serving_max_batch() -> int:
+    """Decode batch cap of the serving runtime
+    (``MPI4JAX_TPU_SERVING_MAX_BATCH``; default 8, minimum 1 — see
+    mpi4jax_tpu/serving/ and docs/serving.md)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_SERVING_MAX_BATCH", DEFAULT_SERVING_MAX_BATCH,
+        minimum=1,
+    )
+
+
+def serving_buckets() -> str:
+    """Raw ``MPI4JAX_TPU_SERVING_BUCKETS`` spec ('' = powers of two up
+    to :func:`serving_max_batch`).  Parsed by
+    ``mpi4jax_tpu.serving.buckets.BucketTable.from_spec``."""
+    return (_getenv("MPI4JAX_TPU_SERVING_BUCKETS") or "").strip()
+
+
+def serving_kv_slots() -> int:
+    """KV slot budget of the serving runtime
+    (``MPI4JAX_TPU_SERVING_KV_SLOTS``; 0 = twice the max batch)."""
+    return _parse_env_positive_int("MPI4JAX_TPU_SERVING_KV_SLOTS", 0)
+
+
+def serving_unroll() -> int:
+    """Decode megastep trip count of the serving runtime
+    (``MPI4JAX_TPU_SERVING_UNROLL``; default 4, minimum 1)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_SERVING_UNROLL", DEFAULT_SERVING_UNROLL, minimum=1,
+    )
+
+
+def serving_slo_p99_ms() -> float:
+    """The serving p99 latency objective in milliseconds
+    (``MPI4JAX_TPU_SERVING_SLO_P99_MS``; default 1000)."""
+    val = parse_env_float("MPI4JAX_TPU_SERVING_SLO_P99_MS",
+                          DEFAULT_SERVING_SLO_P99_MS)
+    if val is None or val <= 0:
+        raise ValueError(
+            "MPI4JAX_TPU_SERVING_SLO_P99_MS must be a positive number of "
+            f"milliseconds, got {val!r}"
+        )
+    return val
 
 
 def prefer_notoken() -> bool:

@@ -242,19 +242,21 @@ def test_mpx_codes_sync():
 def test_docs_list_every_registered_flag():
     """Docs-sync: each declared flag must appear in the docs flag tables
     (docs/usage.md, docs/resilience.md, docs/observability.md,
-    docs/overlap.md, docs/topology.md, docs/aot.md, or
-    docs/autotune.md) — a flag without documentation is
+    docs/overlap.md, docs/topology.md, docs/aot.md, docs/autotune.md,
+    or docs/serving.md) — a flag without documentation is
     indistinguishable from an undocumented sharp bit."""
     config = _load_config()
     docs = "\n".join(
         (REPO / "docs" / f).read_text()
         for f in ("usage.md", "resilience.md", "observability.md",
-                  "overlap.md", "topology.md", "aot.md", "autotune.md")
+                  "overlap.md", "topology.md", "aot.md", "autotune.md",
+                  "serving.md")
     )
     missing = [name for name in config.FLAGS if name not in docs]
     assert not missing, (
         "flags declared in utils/config.py but absent from the docs flag "
         "tables (docs/usage.md / docs/resilience.md / "
         "docs/observability.md / docs/overlap.md / docs/topology.md / "
-        "docs/aot.md / docs/autotune.md): " + ", ".join(missing)
+        "docs/aot.md / docs/autotune.md / docs/serving.md): "
+        + ", ".join(missing)
     )

@@ -1,0 +1,726 @@
+"""Serving runtime: the pure-Python half (docs/serving.md).
+
+Bucket table and pad-up rule, the declared-bucket registry, the KV slot
+allocator, continuous/static scheduler admission + eviction ordering,
+Poisson trace determinism (seeded generator), SLO accounting, the
+serving config + per-(bucket, phase) program shapes, the warm-manifest
+emission (parsed back through the aot CLI's own validator), the MPX136
+checker, the megastep boundary-hook registry, the elastic
+BoundaryControl drain path on a scripted store, the cost-model replay
+(continuous must beat static on a saturated heavy-tail trace), and the
+padded-bucket ``overlap_chunks`` regression — all loaded under a
+private package name (the isolated-loader idiom of
+tests/test_autotune_pure.py) so everything runs even where the
+installed JAX is below the package's floor.
+
+The traced half — pinned-per-bucket bit-identity, megastep-boundary
+admission, the live drain drill — is tests/test_serving.py (needs
+jax >= the package floor).
+"""
+
+import importlib
+import sys
+import types
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_serving_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    # no "ops" stub: nothing in the pure serving half imports the op
+    # stack at module level, and revoke_epoch's guarded cache-drop must
+    # see the package as absent (not as an empty stub)
+    for sub in ("utils", "analysis", "parallel", "resilience",
+                "serving", "aot", "autotune"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "analysis.report", "analysis.graph",
+                "analysis.checkers", "analysis.costmodel",
+                "parallel.megastep", "resilience.faultinject",
+                "resilience.retry", "resilience.watchdog",
+                "resilience.elastic", "autotune.schema",
+                "serving.buckets", "serving.kvcache", "serving.metrics",
+                "serving.scheduler", "serving.model", "serving.engine",
+                "serving.sim", "aot.warm"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+config = ISO.utils.config
+buckets = sys.modules[f"{_ISO_NAME}.serving.buckets"]
+kvcache = sys.modules[f"{_ISO_NAME}.serving.kvcache"]
+metrics = sys.modules[f"{_ISO_NAME}.serving.metrics"]
+scheduler = sys.modules[f"{_ISO_NAME}.serving.scheduler"]
+engine = sys.modules[f"{_ISO_NAME}.serving.engine"]
+sim = sys.modules[f"{_ISO_NAME}.serving.sim"]
+megastep = sys.modules[f"{_ISO_NAME}.parallel.megastep"]
+elastic = sys.modules[f"{_ISO_NAME}.resilience.elastic"]
+warm = sys.modules[f"{_ISO_NAME}.aot.warm"]
+graphmod = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+checkers = sys.modules[f"{_ISO_NAME}.analysis.checkers"]
+report = sys.modules[f"{_ISO_NAME}.analysis.report"]
+
+E = graphmod.CollectiveEvent
+G = graphmod.CollectiveGraph
+
+SERVING_FLAGS = ("MPI4JAX_TPU_SERVING_MAX_BATCH",
+                 "MPI4JAX_TPU_SERVING_BUCKETS",
+                 "MPI4JAX_TPU_SERVING_KV_SLOTS",
+                 "MPI4JAX_TPU_SERVING_UNROLL",
+                 "MPI4JAX_TPU_SERVING_SLO_P99_MS")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for name in SERVING_FLAGS + ("MPI4JAX_TPU_OVERLAP_CHUNKS",
+                                 "MPI4JAX_TPU_TUNING"):
+        monkeypatch.delenv(name, raising=False)
+    buckets.clear_declared_buckets()
+    config.load_tuning(None)
+    yield
+    buckets.clear_declared_buckets()
+    config.load_tuning(None)
+
+
+# ---------------------------------------------------------------------------
+# bucket table
+# ---------------------------------------------------------------------------
+
+
+def test_powers_of_two():
+    assert buckets.powers_of_two(8) == (1, 2, 4, 8)
+    assert buckets.powers_of_two(1) == (1,)
+    assert buckets.powers_of_two(6) == (1, 2, 4, 6)
+    with pytest.raises(ValueError):
+        buckets.powers_of_two(0)
+
+
+def test_bucket_for_and_pad():
+    t = buckets.BucketTable((1, 2, 4, 8))
+    assert [t.bucket_for(n) for n in (1, 2, 3, 4, 5, 8)] == \
+        [1, 2, 4, 4, 8, 8]
+    assert t.pad(5) == 3 and t.pad(8) == 0
+    assert t.max_batch == 8
+    assert 4 in t and 5 not in t
+    with pytest.raises(ValueError):
+        t.bucket_for(0)
+    with pytest.raises(ValueError):
+        t.bucket_for(9)
+
+
+@pytest.mark.parametrize("bad", [(), (0, 2), (2, 1), (1, 1, 2), (1, -4)])
+def test_bucket_table_rejects(bad):
+    with pytest.raises(ValueError):
+        buckets.BucketTable(bad)
+
+
+def test_bucket_spec_parsing():
+    assert buckets.BucketTable.from_spec("", 8).buckets == (1, 2, 4, 8)
+    assert buckets.BucketTable.from_spec("1,3,6").buckets == (1, 3, 6)
+    with pytest.raises(ValueError):
+        buckets.BucketTable.from_spec("1,two")
+    with pytest.raises(ValueError):
+        buckets.BucketTable.from_spec("")
+
+
+def test_declared_registry():
+    assert buckets.declared_buckets() is None
+    t = buckets.declare_buckets((1, 2, 4))
+    assert buckets.declared_buckets() is t
+    t2 = buckets.declare_buckets(buckets.BucketTable((1, 8)))
+    assert buckets.declared_buckets() is t2
+    buckets.clear_declared_buckets()
+    assert buckets.declared_buckets() is None
+
+
+def test_bucket_payload_bytes():
+    assert buckets.bucket_payload_bytes(8, 96 * 4) == 8 * 96 * 4
+    with pytest.raises(ValueError):
+        buckets.bucket_payload_bytes(0, 4)
+
+
+# ---------------------------------------------------------------------------
+# slot allocator
+# ---------------------------------------------------------------------------
+
+
+def test_slot_allocator_deterministic_order():
+    a = kvcache.SlotAllocator(4)
+    assert [a.alloc() for _ in range(4)] == [0, 1, 2, 3]
+    a.free_slot(2)
+    a.free_slot(0)
+    # freed slots re-issue lowest-first regardless of free order
+    assert a.alloc() == 0 and a.alloc() == 2
+    assert a.free() == 0
+
+
+def test_slot_allocator_errors():
+    a = kvcache.SlotAllocator(1)
+    with pytest.raises(ValueError):
+        a.free_slot(0)          # not allocated
+    s = a.alloc()
+    with pytest.raises(RuntimeError):
+        a.alloc()               # exhausted
+    a.free_slot(s)
+    assert a.scratch == 1       # outside the pool
+    with pytest.raises(ValueError):
+        kvcache.SlotAllocator(0)
+
+
+# ---------------------------------------------------------------------------
+# trace generator
+# ---------------------------------------------------------------------------
+
+
+def test_poisson_trace_deterministic():
+    a = scheduler.poisson_trace(32, 100.0, seed=3, long_frac=0.25,
+                                long_new=(32, 64))
+    b = scheduler.poisson_trace(32, 100.0, seed=3, long_frac=0.25,
+                                long_new=(32, 64))
+    assert [(r.arrival_s, r.prompt, r.max_new_tokens) for r in a] == \
+        [(r.arrival_s, r.prompt, r.max_new_tokens) for r in b]
+    c = scheduler.poisson_trace(32, 100.0, seed=4)
+    assert [r.arrival_s for r in a] != [r.arrival_s for r in c]
+
+
+def test_poisson_trace_shape():
+    trace = scheduler.poisson_trace(64, 100.0, seed=0, prompt_len=(2, 5),
+                                    max_new=(4, 8), long_frac=0.5,
+                                    long_new=(20, 30))
+    arrivals = [r.arrival_s for r in trace]
+    assert arrivals == sorted(arrivals) and arrivals[0] > 0
+    assert all(2 <= r.prompt_len <= 5 for r in trace)
+    assert all(4 <= r.max_new_tokens <= 8 or 20 <= r.max_new_tokens <= 30
+               for r in trace)
+    assert any(r.max_new_tokens >= 20 for r in trace)
+    with pytest.raises(ValueError):
+        scheduler.poisson_trace(0, 1.0)
+    with pytest.raises(ValueError):
+        scheduler.poisson_trace(1, 0.0)
+    with pytest.raises(ValueError):
+        scheduler.poisson_trace(1, 1.0, long_frac=1.5)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+def _mktrace(n, arrival=0.0, max_new=4):
+    return [scheduler.Request(rid=i, arrival_s=arrival, prompt=(1, 2),
+                              max_new_tokens=max_new) for i in range(n)]
+
+
+def _sched(cls=scheduler.ContinuousScheduler, max_batch=4, slots=8):
+    table = buckets.BucketTable.from_spec("", max_batch)
+    return cls(table, kvcache.SlotAllocator(slots))
+
+
+def test_admission_fifo_and_bounds():
+    s = _sched(max_batch=4, slots=8)
+    trace = _mktrace(6)
+    assert s.offer(trace, now=0.0) == 6
+    new = s.admit(0.0)
+    # FIFO, bounded by max_batch
+    assert [q.rid for q in new] == [0, 1, 2, 3]
+    assert len(s.waiting) == 2
+    assert s.decode_bucket() == 4
+    # a finished sequence frees its lane and slot; next boundary admits
+    s.running[0].record([9] * 4, 1.0)
+    done = s.finish_ready(1.0)
+    assert [q.rid for q in done] == [0]
+    assert [q.rid for q in s.admit(1.0)] == [4]
+
+
+def test_admission_slot_bound():
+    s = _sched(max_batch=8, slots=2)
+    s.offer(_mktrace(5), 0.0)
+    assert len(s.admit(0.0)) == 2  # KV budget binds before max_batch
+    assert s.alloc.free() == 0
+
+
+def test_static_scheduler_gates_on_drain():
+    s = _sched(cls=scheduler.StaticScheduler, max_batch=4, slots=8)
+    s.offer(_mktrace(8), 0.0)
+    assert len(s.admit(0.0)) == 4
+    s.running[0].record([9] * 4, 0.5)
+    s.finish_ready(0.5)
+    # batch not fully drained: nothing admitted
+    assert s.admit(0.5) == []
+    for q in list(s.running):
+        q.record([9] * 4, 1.0)
+    s.finish_ready(1.0)
+    # drained: the next WHOLE batch comes in at once
+    assert len(s.admit(1.0)) == 4
+
+
+def test_sequence_record_caps_overshoot():
+    q = scheduler.Sequence(request=_mktrace(1, max_new=3)[0], slot=0,
+                           admitted_s=0.0)
+    q.record([5, 6, 7, 8], 1.0)   # a megastep overshoots by one
+    assert q.generated == [5, 6, 7] and q.done
+    assert q.finish_s == 1.0 and q.first_token_s == 1.0
+    assert q.tokens == (1, 2, 5, 6, 7)
+
+
+def test_requeue_and_readmit():
+    s = _sched(max_batch=4, slots=4)
+    s.offer(_mktrace(3), 0.0)
+    s.admit(0.0)
+    moved = s.requeue_running()
+    assert len(moved) == 3 and not s.running and s.alloc.free() == 4
+    s.readmit(moved)
+    assert [q.rid for q in s.running] == [0, 1, 2]
+    assert all(q.preempt_readmissions == 1 for q in s.running)
+
+
+def test_idle():
+    s = _sched()
+    trace = _mktrace(1, arrival=5.0)
+    assert not s.idle(trace)          # not yet offered
+    s.offer(trace, 10.0)
+    s.admit(10.0)
+    assert not s.idle(trace)
+    s.running[0].record([9] * 4, 11.0)
+    s.finish_ready(11.0)
+    assert s.idle(trace)
+    assert s.next_arrival_s(trace) is None
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_percentile():
+    assert metrics.percentile([], 0.5) is None
+    assert metrics.percentile([3.0], 0.99) == 3.0
+    vals = [float(i) for i in range(1, 101)]
+    assert metrics.percentile(vals, 0.5) == 51.0
+    assert metrics.percentile(vals, 0.99) == 99.0
+    with pytest.raises(ValueError):
+        metrics.percentile([1.0], 1.5)
+
+
+def test_summarize_and_bench_payload():
+    trace = _mktrace(2, arrival=1.0, max_new=2)
+    done = []
+    for i, r in enumerate(trace):
+        q = scheduler.Sequence(request=r, slot=i, admitted_s=1.0)
+        q.record([5, 5], 1.0 + 0.1 * (i + 1))
+        done.append(q)
+    cont = metrics.summarize(done, wall_s=2.0, chips=4, slo_p99_ms=500.0)
+    assert cont["completed"] == 2 and cont["failed"] == 0
+    assert cont["tokens"] == 4
+    assert cont["tokens_per_s_per_chip"] == round(4 / 2.0 / 4, 3)
+    assert cont["p99_ms"] == pytest.approx(200.0)
+    assert cont["slo_met"] is True
+    stat = dict(cont, tokens_per_s_per_chip=0.25, scheduler="static")
+    payload = metrics.bench_payload(
+        workload={"model": "m"}, trace_meta={"requests": 2}, chips=4,
+        continuous=cont, static=stat, environment="test")
+    assert payload["schema"] == metrics.BENCH_SCHEMA
+    assert payload["speedup_tokens_per_s"] == \
+        round(cont["tokens_per_s_per_chip"] / 0.25, 3)
+    assert payload["slo_p99_ms"] == 500.0
+
+
+def test_summarize_slo_violation():
+    r = _mktrace(1, arrival=0.0, max_new=1)[0]
+    q = scheduler.Sequence(request=r, slot=0, admitted_s=0.0)
+    q.record([5], 2.0)
+    out = metrics.summarize([q], wall_s=2.0, chips=1, slo_p99_ms=100.0)
+    assert out["p99_ms"] == pytest.approx(2000.0)
+    assert out["slo_met"] is False
+
+
+# ---------------------------------------------------------------------------
+# serving config + program shapes + warm manifest
+# ---------------------------------------------------------------------------
+
+
+def test_config_from_env(monkeypatch):
+    cfg = engine.ServingConfig.from_env()
+    assert cfg.max_batch == config.DEFAULT_SERVING_MAX_BATCH
+    assert cfg.unroll == config.DEFAULT_SERVING_UNROLL
+    assert cfg.slo_p99_ms == config.DEFAULT_SERVING_SLO_P99_MS
+    assert cfg.table().buckets == (1, 2, 4, 8)
+    assert cfg.slots() == 2 * cfg.max_batch
+    monkeypatch.setenv("MPI4JAX_TPU_SERVING_MAX_BATCH", "4")
+    monkeypatch.setenv("MPI4JAX_TPU_SERVING_BUCKETS", "2,4")
+    monkeypatch.setenv("MPI4JAX_TPU_SERVING_KV_SLOTS", "5")
+    monkeypatch.setenv("MPI4JAX_TPU_SERVING_UNROLL", "2")
+    monkeypatch.setenv("MPI4JAX_TPU_SERVING_SLO_P99_MS", "250")
+    cfg = engine.ServingConfig.from_env()
+    assert cfg.max_batch == 4 and cfg.buckets == (2, 4)
+    assert cfg.slots() == 5 and cfg.unroll == 2
+    assert cfg.slo_p99_ms == 250.0
+    # explicit overrides win over env
+    cfg = engine.ServingConfig.from_env(unroll=8)
+    assert cfg.unroll == 8
+
+
+def test_config_validation():
+    cfg = engine.ServingConfig()           # heads=24, ffn=384
+    cfg.validate_world(8)
+    cfg.validate_world(3)
+    with pytest.raises(ValueError):
+        cfg.validate_world(5)              # 24 % 5 != 0
+    with pytest.raises(ValueError):
+        engine.ServingConfig(max_prompt=0).validate_world(1)
+    with pytest.raises(ValueError):
+        # bucket table must top out at max_batch
+        engine.ServingConfig(buckets=(1, 2), max_batch=8).table()
+    cfg.budget_check(8, 16)
+    with pytest.raises(ValueError):
+        cfg.budget_check(cfg.max_prompt + 1, 1)
+    with pytest.raises(ValueError):
+        cfg.budget_check(4, cfg.max_len)   # cannot fit the KV row
+
+
+def test_program_args_shapes():
+    cfg = engine.ServingConfig()
+    k = 8
+    hl = cfg.heads // k
+    pre = cfg.program_args("prefill", 4, k)
+    dec = cfg.program_args("decode", 4, k)
+    rep = cfg.program_args("replay", 4, k)
+    # 5 params + kk + vv + tok + 3 lane arrays
+    assert len(pre) == len(dec) == len(rep) == 11
+    kvs = (k, cfg.slots() + 1, cfg.max_len, hl, cfg.head_dim)
+    assert pre[5] == (kvs, "float32") and pre[6] == (kvs, "float32")
+    assert pre[8] == ((k, 4, cfg.max_prompt), "int32")
+    assert rep[8] == ((k, 4, cfg.max_len), "int32")
+    assert dec[8] == ((k, 4), "int32")
+    with pytest.raises(ValueError):
+        cfg.program_args("sample", 4, k)
+
+
+def test_collective_payload_is_padded():
+    cfg = engine.ServingConfig()
+    # the decode collective payload is derived from the BUCKET, so two
+    # live batch sizes in one bucket consult every payload-keyed knob
+    # with the same bytes
+    assert cfg.collective_payload_bytes(4) == 4 * cfg.dim * 4
+    t = cfg.table()
+    assert t.bucket_for(3) == t.bucket_for(4) == 4
+
+
+def test_warm_manifest_round_trip():
+    cfg = engine.ServingConfig()
+    man = engine.warm_manifest(cfg, 8)
+    specs = warm.parse_manifest(man)     # the aot CLI's own validator
+    assert len(specs) == 3 * len(cfg.table().buckets)
+    labels = {s.label for s in specs}
+    for b in cfg.table().buckets:
+        for phase in engine.ALL_PHASES:
+            assert f"serving.{phase}.b{b}" in labels
+    for s in specs:
+        assert s.fn.startswith("mpi4jax_tpu.serving.model:")
+        assert s.unroll == (cfg.unroll if "decode" in s.label else 1)
+        # manifest shapes ARE the engine's pin shapes
+        phase = s.label.split(".")[1]
+        b = int(s.label.rsplit(".b", 1)[1])
+        want = cfg.program_args(phase, b, 8)
+        got = [(tuple(a["shape"]), a["dtype"]) for a in s.args]
+        assert got == want
+    with pytest.raises(ValueError):
+        engine.warm_manifest(cfg, 5)     # unshardable world
+
+
+# ---------------------------------------------------------------------------
+# MPX136
+# ---------------------------------------------------------------------------
+
+
+def _ev(i, shape, op="allreduce", eager=False):
+    return E(index=i, op=op, shape=shape,
+             payload_bytes=4 * int.__mul__(*shape[:2]) if len(shape) > 1
+             else 0, eager=eager)
+
+
+def test_mpx136_positive():
+    g = G(events=[_ev(0, (5, 96)), _ev(1, (4, 96)), _ev(2, (5, 96)),
+                  _ev(3, (7, 96))],
+          meta={"serving_buckets": (1, 2, 4, 8)})
+    fs = checkers.check_unbucketed_batch(g)
+    assert [f.code for f in fs] == ["MPX136", "MPX136"]
+    assert all(f.severity == "advisory" for f in fs)
+    assert "5" in fs[0].message and "7" in fs[1].message
+    assert "bucket" in fs[0].suggestion
+
+
+def test_mpx136_negative():
+    events = [_ev(0, (4, 96)), _ev(1, (8, 96))]
+    # in-bucket shapes: clean
+    assert not checkers.check_unbucketed_batch(
+        G(events=events, meta={"serving_buckets": (1, 2, 4, 8)}))
+    # no declared table: inert even with odd shapes
+    assert not checkers.check_unbucketed_batch(
+        G(events=[_ev(0, (5, 96))], meta={}))
+    # eager events and shapeless events never count
+    g = G(events=[_ev(0, (5, 96), eager=True),
+                  E(index=1, op="barrier", shape=())],
+          meta={"serving_buckets": (1, 2, 4, 8)})
+    assert not checkers.check_unbucketed_batch(g)
+
+
+def test_mpx136_catalog():
+    info = report.CODES["MPX136"]
+    assert info.severity == report.ADVISORY
+    assert "bucket" in info.doc
+    # owned by exactly the checker above
+    assert "MPX136" in checkers.registered_codes()
+
+
+def test_mpx136_through_run_checkers():
+    g = G(events=[_ev(0, (5, 96))],
+          meta={"serving_buckets": (1, 2, 4, 8), "pinned": True})
+    codes = {f.code for f in checkers.run_checkers(g)}
+    assert "MPX136" in codes
+
+
+# ---------------------------------------------------------------------------
+# megastep boundary hooks
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_hooks_order_and_unregister():
+    calls = []
+    u1 = megastep.register_boundary_hook("a", lambda s, **kw: calls.append(
+        ("a", s, kw.get("engine"))))
+    u2 = megastep.register_boundary_hook("b", lambda s, **kw: calls.append(
+        ("b", s, None)))
+    try:
+        out = megastep.run_boundary_hooks(7, engine="E")
+        assert [n for n, _ in out] == ["a", "b"]
+        assert calls == [("a", 7, "E"), ("b", 7, None)]
+    finally:
+        u1()
+        u2()
+    assert megastep.run_boundary_hooks(8) == []
+    u1()  # double-unregister is a no-op
+    with pytest.raises(TypeError):
+        megastep.register_boundary_hook("bad", None)
+
+
+def test_boundary_hook_exceptions_propagate():
+    def boom(step, **kw):
+        raise RuntimeError("stop the loop")
+
+    u = megastep.register_boundary_hook("boom", boom)
+    try:
+        with pytest.raises(RuntimeError):
+            megastep.run_boundary_hooks(1)
+    finally:
+        u()
+
+
+# ---------------------------------------------------------------------------
+# BoundaryControl: the scripted single-controller drain
+# ---------------------------------------------------------------------------
+
+
+class _FakeMesh:
+    shape = {"x": 4}
+
+
+class _FakeComm:
+    def __init__(self, uid=9001, size=4):
+        self.uid = uid
+        self._size = size
+        self.mesh = _FakeMesh()
+        self.epoch = 0
+
+    def world_size(self):
+        return self._size
+
+
+class _FakeStore:
+    """The minimal surface _boundary_actions touches on the
+    single-controller drain path."""
+
+    def __init__(self):
+        self.comm = _FakeComm()
+        self.bootstrap = {}
+        self.commits = []
+        self.shrinks = []
+        self.drained = False
+        self.committed_step = 0
+
+    def multiprocess(self):
+        return False
+
+    def commit(self, step, state):
+        self.commits.append(step)
+
+    def apply_shrink(self, removed, unit):
+        self.shrinks.append((tuple(sorted(removed)), unit))
+        self.comm = _FakeComm(uid=self.comm.uid + 1,
+                              size=self.comm.world_size() - len(removed))
+
+
+@pytest.fixture
+def _fresh_epoch():
+    elastic._reset_epoch_for_tests()
+    yield
+    elastic._reset_epoch_for_tests()
+
+
+def test_boundary_control_single_controller_drain(_fresh_epoch):
+    store = _FakeStore()
+    with elastic.BoundaryControl(store) as bc:
+        assert bc.poll(0, {"x": 1}) is None
+        elastic.request_drain(rank=3)
+        outcome = bc.poll(1, {"x": 1}, committed=False)
+    assert outcome is not None and outcome[0] == "continue"
+    # the drain force-committed (committed=False) and shrank rank 3 out
+    assert store.commits == [1]
+    assert store.shrinks == [((3,), "rank")]
+    assert elastic.current_epoch() == 1
+    # the old comm is sealed past its leave boundary
+    assert elastic.comm_drained(store.comm.uid - 1)
+
+
+def test_boundary_control_noop_poll(_fresh_epoch):
+    store = _FakeStore()
+    with elastic.BoundaryControl(store) as bc:
+        for step in range(3):
+            assert bc.poll(step, None) is None
+    assert store.shrinks == [] and elastic.current_epoch() == 0
+
+
+# ---------------------------------------------------------------------------
+# cost-model replay: continuous beats static on a heavy-tail trace
+# ---------------------------------------------------------------------------
+
+
+def _bench_cfg():
+    return engine.ServingConfig(heads=24, head_dim=64, ffn=6144,
+                                max_len=160, max_prompt=16, max_batch=8,
+                                unroll=8, slo_p99_ms=1000.0)
+
+
+def _bench_trace():
+    return scheduler.poisson_trace(
+        192, 8000.0, seed=7, prompt_len=(4, 16), max_new=(8, 24),
+        long_frac=0.25, long_new=(96, 128))
+
+
+def test_replay_deterministic():
+    cfg = _bench_cfg()
+    a = sim.replay(cfg, _bench_trace(), k=8)
+    b = sim.replay(cfg, _bench_trace(), k=8)
+    assert a == b
+
+
+def test_replay_continuous_beats_static():
+    cfg = _bench_cfg()
+    trace = _bench_trace()
+    payload, cont, stat = sim.replay_bench(
+        cfg, trace, k=8, trace_meta={"requests": len(trace)})
+    assert cont["failed"] == 0 and stat["failed"] == 0
+    assert cont["completed"] == stat["completed"] == len(trace)
+    assert payload["speedup_tokens_per_s"] >= 1.5, payload
+    assert cont["slo_met"], cont
+    # continuous batching also improves the tail, not just throughput
+    assert cont["p99_ms"] < stat["p99_ms"]
+    assert payload["schema"] == metrics.BENCH_SCHEMA
+    assert "static" in payload and "continuous" in payload
+
+
+def test_replay_step_costs_shape():
+    cfg = _bench_cfg()
+    costs = sim.step_costs_us(cfg, 8)
+    assert costs["dispatch"] > 0
+    for b in cfg.table().buckets:
+        assert costs[f"decode.b{b}"] > 0
+        assert costs[f"prefill.b{b}"] > 0
+    # bigger buckets cost at least as much per step
+    assert costs["decode.b8"] >= costs["decode.b1"]
+
+
+def test_committed_bench_artifact():
+    """The committed BENCH_serving.json must carry both scheduler
+    numbers at one SLO, a >= 1.5x continuous-over-static speedup, and
+    zero failed requests (the acceptance bar of docs/serving.md)."""
+    import json
+
+    path = REPO / "BENCH_serving.json"
+    assert path.exists(), "BENCH_serving.json missing"
+    payload = json.loads(path.read_text())
+    assert payload["schema"] == metrics.BENCH_SCHEMA
+    assert payload["slo_p99_ms"] > 0
+    cont, stat = payload["continuous"], payload["static"]
+    assert cont["slo_p99_ms"] == stat["slo_p99_ms"] == \
+        payload["slo_p99_ms"]
+    assert cont["failed"] == 0 and stat["failed"] == 0
+    assert cont["slo_met"] is True
+    assert cont["tokens_per_s_per_chip"] > 0
+    assert stat["tokens_per_s_per_chip"] > 0
+    assert payload["speedup_tokens_per_s"] >= 1.5
+    assert "environment" in payload
+
+
+# ---------------------------------------------------------------------------
+# the padded-bucket overlap_chunks regression (docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def _tuning_with_chunk_boundary(boundary_bytes):
+    return {
+        "schema": "mpx-tuning/1",
+        "tuned": {"overlap_chunks": [
+            {"max_bytes": boundary_bytes, "chunks": 2},
+            {"max_bytes": None, "chunks": 8},
+        ]},
+    }
+
+
+def test_overlap_chunks_consulted_at_padded_payload():
+    """Two live batches in ONE serving bucket must derive ONE chunk
+    count: the payload every payload-bucketed knob sees at trace time
+    is the PADDED bucket payload (bucket_payload_bytes), never the live
+    payload.  The tuning boundary here is placed BETWEEN the two live
+    payloads, so consulting with live bytes would split the bucket
+    across two chunk counts — two traces, two cache keys."""
+    cfg = engine.ServingConfig()
+    per_item = cfg.dim * 4
+    live_a, live_b = 3, 4                   # same bucket (4)
+    bucket = cfg.table().bucket_for(live_a)
+    assert bucket == cfg.table().bucket_for(live_b)
+    boundary = (live_a * per_item + live_b * per_item) // 2
+    config.load_tuning(_tuning_with_chunk_boundary(boundary))
+    try:
+        # the hazard: live payloads straddle the tuning boundary
+        assert config.overlap_chunks(live_a * per_item) != \
+            config.overlap_chunks(live_b * per_item)
+        # the rule: both consult at the padded bucket payload
+        padded = buckets.bucket_payload_bytes(bucket, per_item)
+        assert cfg.collective_payload_bytes(bucket) == padded
+        assert config.overlap_chunks(padded) == \
+            config.overlap_chunks(padded)
+        assert config.overlap_chunks(padded) == 8
+    finally:
+        config.load_tuning(None)
+
+
+def test_overlap_chunks_env_still_wins(monkeypatch):
+    cfg = engine.ServingConfig()
+    config.load_tuning(_tuning_with_chunk_boundary(1024))
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", "3")
+    assert config.overlap_chunks(
+        cfg.collective_payload_bytes(8)) == 3
