@@ -248,6 +248,123 @@ def bench_hierarchy(comm, sizes_mb=(1, 4), topologies=("2x4", "4x2"),
     return rows
 
 
+def bench_alltoall(comm, sizes_mb=(0.25, 1), topologies=(None,), iters=10,
+                   compute_dim=64):
+    """The alltoall sweep (``--alltoall-sweep``): flat single-exchange
+    vs the forced two-level hierarchical lowering vs the chunked async
+    start/wait split (with synthetic compute in the gap), over a
+    payload x topology grid (docs/moe.md) — the MoE dispatch/combine
+    primitive's three execution shapes.
+
+    A ``None`` topology entry measures under the ambient (derived)
+    topology; spec strings are faked via ``MPI4JAX_TPU_TOPOLOGY`` like
+    the hierarchy sweep.  Each row also carries the MODELED per-rank
+    DCN byte and message columns from the pinned byte models
+    (``ops/_hierarchy``): the hierarchical exchange ships the same
+    bytes in ``1/r`` the DCN messages (``dcn_msg_reduction``), which is
+    the latency/message-rate lever the crossover measures."""
+    from mpi4jax_tpu.ops import _hierarchy
+    from mpi4jax_tpu.utils.config import parse_topology_spec
+
+    n = comm.Get_size()
+    rows = []
+    saved = {k: os.environ.get(k) for k in
+             ("MPI4JAX_TPU_COLLECTIVE_ALGO",
+              "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES",
+              "MPI4JAX_TPU_TOPOLOGY")}
+    try:
+        for topo in topologies:
+            counts = parse_topology_spec(topo) if topo else None
+            if counts is not None and sum(counts) != n:
+                print(f"alltoall sweep: skipping topology {topo} "
+                      f"(covers {sum(counts)} ranks, mesh has {n})",
+                      file=sys.stderr)
+                continue
+            if topo:
+                os.environ["MPI4JAX_TPU_TOPOLOGY"] = topo
+            else:
+                os.environ.pop("MPI4JAX_TPU_TOPOLOGY", None)
+            for mb in sizes_mb:
+                per = max(1, int(mb * 1e6 / 4 / n))
+                nbytes = n * per * 4
+                row = {"size_mb": round(nbytes / 1e6, 4),
+                       "topology": topo or "derived"}
+
+                def timed(env, fn):
+                    for k, v in env.items():
+                        os.environ[k] = str(v)
+                    try:
+                        x = jnp.ones((n, n, per), jnp.float32)
+                        w = jnp.full((n, compute_dim, compute_dim), 0.01,
+                                     jnp.float32)
+                        return _time_program(fn(), (x, w)) / iters
+                    finally:
+                        for k in env:
+                            os.environ.pop(k, None)
+
+                def sync_prog():
+                    @mpx.spmd(comm=comm)
+                    def prog(x, w):
+                        def body(_, carry):
+                            v, m = carry
+                            r, _tok = mpx.alltoall(v)
+                            m = jnp.tanh(m @ m)
+                            return (mpx.varying(r), m)
+
+                        return jax.lax.fori_loop(0, iters, body, (x, w))
+
+                    return prog
+
+                def async_prog():
+                    @mpx.spmd(comm=comm)
+                    def prog(x, w):
+                        def body(_, carry):
+                            v, m = carry
+                            h, _tok = mpx.alltoall_start(v)
+                            m = jnp.tanh(m @ m)  # overlaps the exchange
+                            r, _tok = mpx.alltoall_wait(h)
+                            return (mpx.varying(r), m)
+
+                        return jax.lax.fori_loop(0, iters, body, (x, w))
+
+                    return prog
+
+                huge = 1 << 60  # flat: the crossover can never trip
+                row["flat_us"] = round(timed(
+                    {"MPI4JAX_TPU_COLLECTIVE_ALGO": "auto",
+                     "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES": huge},
+                    sync_prog) * 1e6, 1)
+                row["hier_us"] = round(timed(
+                    {"MPI4JAX_TPU_COLLECTIVE_ALGO": "hier"},
+                    sync_prog) * 1e6, 1)
+                row["async_us"] = round(timed(
+                    {"MPI4JAX_TPU_COLLECTIVE_ALGO": "auto",
+                     "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES": huge},
+                    async_prog) * 1e6, 1)
+                row["hier_speedup"] = (
+                    round(row["flat_us"] / row["hier_us"], 2)
+                    if n > 1 and row["hier_us"] else None
+                )
+                if counts is not None and len(set(counts)) == 1:
+                    h, r = len(counts), counts[0]
+                    row["dcn_bytes_flat"] = _hierarchy.flat_link_bytes(
+                        "alltoall", "native", nbytes, n, h)[1]
+                    row["dcn_bytes_hier"] = _hierarchy.hier_link_bytes(
+                        "alltoall", nbytes, h, r)[1]
+                    mf, mh = _hierarchy.alltoall_dcn_messages(h, r)
+                    row["dcn_msgs_flat"] = mf
+                    row["dcn_msgs_hier"] = mh
+                    row["dcn_msg_reduction"] = r
+                rows.append(row)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rows
+
+
 def bench_fusion(comm, counts=(8, 32), size_kb=64, iters=1):
     """The collective-fusion sweep (``--fusion-sweep``): N small allreduces
     per program, fused (``MPI4JAX_TPU_FUSION=auto``, issue-then-consume
@@ -665,6 +782,21 @@ def main():
     p.add_argument("--hierarchy-sizes-mb", type=float, nargs="+",
                    default=[1, 4],
                    help="payload sizes for --hierarchy-sweep (MB)")
+    p.add_argument("--alltoall-sweep", action="store_true",
+                   help="also run the alltoall sweep (flat single-"
+                        "exchange vs the forced two-level ICI/DCN "
+                        "lowering vs the chunked async start/wait "
+                        "split, over a payload x topology grid with "
+                        "the modeled DCN byte/message columns; "
+                        "docs/moe.md)")
+    p.add_argument("--alltoall-topologies", nargs="+",
+                   default=["2x4", "4x2"],
+                   help="MPI4JAX_TPU_TOPOLOGY specs for "
+                        "--alltoall-sweep (non-matching specs are "
+                        "skipped with a note)")
+    p.add_argument("--alltoall-sizes-mb", type=float, nargs="+",
+                   default=[0.25, 1],
+                   help="payload sizes for --alltoall-sweep (MB)")
     p.add_argument("--dispatch-sweep", action="store_true",
                    help="also run the dispatch sweep (per-call overhead "
                         "of eager vs spmd vs mpx.compile-pinned for the "
@@ -743,6 +875,10 @@ def main():
                    tuple(args.hierarchy_sizes_mb),
                    tuple(args.hierarchy_topologies))
           if args.hierarchy_sweep else None)
+    a2a = (_section("alltoall", bench_alltoall, comm,
+                    tuple(args.alltoall_sizes_mb),
+                    tuple(args.alltoall_topologies))
+           if args.alltoall_sweep else None)
     ds = (_section("dispatch", bench_dispatch, comm,
                    tuple(args.dispatch_sizes_kb), args.dispatch_iters)
           if args.dispatch_sweep else None)
@@ -780,6 +916,9 @@ def main():
     if hs is not None:
         payload["hierarchy"] = hs
         payload["hierarchy_topologies"] = list(args.hierarchy_topologies)
+    if a2a is not None:
+        payload["alltoall"] = a2a
+        payload["alltoall_topologies"] = list(args.alltoall_topologies)
     if ds is not None:
         payload["dispatch"] = ds
         # the AOT/persistent-cache counters are the sweep's provenance:
@@ -850,6 +989,15 @@ def main():
             print(f"  {r['size_mb']:>10.3f} MB   {r['topology']:>8}"
                   f"   {r['flat_us']:>8.1f} us   {r['hier_us']:>8.1f} us"
                   f"   {sp}")
+    if a2a is not None:
+        print("\nalltoall sweep (f32)          topology   flat"
+              "         two-level    async        hier speedup")
+        for r in a2a:
+            sp = (f"{r['hier_speedup']:>6.2f}x"
+                  if r["hier_speedup"] is not None else "n/a (1 device)")
+            print(f"  {r['size_mb']:>10.4f} MB   {r['topology']:>8}"
+                  f"   {r['flat_us']:>8.1f} us   {r['hier_us']:>8.1f} us"
+                  f"   {r['async_us']:>8.1f} us   {sp}")
     if ds is not None:
         print("\ndispatch sweep (SUM, f32)     eager        spmd"
               "         pinned       pinned vs spmd")

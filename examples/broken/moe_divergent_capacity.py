@@ -1,0 +1,117 @@
+"""Seeded rank-divergent MoE capacity split — INTENTIONALLY BROKEN
+(MPX120).
+
+The MoE dispatch/combine contract (docs/moe.md, parallel/moe.py) is
+that every rank derives the SAME capacity bucketing from shared static
+structure: the dispatch buffer shape and the combine chunk count are
+part of the collective schedule.  This fixture breaks it the way real
+MoE stacks do — by deriving the capacity-chunk granularity from the
+rank: even ranks split their combine into TWO half-capacity alltoalls,
+odd ranks issue ONE full-capacity exchange.  Both branches of the
+``lax.cond`` communicate (so MPX108 stays silent) and every branch's
+output shape matches, but at the second collective position on the comm
+the even ranks sit in an ``alltoall`` while the odd ranks are already
+in the gate-stats ``allreduce`` — a cross-rank order mismatch that
+hangs at run time.
+
+Only the cross-rank schedule pass catches it, by re-tracing once per
+rank (concretizing ``comm.Get_rank`` so the cond takes its real
+per-rank path) and matching the per-rank schedules position by
+position (docs/analysis.md "Cross-rank verification"):
+
+    python examples/broken/moe_divergent_capacity.py
+
+runs both front-ends — ``mpx.analyze(ranks='all')`` and the ambient
+``MPI4JAX_TPU_ANALYZE=error`` path — and asserts both flag MPX120.
+This file lives under ``examples/broken/`` so the CI sweep over
+``examples/*.py`` (which must come back clean) does not pick it up; the
+CI analyze lane instead asserts that analyzing THIS file fails with
+MPX120 (.github/workflows/test.yml) — alltoall traffic is the pattern
+the MPX120-125 machinery had never been stress-tested on.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+CAPACITY = 4
+D = 8
+
+
+def build_combine(comm):
+    """The combine stage with rank-derived chunking: even ranks exchange
+    two half-capacity buckets, odd ranks one full bucket, then everyone
+    allreduces the gate load stats.  The schedules disagree at the
+    second collective position on the comm."""
+
+    def combine(buckets):
+        # buckets: (k, CAPACITY, D) — this rank's processed expert output
+        r = comm.Get_rank()
+
+        def even_path(b):
+            half = CAPACITY // 2
+            lo, _ = mpx.alltoall(b[:, :half], comm=comm)
+            hi, _ = mpx.alltoall(b[:, half:], comm=comm)
+            return jnp.concatenate([lo, hi], axis=1)
+
+        def odd_path(b):
+            out, _ = mpx.alltoall(b, comm=comm)
+            return out
+
+        combined = lax.cond(r % 2 == 0, even_path, odd_path, buckets)
+        load, _ = mpx.allreduce(jnp.sum(combined), op=mpx.SUM, comm=comm)
+        return combined, load
+
+    return combine
+
+
+def main():
+    mesh = mpx.make_world_mesh(devices=jax.devices())
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+    if n < 2:
+        print("needs >= 2 devices (e.g. XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8); nothing to "
+              "diverge on 1 rank")
+        return
+    combine = build_combine(comm)
+    x = jnp.stack([
+        jnp.full((n, CAPACITY, D), float(r)) for r in range(n)
+    ])
+
+    # --- front-end 1: explicit cross-rank analysis
+    report = mpx.analyze(combine, x, comm=comm, ranks="all")
+    print(report.render(), file=sys.stderr)
+    codes = {f.code for f in report.findings}
+    assert "MPX120" in codes, f"expected MPX120, got {sorted(codes)}"
+    print("mpx.analyze(ranks='all'): rank-divergent capacity split "
+          "caught (MPX120)", file=sys.stderr)
+
+    # --- front-end 2: the ambient env=error path (the cross-rank pass
+    # runs at spmd trace time, before anything compiles)
+    mpx.set_analyze_mode("error")
+    try:
+        try:
+            mpx.run(combine, x, comm=comm)
+        except mpx.AnalysisError as e:
+            assert any(f.code == "MPX120" for f in e.findings), e.findings
+            print("MPI4JAX_TPU_ANALYZE=error: rank-divergent capacity "
+                  "split caught (MPX120) at trace time", file=sys.stderr)
+        else:
+            raise AssertionError("ambient cross-rank pass missed the "
+                                 "divergent capacity split")
+    finally:
+        mpx.set_analyze_mode(None)
+        mpx.clear_caches()
+
+
+if __name__ == "__main__":
+    main()

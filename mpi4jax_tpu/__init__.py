@@ -39,6 +39,8 @@ from .ops import (  # noqa: F401
     allreduce_start,
     allreduce_wait,
     alltoall,
+    alltoall_start,
+    alltoall_wait,
     barrier,
     bcast,
     cache_stats,
@@ -64,6 +66,7 @@ from .parallel import (  # noqa: F401
     get_default_mesh,
     init_distributed,
     make_world_mesh,
+    moe,
     run,
     set_default_mesh,
     shift,
@@ -180,11 +183,15 @@ __all__ = [
     # throughput layer: fusion + async overlap (docs/overlap.md)
     "allreduce_start",
     "allreduce_wait",
+    "alltoall_start",
+    "alltoall_wait",
     "reduce_scatter_start",
     "reduce_scatter_wait",
     "AsyncHandle",
     "overlap",
     "set_fusion_mode",
+    # expert-parallel MoE helper (docs/moe.md)
+    "moe",
     # AOT pinning + persistent compile cache (docs/aot.md)
     "aot",
     "compile",

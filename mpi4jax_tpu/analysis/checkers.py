@@ -671,6 +671,65 @@ def check_flat_over_dcn(graph: CollectiveGraph) -> List[Finding]:
     return findings
 
 
+@checker("MPX137")
+def check_flat_alltoall_over_dcn(graph: CollectiveGraph) -> List[Finding]:
+    """Flat alltoall on a multi-host comm above the alltoall crossover:
+    the MPX113 analog for the permutation family.  The payload is large
+    enough that ``auto`` would have chosen the two-level ICI/DCN
+    lowering (intra-host transpose, inter-host exchange of
+    host-aggregated contiguous blocks — 1/r the DCN message count), but
+    a forced flat algorithm (or an explicit crossover move) kept the
+    single-level exchange, whose every per-rank message crosses DCN
+    individually.
+
+    Events carry ``hosts`` only when a hierarchical plan was derivable
+    for their comm (``ops/_hierarchy.annotate_selection``), so comms
+    whose host partition is non-uniform — where flat is the only
+    option — never fire this.  Async ``alltoall_start`` spans count
+    like the blocking op (the start phase runs the exchange).
+
+    Like its MPX113 template, a calibrated MEASURED crossover (from a
+    loaded tuning/cost-model file) replaces the static value — as the
+    firing threshold and in the advisory text, which then cites the
+    calibration source."""
+    measured = graph.meta.get("measured_alltoall_crossover_bytes")
+    crossover = measured or graph.meta.get("alltoall_crossover_bytes")
+    if not crossover:
+        return []
+    cite = (
+        f"measured alltoall crossover, {_calibration_cite(graph.meta)}"
+        if measured else "alltoall crossover"
+    )
+    findings: List[Finding] = []
+    for e in graph.events:
+        if e.op not in ("alltoall", "alltoall_start"):
+            continue
+        if e.algo not in ("native", "pairwise"):
+            continue
+        if not e.hosts or e.hosts <= 1:
+            continue
+        if e.comm_size is None or e.comm_size <= e.hosts:
+            continue
+        if e.payload_bytes < crossover:
+            continue
+        r = e.comm_size // e.hosts
+        findings.append(Finding(
+            code="MPX137", op=e.op, index=e.index,
+            message=(f"{e.op} on comm {e.comm_uid} spans {e.hosts} hosts "
+                     f"({e.comm_size} ranks) but ran the flat "
+                     f"'{e.algo}' exchange at {e.payload_bytes} B (>= "
+                     f"the {crossover} B {cite}): every "
+                     f"rank addresses every remote rank directly — "
+                     f"{r}x the DCN message count of the two-level "
+                     "lowering"),
+            suggestion=("let algo=auto pick the hierarchical alltoall, "
+                        "or force MPI4JAX_TPU_COLLECTIVE_ALGO=hier for "
+                        "an A/B run — see docs/moe.md and "
+                        "docs/topology.md"),
+        ))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # perf advisory (MPX109)
 # ---------------------------------------------------------------------------

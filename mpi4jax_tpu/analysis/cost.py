@@ -49,7 +49,7 @@ COST_CODES = ("MPX131", "MPX132", "MPX133", "MPX134", "MPX135")
 # adjacent compute must be able to hide before the advisory fires
 OVERLAP_HIDE_FRACTION = 0.3
 # ops with an async *_start/*_wait split (ops/_async.py)
-ASYNC_CAPABLE_OPS = ("allreduce", "reduce_scatter")
+ASYNC_CAPABLE_OPS = ("allreduce", "reduce_scatter", "alltoall")
 # MPX133: predicted delta below this fraction of the best time is noise
 MISPICK_MIN_FRACTION = 0.10
 # MPX135: minimum transfer hops + distinct ranks of a serialized chain,
@@ -691,10 +691,19 @@ def _check_mispick(sim: _TimedSimulation,
         present = matched.instances[key]
         op = present[min(present)]
         base = _base_op(op)
-        if op.kind != "coll" or base not in ALGO_OPS:
+        if op.kind != "coll" or (base not in ALGO_OPS
+                                 and base != "alltoall"):
             continue
-        if op.algo not in ("butterfly", "ring", "hier"):
-            continue
+        if base == "alltoall":
+            # the permutation family: flat ("native"/"pairwise" price
+            # identically — a fixed permutation) vs the two-level split
+            if op.algo not in ("native", "pairwise", "hier"):
+                continue
+            chosen = "native" if op.algo == "pairwise" else op.algo
+        else:
+            if op.algo not in ("butterfly", "ring", "hier"):
+                continue
+            chosen = op.algo
         members = op.participants
         k = len(members) if members else sim.world
         if k < 2:
@@ -708,7 +717,6 @@ def _check_mispick(sim: _TimedSimulation,
         best, times = costmodel.best_algo(
             base, nbytes, k, sim.model, hosts=op.hosts, hier=hier,
             preserve=preserve)
-        chosen = op.algo
         if chosen not in times or best == chosen:
             continue
         delta = times[chosen] - times[best]

@@ -535,6 +535,18 @@ def collective_cost(op: str, algo: Optional[str], nbytes: int, k: int,
     if op == "allgather":
         term = LinkTerm(k - 1, (k - 1) * nbytes)  # nbytes = one block
     elif op == "alltoall":
+        if algo == "hier" and hier is not None:
+            # the two-level split (ops/_hierarchy.apply_hier_alltoall):
+            # byte model reused from the pinned PR-6 family so the cost
+            # model can never drift from what the lowering moves —
+            # intra transpose (r-1 rounds over ICI), inter exchange of
+            # host-aggregated blocks (h-1 rounds over DCN, 1/r the flat
+            # message count)
+            _, hier_link_bytes = _byte_models()
+            h, r = hier
+            intra_b, inter_b = hier_link_bytes("alltoall", nbytes, h, r)
+            return OpCost(ici=LinkTerm(r - 1 if r > 1 else 0, intra_b),
+                          dcn=LinkTerm(h - 1, inter_b))
         term = LinkTerm(k - 1, (k - 1) * chunk)  # nbytes = full buffer
     elif op == "gather":
         term = LinkTerm(rounds, (k - 1) * nbytes)  # binomial, per-block
@@ -559,6 +571,28 @@ def p2p_cost(nbytes: int, same_host: bool = True) -> OpCost:
     return OpCost(ici=term) if same_host else OpCost(dcn=term)
 
 
+def chunked_async_cost(cost: OpCost, chunks: int) -> OpCost:
+    """Modeled cost of the ``C``-chunk async split of one collective
+    (ops/_async.py ``*_start``/``*_wait``): the chunks partition the
+    payload, so total wire bytes are unchanged; each active link pays
+    ``C - 1`` extra chunk-rounds of pipeline fill (double buffering) on
+    top of the base round count.  The alpha overhead is the price of
+    the split — the win, which the critical-path simulation (not this
+    per-op formula) credits, is that everything past the fill is
+    hideable behind independent compute issued in the start→wait gap
+    (MPX131 quantifies exactly that)."""
+    if chunks <= 1:
+        return cost
+
+    def _ext(term: LinkTerm) -> LinkTerm:
+        if not term:
+            return term
+        return LinkTerm(term.rounds + chunks - 1, term.nbytes)
+
+    return OpCost(ici=_ext(cost.ici), dcn=_ext(cost.dcn),
+                  gamma_bytes=cost.gamma_bytes)
+
+
 def best_algo(op: str, nbytes: int, k: int, model: CostModel,
               hosts: Optional[int] = None,
               hier: Optional[Tuple[int, int]] = None,
@@ -569,11 +603,19 @@ def best_algo(op: str, nbytes: int, k: int, model: CostModel,
     ``(best, {algo: time_us})`` — the MPX133 discriminator and the
     flat-vs-hier comparator the acceptance sweep checks sign against."""
     if candidates is None:
-        candidates = ["butterfly"]
-        if k >= 4 and not preserve:  # RING_MIN_GROUP, mirrored literally
-            candidates.append("ring")
-        if hier is not None:
-            candidates.append("hier")
+        if op == "alltoall":
+            # the permutation family has exactly two shapes: the flat
+            # single-level exchange ("native" — the pairwise rounds
+            # price identically) and the two-level hierarchical split
+            candidates = ["native"]
+            if hier is not None:
+                candidates.append("hier")
+        else:
+            candidates = ["butterfly"]
+            if k >= 4 and not preserve:  # RING_MIN_GROUP, mirrored
+                candidates.append("ring")
+            if hier is not None:
+                candidates.append("hier")
     times = {
         a: model.time_us(collective_cost(op, a, nbytes, k, hosts=hosts,
                                          hier=hier, preserve=preserve))

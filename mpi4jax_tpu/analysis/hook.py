@@ -124,6 +124,7 @@ def config_snapshot() -> dict:
         "collective_algo": config.collective_algo(),
         "ring_crossover_bytes": config.ring_crossover_bytes(),
         "dcn_crossover_bytes": config.dcn_crossover_bytes(),
+        "alltoall_crossover_bytes": config.alltoall_crossover_bytes(),
         "topology": config.topology_spec(),
         "fusion": fusion_mode(),
         "fusion_bucket_bytes": config.fusion_bucket_bytes(),

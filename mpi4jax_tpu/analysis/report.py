@@ -293,6 +293,20 @@ CODES = {
             "(docs/serving.md).",
         ),
         CodeInfo(
+            "MPX137", "flat alltoall on a multi-host comm", ADVISORY,
+            "A comm spanning multiple hosts ran a flat (single-level) "
+            "alltoall at a payload above "
+            "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES while the two-level "
+            "hierarchical lowering was expressible: every rank "
+            "addresses every remote rank directly, paying r times the "
+            "DCN message count of the hierarchical split (intra-host "
+            "transpose over ICI, inter-host exchange of host-aggregated "
+            "contiguous blocks over DCN — ops/_hierarchy.py).  The "
+            "MPX113 analog for the permutation family; let auto pick "
+            "the hierarchy, or force MPI4JAX_TPU_COLLECTIVE_ALGO=hier "
+            "(docs/moe.md).",
+        ),
+        CodeInfo(
             "MPX130", "async span straddles a megastep loop boundary", ERROR,
             "An async *_start/*_wait span crosses a megastep loop "
             "boundary (mpx.compile/mpx.spmd unroll=N, "

@@ -360,6 +360,47 @@ def autotune(comm=None, budget_s: float = 60.0, save: Optional[str] = None,
             _meter("autotune.fits")
             note(f"hier crossover @ {spec}: {x} B")
 
+    # -- phase 4b: alltoall crossover (flat vs hier, per topology) --------
+    # the permutation-family twin of phase 4: the payload where the
+    # two-level alltoall first beats the flat exchange, interpolated
+    # from the --alltoall-sweep grid (docs/moe.md).  Swept per requested
+    # topology (or the ambient derived one on a real multi-host pod);
+    # single-host meshes leave the knob untuned — there is no DCN to
+    # aggregate messages over.
+    a2a_specs = list(topologies) or ([None] if hosts > 1 else [])
+    for spec in a2a_specs:
+        if not budget.ok():
+            note("budget exhausted before the alltoall crossover sweep")
+            break
+        a2a_rows = micro.bench_alltoall(
+            comm, sizes_mb=tuple(ALGO_SIZES_MB[:4]),
+            topologies=(spec,), iters=5)
+        x = fit.measured_crossover(a2a_rows, "size_mb", "flat_us",
+                                   "hier_us")
+        if x is None:
+            continue
+        if spec is None:
+            tuned["alltoall_crossover_bytes"] = int(x)
+            measured["alltoall_crossover_bytes"] = int(x)
+            fit_sources["alltoall_crossover_bytes"] = "sweep"
+            fitted.append("alltoall_crossover_bytes")
+        else:
+            topo_overrides.setdefault(spec, {})[
+                "alltoall_crossover_bytes"] = int(x)
+            if "alltoall_crossover_bytes" not in tuned:
+                # the first fitted topology also seeds the flat knob so
+                # an untopologized consumer still gets a measured value
+                tuned["alltoall_crossover_bytes"] = int(x)
+                measured["alltoall_crossover_bytes"] = int(x)
+                fit_sources["alltoall_crossover_bytes"] = (
+                    f"sweep @ {spec}")
+                fitted.append("alltoall_crossover_bytes")
+            fitted.append(f"alltoall[{spec}]")
+        _meter("autotune.fits")
+        note(f"alltoall crossover @ {spec or 'ambient'}: {x} B")
+    if "alltoall_crossover_bytes" not in tuned:
+        unfitted.append("alltoall_crossover_bytes")
+
     # -- phase 5: fusion bucket bytes -------------------------------------
     bucket_rows = []
     for cand in FUSION_BUCKET_CANDIDATES:

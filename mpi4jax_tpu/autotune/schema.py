@@ -47,6 +47,11 @@ COST_SCHEMA = "mpx-cost-model/1"
 KNOB_FLAGS = {
     "ring_crossover_bytes": "MPI4JAX_TPU_RING_CROSSOVER_BYTES",
     "dcn_crossover_bytes": "MPI4JAX_TPU_DCN_CROSSOVER_BYTES",
+    # schema-bump-free addition (PR 15): an unknown-key-rejecting
+    # validator plus a content stamp means a NEW tuned knob needs no
+    # version bump — old files simply do not tune it, new files retrace
+    # via the stamp (docs/autotune.md)
+    "alltoall_crossover_bytes": "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES",
     "fusion_bucket_bytes": "MPI4JAX_TPU_FUSION_BUCKET_BYTES",
     "overlap_chunks": "MPI4JAX_TPU_OVERLAP_CHUNKS",
 }

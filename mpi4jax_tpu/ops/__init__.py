@@ -28,6 +28,8 @@ from ._async import (  # noqa: F401
     AsyncHandle,
     allreduce_start,
     allreduce_wait,
+    alltoall_start,
+    alltoall_wait,
     overlap,
     reduce_scatter_start,
     reduce_scatter_wait,

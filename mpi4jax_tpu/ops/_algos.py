@@ -75,10 +75,12 @@ def algo_cache_token() -> tuple:
     happen to match the defaults (the env route pins a file's content
     at first read per process, so an in-place edit needs the explicit
     ``load_tuning(path)`` refresh); with no layer the token is exactly
-    the pre-tuning 4-tuple, so cache keys stay byte-identical (pinned
-    by tests/test_autotune.py)."""
+    the flat 5-tuple below (the alltoall crossover joined the base in
+    PR 15, deliberately moving every cache key once) with no trailing
+    stamp entry (pinned by tests/test_autotune_pure.py)."""
     base = (config.collective_algo(), config.ring_crossover_bytes(),
-            config.dcn_crossover_bytes(), config.topology_spec())
+            config.dcn_crossover_bytes(), config.topology_spec(),
+            config.alltoall_crossover_bytes())
     stamp = config.tuning_stamp()
     return base if stamp is None else base + (("tuning", stamp),)
 
@@ -138,6 +140,34 @@ def resolve_dcn_algo(shard_bytes: int, h: int, ring_ok: bool = True) -> str:
             and shard_bytes >= config.dcn_crossover_bytes()):
         return "ring"
     return "butterfly"
+
+
+def resolve_alltoall_algo(algo: str, payload_bytes: int, hier_ok: bool,
+                          flat: str = "native") -> str:
+    """Lowering pick for one alltoall: ``"hier"`` (the two-level
+    ICI/DCN split of ops/_hierarchy.py) or ``flat`` (the single-level
+    exchange — ``"native"`` for the one-AllToAll-HLO whole-axes path,
+    ``"pairwise"`` for the chunked ppermute rounds the async split
+    uses on color-split comms).
+
+    ``MPI4JAX_TPU_COLLECTIVE_ALGO=hier`` forces the hierarchy where
+    expressible (``hier_ok``); the forced flat algorithms
+    (``butterfly``/``ring``) force the flat lowering — alltoall is a
+    fixed permutation, so "flat" is the only single-level shape and
+    both spellings mean it.  ``auto`` picks the hierarchy on a
+    multi-host comm when the payload clears
+    ``MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES`` (below it, one monolithic
+    exchange's latency wins; above it, the intra-host aggregation cuts
+    the DCN message count to 1/r of flat — docs/moe.md).  Bit-identical
+    either way: no arithmetic, only routing.
+    """
+    if algo == "hier":
+        return "hier" if hier_ok else flat
+    if algo in ("butterfly", "ring"):
+        return flat
+    if hier_ok and payload_bytes >= config.alltoall_crossover_bytes():
+        return "hier"
+    return flat
 
 
 def algorithm_bytes_per_rank(algo: str, nbytes: int, k: int,
@@ -230,6 +260,32 @@ def rs_finish_pair(where, fn, pos, k, lo, hi):
     (which owns chunk ``pos``): ``lo ∘ hi``, except chunk ``k-1`` whose
     journey never wrapped (``lo`` still holds its placeholder)."""
     return where(pos == k - 1, hi, fn(lo, hi))
+
+
+def rotation_pairs(groups, t: int):
+    """Static ppermute pairs of alltoall pairwise-exchange round ``t``:
+    every group position ``p`` sends to position ``(p + t) % k`` — one
+    rotation per round, ``k - 1`` rounds total (round 0 is the local
+    own-block copy).  Singleton groups need no edges."""
+    return [
+        (members[p], members[(p + t) % len(members)])
+        for members in groups
+        if len(members) > 1
+        for p in range(len(members))
+    ]
+
+
+def a2a_send_block(pos, t, k):
+    """Block index group-position ``pos`` ships in pairwise-exchange
+    round ``t``: the block addressed to its round-``t`` partner
+    ``(pos + t) % k``."""
+    return (pos + t) % k
+
+
+def a2a_recv_slot(pos, t, k):
+    """Source position whose block arrives at ``pos`` in round ``t`` (=
+    the output slot it fills): the rotation's inverse, ``(pos - t) % k``."""
+    return (pos - t) % k
 
 
 def next_pow2(k: int) -> int:
@@ -358,6 +414,41 @@ def apply_ring_allreduce(x, op, comm, k=None):
     mine = apply_ring_reduce_scatter(blocks, op, comm, k)
     full = apply_ring_allgather(mine, comm, k, comm.Get_rank())
     return full.reshape(-1)[:n].reshape(shape)
+
+
+def apply_pairwise_alltoall(blocks, comm, k: int):
+    """Pairwise-exchange alltoall of ``blocks`` (shape ``(k, *s)``,
+    block ``i`` addressed to group position ``i``) over ``comm``:
+    position ``p`` receives ``(k, *s)`` where ``out[q]`` is position
+    ``q``'s block addressed to ``p``.
+
+    ``k - 1`` ppermute rounds, round ``t`` rotating every position's
+    round-``t`` block one ``t``-step around the group
+    (``rotation_pairs``) — one chunk-sized message per rank per round,
+    the classic pairwise schedule.  This is the expressible-anywhere
+    building block of the hierarchical alltoall (ops/_hierarchy.py) and
+    the chunked async split (ops/_async.py): unlike the native AllToAll
+    HLO it works on color-split comms, and unlike the allgather-based
+    group lowering it ships each rank only its own O(size) bytes.
+    Requires a uniform static group size.  Pure routing — bit-identical
+    to any other alltoall lowering by construction.
+    """
+    from ._base import _comm_groups, _permute_axis, as_varying
+
+    blocks = as_varying(blocks, comm.axes)
+    if k == 1:
+        return blocks
+    pos = comm.Get_rank()
+    axis = _permute_axis(comm)
+    groups = _comm_groups(comm)
+    out = jnp.zeros_like(blocks)
+    out = out.at[pos].set(jnp.take(blocks, pos, axis=0))  # own block
+    for t in range(1, k):
+        pairs = rotation_pairs(groups, t)
+        send = jnp.take(blocks, a2a_send_block(pos, t, k), axis=0)
+        recvd = lax.ppermute(send, axis, pairs)
+        out = out.at[a2a_recv_slot(pos, t, k)].set(recvd)
+    return out
 
 
 def apply_binomial_scatter(buf, groups, root: int, axis, relpos, K: int):

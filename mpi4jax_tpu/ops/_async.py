@@ -1,6 +1,7 @@
 """Async collectives: ``*_start``/``*_wait`` pairs + the ``mpx.overlap()``
-region — communication/compute overlap for ``allreduce`` and
-``reduce_scatter``.
+region — communication/compute overlap for ``allreduce``,
+``reduce_scatter``, and ``alltoall`` (the MoE dispatch/combine
+primitive, docs/moe.md).
 
 A monolithic collective is one HLO op: XLA schedules everything after it
 behind it, so independent compute waits on the wire.  Splitting the
@@ -56,6 +57,8 @@ __all__ = [
     "AsyncHandle",
     "allreduce_start",
     "allreduce_wait",
+    "alltoall_start",
+    "alltoall_wait",
     "reduce_scatter_start",
     "reduce_scatter_wait",
     "overlap",
@@ -361,6 +364,129 @@ def allreduce_wait(handle, *, token: Optional[Token] = None):
 
 
 # ---------------------------------------------------------------------------
+# alltoall start / wait
+# ---------------------------------------------------------------------------
+
+
+@enforce_types(comm=(Comm, None), token=(Token, None))
+def alltoall_start(x, *, comm: Optional[Comm] = None,
+                   token: Optional[Token] = None):
+    """Begin an async alltoall of ``x`` (shape ``(size, *s)``, block
+    ``i`` addressed to rank ``i``): splits the per-block payload into
+    ``MPI4JAX_TPU_OVERLAP_CHUNKS`` independent double-buffered
+    pairwise-exchange phases and emits them all, returning
+    ``(handle, token)``.  Issue independent compute — the next capacity
+    chunk's expert MLP, in the MoE recipe (docs/moe.md) — then finish
+    with :func:`alltoall_wait`.
+
+    On a multi-host comm above ``MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES``
+    each chunk's exchange runs the two-level hierarchical split
+    (ops/_hierarchy.py) — intra-host transpose AND the DCN exchange at
+    start — so the compute in the gap overlaps the expensive inter-host
+    messages, not just ICI.  The wait is pure reassembly either way.
+    """
+    from . import _algos, _hierarchy
+    from ._base import as_varying, dispatch
+
+    comm = _require_region("alltoall_start", comm)
+    handle = AsyncHandle("alltoall", comm, None)
+
+    def body(comm, arrays, token):
+        (xl,) = arrays
+        size = comm.Get_size()
+        if xl.ndim == 0 or xl.shape[0] != size:
+            raise ValueError(
+                f"alltoall_start input must have leading axis == comm "
+                f"size ({size}), got shape {xl.shape}"
+            )
+        arrays, token = _span_open("alltoall", comm, (xl,), token, handle)
+        xl = consume(token, arrays[0])
+        handle.shape = xl.shape
+        handle.dtype = xl.dtype
+        handle.k = size
+        xl = as_varying(xl, comm.axes)
+        if size == 1:
+            handle.mode = "full"
+            handle.algo = "native"
+            res = xl  # one rank: the exchange is the identity
+            return res, produce(token, res)
+        nbytes = xl.size * xl.dtype.itemsize
+        plan = _hierarchy.hier_plan(comm)
+        # the flat form here is the pairwise ppermute exchange (not the
+        # monolithic AllToAll HLO): each chunk must be an INDEPENDENT
+        # op chain the scheduler can interleave compute between, and
+        # pairwise is expressible on color splits too
+        algo = _algos.resolve_alltoall_algo(
+            config.collective_algo(), nbytes,
+            hier_ok=plan is not None, flat="pairwise",
+        )
+        use_hier = algo == "hier"
+        handle.mode = "hier" if use_hier else "flat"
+        handle.algo = algo
+        handle.plan = plan if use_hier else None
+        blocks = xl.reshape(size, -1)
+        sizes = overlap_chunk_split(blocks.shape[1],
+                                    config.overlap_chunks(nbytes))
+        handle.sizes = sizes
+        _hierarchy.annotate_selection("alltoall", algo, nbytes, size,
+                                      plan, comm)
+        _meter_chunks("alltoall", comm, blocks.dtype, len(sizes))
+        pieces = []
+        off = 0
+        for csz in sizes:
+            seg = blocks[:, off:off + csz]
+            off += csz
+            if use_hier:
+                pieces.append(_hierarchy.apply_hier_alltoall(seg, comm,
+                                                             plan))
+            else:
+                pieces.append(_algos.apply_pairwise_alltoall(seg, comm,
+                                                             size))
+        return (*pieces, produce(token, pieces[0]))
+
+    out = dispatch("alltoall_start", comm, body, (x,), token,
+                   ana={"span": handle.uid}, bare=True)
+    *pieces, tok = out
+    handle.pieces = tuple(pieces)
+    return handle, tok
+
+
+@enforce_types(token=(Token, None))
+def alltoall_wait(handle, *, token: Optional[Token] = None):
+    """Finish an async alltoall: reassembles the exact input shape from
+    the chunk pieces (every exchange phase already ran at start — the
+    wait is the reassembly barrier the results gate on) and closes the
+    start's instrumentation span.  Returns ``(result, token)``."""
+    _check_handle("alltoall_wait", handle, "alltoall")
+    from ._base import dispatch
+
+    comm = handle.comm
+
+    def body(comm, arrays, token):
+        arrays = consume(token, *arrays)
+        if len(handle.pieces) == 1:
+            arrays = (arrays,)
+        if handle.mode == "full":
+            res = arrays[0]
+        else:
+            import jax.numpy as jnp
+
+            parts = [p.reshape(handle.k, -1) for p in arrays]
+            flat = (jnp.concatenate(parts, axis=1) if len(parts) > 1
+                    else parts[0])
+            res = flat.reshape(handle.shape)
+        _annotate_algo(handle.algo, link=(0, 0))
+        _span_close(handle, comm, res, [res])
+        return res, produce(token, res)
+
+    res, tok = dispatch("alltoall_wait", comm, body, handle.pieces, token,
+                        ana={"span": handle.uid}, bare=True)
+    handle.waited = True
+    handle.pieces = None
+    return res, tok
+
+
+# ---------------------------------------------------------------------------
 # reduce_scatter start / wait
 # ---------------------------------------------------------------------------
 
@@ -525,8 +651,9 @@ _overlap_stack: List[_Scope] = []
 
 
 class overlap:
-    """``with mpx.overlap():`` — inside, ``allreduce`` and
-    ``reduce_scatter`` auto-split into start/wait: the start phase is
+    """``with mpx.overlap():`` — inside, ``allreduce``,
+    ``reduce_scatter``, and ``alltoall`` auto-split into start/wait: the
+    start phase is
     emitted at the call site and the wait is deferred until the result is
     first used (or the region exits), so the compute issued in between
     overlaps with the wire phases.  Requires a managed parallel region
@@ -566,6 +693,8 @@ class _LazyWait(_fusion.LazyResult):
         if self._value is None:
             if self._handle.kind == "allreduce":
                 res, _ = allreduce_wait(self._handle)
+            elif self._handle.kind == "alltoall":
+                res, _ = alltoall_wait(self._handle)
             else:
                 res, _ = reduce_scatter_wait(self._handle)
             self._value = res
@@ -590,10 +719,10 @@ def maybe_lazy(opname: str, x, op, comm, token):
         return None
     if opname == "allreduce":
         handle, tok = allreduce_start(x, op, comm=comm, token=token)
-        shape = handle.shape
+    elif opname == "alltoall":
+        handle, tok = alltoall_start(x, comm=comm, token=token)
     else:
         handle, tok = reduce_scatter_start(x, op, comm=comm, token=token)
-        shape = handle.shape
-    lw = _LazyWait(handle, shape, handle.dtype)
+    lw = _LazyWait(handle, handle.shape, handle.dtype)
     _overlap_stack[-1].lazies.append(lw)
     return lw, tok

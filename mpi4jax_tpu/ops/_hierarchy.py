@@ -67,10 +67,12 @@ __all__ = [
     "comm_hosts",
     "hier_link_bytes",
     "flat_link_bytes",
+    "alltoall_dcn_messages",
     "annotate_selection",
     "apply_hier_allreduce",
     "apply_hier_reduce_scatter",
     "apply_hier_bcast",
+    "apply_hier_alltoall",
 ]
 
 
@@ -164,6 +166,18 @@ def hier_link_bytes(kind: str, nbytes: int, h: int, r: int,
     """
     pair = 2 if preserve else 1
     chunk = -(-nbytes // r)
+    if kind == "alltoall":
+        # intra transpose ships (r-1) destination blocks of size/r each
+        # over ICI; the inter exchange ships (h-1) host-aggregated
+        # contiguous blocks of size/h each over DCN (the final intra
+        # scatter degenerates to a local de-interleave — every rank is
+        # its own position-group leader, see apply_hier_alltoall).
+        # Total bytes match flat (alltoall is a fixed permutation); the
+        # win is message granularity: alltoall_dcn_messages pins the
+        # 1/r DCN message-count reduction.
+        intra = (r - 1) * chunk
+        inter = (h - 1) * (-(-nbytes // h))
+        return intra, inter
     if kind == "allreduce":
         intra = (r - 1) * chunk * (pair + 1)
         dcn = _algos.resolve_dcn_algo(chunk, h, ring_ok=not preserve)
@@ -214,6 +228,15 @@ def flat_link_bytes(kind: str, algo: str, nbytes: int, k: int,
     pair = 2 if preserve else 1
     rounds = (k - 1).bit_length() if k > 1 else 0  # ceil(log2 k)
     chunk = -(-nbytes // k) if k else nbytes
+    if kind == "alltoall":
+        # a fixed permutation: every flat lowering — the native AllToAll
+        # HLO, the pairwise ppermute rounds — moves the same (k-1) blocks
+        # of size/k per rank, so (unlike the reduction family) the
+        # ``native`` algorithm is honestly modeled rather than proxied
+        total = (k - 1) * chunk
+        if h is not None and h > 1:
+            return 0, total
+        return total, 0
     if algo == "butterfly":
         if kind == "bcast":
             total = rounds * nbytes
@@ -231,6 +254,26 @@ def flat_link_bytes(kind: str, algo: str, nbytes: int, k: int,
     if h is not None and h > 1:
         return 0, total
     return total, 0
+
+
+def alltoall_dcn_messages(h: int, r: int) -> Tuple[int, int]:
+    """DCN (cross-host) message counts ``(flat, hier)`` of one alltoall
+    over ``h`` hosts × ``r`` ranks/host — the latency claim of the
+    hierarchical split, pinned by tests/test_hierarchy.py:
+
+    - flat: every rank addresses every remote rank directly —
+      ``r² · h · (h−1)`` cross-host messages of ``size/(h·r)`` each;
+    - hier: each rank exchanges one host-aggregated CONTIGUOUS block
+      with each of its ``h−1`` position-group peers — ``r · h · (h−1)``
+      messages of ``size/h`` each (``h·(h−1)`` per position group).
+
+    Exactly ``1/r`` the flat message count at ``r×`` the message size;
+    total DCN bytes are invariant (the permutation is fixed), so the
+    whole win is per-message DCN latency and NIC message rate — the
+    lever Tutel/FasterMoE pull for expert-parallel dispatch."""
+    flat = r * r * h * (h - 1)
+    hier = r * h * (h - 1)
+    return flat, hier
 
 
 # ---------------------------------------------------------------------------
@@ -488,6 +531,54 @@ def apply_hier_bcast(x, comm, root: int, plan: HierPlan):
     mine = _inter_bcast(mine, plan, b0, chunk * itemsize)
     full = _algos.apply_ring_allgather(mine, plan.intra, r, relpos)
     return full.reshape(-1)[:n].reshape(shape)
+
+
+def apply_hier_alltoall(xl, comm, plan: HierPlan):
+    """Two-level alltoall of ``xl`` (shape ``(k, *s)``, block ``i``
+    addressed to group position ``i``): intra-host transpose over ICI →
+    inter-host exchange of host-aggregated contiguous blocks over DCN →
+    local de-interleave.
+
+    Writing position ``i = b·r + j`` (host block ``b``, intra position
+    ``j`` — contiguous uniform blocks by plan construction):
+
+    1. **intra transpose (ICI)** — member ``(b, i)`` ships host-mate
+       ``(b, j)`` its ``h`` blocks addressed to position ``j`` of every
+       host (one pairwise alltoall over ``plan.intra`` with
+       ``size/r``-byte messages); afterwards ``(b, j)`` holds
+       ``A[i, b'] = x_{(b,i)}[b'·r + j]`` — its host's ENTIRE traffic
+       for the position-``j`` members, contiguous per destination host;
+    2. **inter exchange (DCN)** — over the position-``j`` leader group
+       (``plan.inter``), ``(b, j)`` ships ``(b', j)`` the aggregated
+       block ``A[:, b']`` — ``h·(h−1)`` messages of ``size/h`` per
+       group instead of flat's ``r²·h·(h−1)`` per-rank ones
+       (``alltoall_dcn_messages``: exactly ``1/r`` the DCN message
+       count);
+    3. **intra scatter** — degenerates to a local de-interleave: every
+       rank is its own position-group leader, so after the inter
+       exchange it already holds every peer's block addressed to it,
+       ordered ``(source host, source intra position)`` = ascending
+       group order.
+
+    Pure routing, no arithmetic — bit-identical to the flat lowering by
+    construction (pinned across {2x4, 4x2, 8x1, 2x2} by the lockstep
+    simulator in tests/test_hierarchy.py).
+    """
+    from ._base import as_varying
+
+    xl = as_varying(xl, comm.axes)
+    h, r = plan.h, plan.r
+    s = xl.shape[1:]
+    if r == 1:
+        # one rank per host: the inter exchange IS the whole alltoall
+        return _algos.apply_pairwise_alltoall(xl, plan.inter, h)
+    y = jnp.moveaxis(xl.reshape((h, r) + s), 1, 0)  # y[j, b'] → (b'·r + j)
+    a = _algos.apply_pairwise_alltoall(y, plan.intra, r)
+    # a[i, b'] = host-mate i's block addressed to (b', my intra pos)
+    z = jnp.moveaxis(a, 1, 0)  # z[b', i]: the host-aggregated block for b'
+    w = _algos.apply_pairwise_alltoall(z, plan.inter, h)
+    # w[b'', i] = the block rank b''·r + i addressed to me
+    return w.reshape((h * r,) + s)
 
 
 def _inter_bcast(v, plan: HierPlan, b0: int, nbytes: int):

@@ -4,8 +4,25 @@ TPU-native re-design of ref mpi4jax/_src/collective_ops/alltoall.py.  Shape
 contract preserved: input ``(size, *s)`` -> output ``(size, *s)`` where
 ``out[i]`` is the slice rank ``i`` addressed to us; the leading-axis == size
 requirement is checked like the reference (ref alltoall.py:71-73).
-Lowering: one AllToAll HLO — the building block for Ulysses-style sequence
-parallelism (head/sequence exchange).
+
+Lowerings, picked per call by ``_algos.resolve_alltoall_algo``:
+
+- **flat** (``native``): one AllToAll HLO on a whole-axes comm — the
+  building block for Ulysses-style sequence parallelism (head/sequence
+  exchange) — or the allgather+select group form on color splits;
+- **hierarchical** (``hier``, ops/_hierarchy.py): on a multi-host comm
+  above ``MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES``, the two-level split —
+  intra-host transpose over ICI, inter-host exchange of host-aggregated
+  contiguous blocks over DCN (1/r the DCN message count), local
+  de-interleave.  Bit-identical to flat by construction (pure routing);
+  below the crossover / on single-host comms the flat path is emitted
+  unchanged, so the lowered HLO is byte-identical to the pre-crossover
+  build (pinned by tests/test_hier_traced.py).
+
+Throughput layer (docs/overlap.md, docs/moe.md): inside ``mpx.overlap()``
+the call auto-splits into ``alltoall_start``/``alltoall_wait``
+(ops/_async.py) and the result is lazy until first use — the MoE
+combine-exchange overlap rides exactly this path.
 """
 
 from typing import Optional
@@ -15,6 +32,7 @@ from jax import lax
 from ..parallel.comm import Comm
 from ..utils.debug import log_op
 from ..utils.validation import enforce_types
+from . import _async
 from ._base import _permute_axis, dispatch, group_select_gather
 from .token import Token, consume, produce
 
@@ -26,8 +44,14 @@ def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
 
     Returns ``(result, token)`` (ref API: alltoall.py:39-77).
     """
+    lazy = _async.maybe_lazy("alltoall", x, None, comm, token)
+    if lazy is not None:
+        return lazy
 
     def body(comm, arrays, token):
+        from ..utils import config
+        from . import _algos, _hierarchy
+
         (xl,) = arrays
         size = comm.Get_size()
         if xl.ndim == 0 or xl.shape[0] != size:
@@ -37,7 +61,16 @@ def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
             )
         xl = consume(token, xl)
         log_op("MPI_Alltoall", comm.Get_rank(), f"sending {xl.size} items")
-        if comm.groups is not None:
+        nbytes = xl.size * xl.dtype.itemsize
+        plan = _hierarchy.hier_plan(comm) if size > 1 else None
+        algo = _algos.resolve_alltoall_algo(
+            config.collective_algo(), nbytes, hier_ok=plan is not None
+        )
+        _hierarchy.annotate_selection("alltoall", algo, nbytes, size, plan,
+                                      comm)
+        if algo == "hier":
+            res = _hierarchy.apply_hier_alltoall(xl, comm, plan)
+        elif comm.groups is not None:
             # color split (uniform): out[j] = group-member j's row
             # addressed to this rank's group-local index
             import jax.numpy as jnp

@@ -6,6 +6,7 @@ communicator-handle plumbing in _src/utils.py:80-96).
 """
 
 from .comm import Comm  # noqa: F401
+from . import moe  # noqa: F401  (expert-parallel MoE helper, docs/moe.md)
 from .mesh import (  # noqa: F401
     DEFAULT_AXIS,
     get_default_mesh,

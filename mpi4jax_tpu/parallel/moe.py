@@ -1,0 +1,237 @@
+"""Expert-parallel mixture-of-experts: capacity-bucketed alltoall
+dispatch/combine around a per-expert MLP (docs/moe.md).
+
+The workload class ROADMAP item 5a names: ``k`` ranks each own ONE
+expert, tokens are routed by a top-1 gate, and the two hottest
+collectives in the program are **alltoalls** —
+
+- **dispatch**: every rank buckets its local tokens by destination
+  expert into a ``(experts, capacity, d)`` buffer (tokens beyond the
+  capacity are dropped, the standard top-1 discipline) and one alltoall
+  ships bucket ``e`` to rank ``e``;
+- **expert compute**: each rank runs ITS expert's MLP over the
+  ``k · capacity`` tokens it received;
+- **combine**: the mirror alltoall ships every processed bucket back to
+  its source rank, where the gate probability weighs it into the output
+  (dropped tokens contribute zero).
+
+The combine is where Tutel/FasterMoE-style overlap pays: the per-expert
+compute and the combine-exchange split into
+``MPI4JAX_TPU_MOE_CAPACITY_CHUNKS`` capacity chunks, chunk ``i``'s
+combine issued via :func:`~mpi4jax_tpu.alltoall_start` while chunk
+``i+1``'s MLP runs — the exchange rides the async alltoall fast path
+(ops/_async.py), hierarchical over ICI/DCN where the topology layer
+selects it (ops/_hierarchy.py).
+
+**Determinism contract**: the gate and capacity math is pure and seeded
+(``init_moe_params``), every bucket operation is a one-hot einsum (no
+data-dependent gather ordering), and dispatch/combine are fixed
+permutations — so the 8-device layer output is BIT-IDENTICAL to the
+single-device :func:`reference_moe` fold (pinned by tests/test_moe.py),
+and the overlapped pipeline is bit-identical to the synchronous one.
+
+The gate math helpers are numpy-polymorphic (they take the array module
+as an argument), so tests/test_moe_pure.py drives the SAME functions
+through plain numpy under any installed JAX.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+__all__ = [
+    "MoEParams",
+    "capacity_for",
+    "init_moe_params",
+    "gate_tokens",
+    "dispatch_tensor",
+    "expert_mlp",
+    "moe_layer",
+    "reference_moe",
+]
+
+
+def capacity_for(tokens: int, experts: int, factor: float = 1.25) -> int:
+    """Per-expert token capacity of one rank's dispatch bucket:
+    ``ceil(tokens / experts · factor)``, at least 1 — the padded bucket
+    shape every rank shares, so the dispatch alltoall is shape-uniform
+    across ranks (rank-divergent capacities are exactly the MPX120
+    fixture under examples/broken/)."""
+    if tokens < 1 or experts < 1:
+        raise ValueError(
+            f"capacity_for needs tokens >= 1 and experts >= 1, got "
+            f"tokens={tokens}, experts={experts}"
+        )
+    if factor <= 0:
+        raise ValueError(f"capacity factor must be > 0, got {factor}")
+    return max(1, -(-int(tokens * factor) // experts))
+
+
+class MoEParams(NamedTuple):
+    """One rank's MoE parameters: the (replicated) router plus THIS
+    rank's expert MLP (expert-parallel: rank ``e`` owns expert ``e``)."""
+
+    w_gate: object   # (d, experts) — replicated router
+    w_in: object     # (d, d_ff)    — this rank's expert, layer 1
+    w_out: object    # (d_ff, d)    — this rank's expert, layer 2
+
+
+def init_moe_params(d: int, d_ff: int, experts: int, rank: int = 0,
+                    seed: int = 0):
+    """Seeded numpy parameter init (float32).  Pure and deterministic:
+    the router is identical on every rank (same seed), the expert MLP is
+    seeded per ``rank`` — so a single-device reference can rebuild every
+    expert's weights exactly (``reference_moe``)."""
+    gate_rng = np.random.default_rng(seed)
+    w_gate = gate_rng.standard_normal((d, experts)).astype(np.float32) * 0.3
+    ex_rng = np.random.default_rng(seed * 7919 + 31 + rank)
+    w_in = ex_rng.standard_normal((d, d_ff)).astype(np.float32) * 0.2
+    w_out = ex_rng.standard_normal((d_ff, d)).astype(np.float32) * 0.2
+    return MoEParams(w_gate=w_gate, w_in=w_in, w_out=w_out)
+
+
+def gate_tokens(xp, x, w_gate):
+    """Top-1 gating: returns ``(assignment, gate_prob)`` for local
+    tokens ``x`` (shape ``(tokens, d)``) — the expert index each token
+    routes to and its softmax probability.  ``xp`` is the array module
+    (``jax.numpy`` traced, ``numpy`` in the pure tests): the math is
+    identical, which is what makes the 8-device pins possible."""
+    logits = x @ w_gate
+    a = xp.argmax(logits, axis=-1)
+    z = xp.exp(logits - xp.max(logits, axis=-1, keepdims=True))
+    probs = z / xp.sum(z, axis=-1, keepdims=True)
+    gate = xp.take_along_axis(probs, a[:, None], axis=-1)[:, 0]
+    return a, gate
+
+
+def dispatch_tensor(xp, assignment, experts: int, capacity: int):
+    """The one-hot dispatch tensor ``D[t, e, c]`` = 1 iff local token
+    ``t`` is the ``c``-th token (in position order) routed to expert
+    ``e`` and ``c < capacity``.  Everything downstream is an einsum
+    against ``D`` — bucketing, un-bucketing, and the gate-weighted
+    combine — so there is no data-dependent gather order to diverge
+    across ranks or between the traced and reference paths."""
+    onehot = (assignment[:, None] ==
+              xp.arange(experts)[None, :]).astype(xp.float32)
+    pos = xp.cumsum(onehot, axis=0) * onehot - onehot  # 0-based in-bucket
+    slot = (pos[:, :, None] ==
+            xp.arange(capacity)[None, None, :]).astype(xp.float32)
+    return slot * onehot[:, :, None]
+
+
+def expert_mlp(xp, z, w_in, w_out):
+    """One expert's feed-forward over a token block: ``tanh`` MLP —
+    smooth, bounded, and bit-reproducible across the traced and numpy
+    reference paths (no erf/gelu implementation divergence)."""
+    return xp.tanh(z @ w_in) @ w_out
+
+
+def moe_layer(x, params: MoEParams, *, comm=None, token=None,
+              capacity_factor: float = 1.25,
+              chunks: Optional[int] = None):
+    """The expert-parallel MoE layer (rank-local view, inside a managed
+    parallel region): gate → capacity-bucketed dispatch alltoall →
+    per-expert MLP → combine alltoall → gate-weighted output.
+
+    ``chunks`` (default ``MPI4JAX_TPU_MOE_CAPACITY_CHUNKS``) pipelines
+    the combine: the received buckets split into capacity chunks, chunk
+    ``i``'s combine-exchange is issued with ``alltoall_start`` and
+    chunk ``i+1``'s expert MLP runs in the gap; the waits land after
+    the last chunk's compute.  ``chunks=1`` is the synchronous layer —
+    bit-identical output either way (pinned by tests/test_moe.py).
+
+    Returns ``(y, token)`` with ``y`` shaped like ``x``; dropped tokens
+    (beyond an expert's capacity) produce zero rows, the standard top-1
+    capacity discipline.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import _async
+    from ..ops.alltoall import alltoall
+    from ..parallel.region import resolve_comm
+    from ..utils import config
+
+    comm = resolve_comm(comm)
+    k = comm.Get_size()
+    tokens, d = x.shape
+    capacity = capacity_for(tokens, k, capacity_factor)
+    if chunks is None:
+        chunks = config.moe_capacity_chunks()
+    chunks = max(1, min(int(chunks), capacity))
+
+    a, gate = gate_tokens(jnp, x, jnp.asarray(params.w_gate))
+    D = dispatch_tensor(jnp, a, k, capacity)           # (tokens, k, cap)
+    dispatch = jnp.einsum("tec,td->ecd", D, x)         # (k, cap, d)
+    received, tok = alltoall(dispatch, comm=comm, token=token)
+    # received[g, c] = rank g's c-th token for MY expert
+
+    w_in = jnp.asarray(params.w_in)
+    w_out = jnp.asarray(params.w_out)
+    sizes = _async.overlap_chunk_split(capacity, chunks)
+    if len(sizes) == 1:
+        # synchronous: one MLP, one combine exchange
+        processed = expert_mlp(jnp, received, w_in, w_out)
+        combined, tok = alltoall(processed, comm=comm, token=tok)
+    else:
+        # the overlap pipeline: chunk i's combine-alltoall is in flight
+        # while chunk i+1's expert MLP runs (docs/moe.md)
+        handles = []
+        off = 0
+        for csz in sizes:
+            block = received[:, off:off + csz]
+            off += csz
+            out = expert_mlp(jnp, block, w_in, w_out)
+            h, tok = _async.alltoall_start(out, comm=comm, token=tok)
+            handles.append(h)
+        parts = []
+        for h in handles:
+            part, tok = _async.alltoall_wait(h, token=tok)
+            parts.append(part)
+        combined = jnp.concatenate(parts, axis=1)
+    # combined[e, c] = my c-th token as processed by expert e
+    y = jnp.einsum("tec,ecd->td", D, combined) * gate[:, None]
+    return y, tok
+
+
+def reference_moe(x_global, d_ff: int, experts: int, *, seed: int = 0,
+                  capacity_factor: float = 1.25):
+    """Single-device numpy reference of the whole expert-parallel layer:
+    ``x_global`` is ``(ranks, tokens, d)`` (rank-major, the eager global
+    convention) and the return is the matching global output — the
+    8-device dryrun pin (tests/test_moe.py compares bit-for-bit).
+
+    Rebuilds every expert's weights from the same seeded init the ranks
+    use, replays the same capacity discipline, and never simulates the
+    wire: dispatch/combine are fixed permutations, so equality with the
+    distributed layer is exact.
+    """
+    k, tokens, d = x_global.shape
+    assert k == experts, (k, experts)
+    capacity = capacity_for(tokens, experts, capacity_factor)
+    params = [init_moe_params(d, d_ff, experts, rank=r, seed=seed)
+              for r in range(k)]
+    # per-rank gating + dispatch buckets
+    disp = np.zeros((k, experts, capacity, d), np.float32)
+    Ds = []
+    gates = []
+    for r in range(k):
+        a, gate = gate_tokens(np, x_global[r], params[r].w_gate)
+        D = dispatch_tensor(np, a, experts, capacity)
+        Ds.append(D)
+        gates.append(gate)
+        disp[r] = np.einsum("tec,td->ecd", D, x_global[r])
+    # alltoall: expert e receives bucket e of every rank
+    received = np.stack([disp[:, e] for e in range(experts)])  # (e, k, c, d)
+    processed = np.stack([
+        expert_mlp(np, received[e], params[e].w_in, params[e].w_out)
+        for e in range(experts)
+    ])
+    # combine alltoall back: rank r's view of expert e's output bucket
+    out = np.zeros_like(x_global)
+    for r in range(k):
+        combined = processed[:, r]  # (e, c, d): my tokens at each expert
+        out[r] = np.einsum("tec,ecd->td", Ds[r],
+                           combined) * gates[r][:, None]
+    return out

@@ -109,6 +109,22 @@ DEFAULT_RING_CROSSOVER_BYTES = 1 << 20
 # ``benchmarks/micro.py --hierarchy-sweep`` (docs/topology.md).
 DEFAULT_DCN_CROSSOVER_BYTES = 4 << 20
 
+# default crossover for the two-level hierarchical alltoall
+# (ops/_hierarchy.apply_hier_alltoall): 1 MiB — below it the single
+# monolithic AllToAll HLO's latency wins; above it the hierarchical
+# split's intra-host aggregation pays for itself by cutting the DCN
+# message count to 1/r of flat (r·h·(h−1) contiguous host-aggregated
+# messages instead of r²·h·(h−1) per-rank ones — docs/moe.md).
+# Measured per pod by ``benchmarks/micro.py --alltoall-sweep``.
+DEFAULT_ALLTOALL_CROSSOVER_BYTES = 1 << 20
+
+# default capacity-chunk count of the expert-parallel MoE helper
+# (parallel/moe.py): the per-expert compute and the combine-alltoall
+# split into this many capacity chunks so chunk i's combine exchange
+# (issued via alltoall_start) overlaps chunk i+1's expert MLP — the
+# same double-buffering default as MPI4JAX_TPU_OVERLAP_CHUNKS.
+DEFAULT_MOE_CAPACITY_CHUNKS = 2
+
 FLAGS = {
     f.name: f
     for f in (
@@ -218,6 +234,23 @@ FLAGS = {
              "butterfly to the bandwidth-optimal ring.  Default 4 MiB "
              "(DCN rounds cost ~10x an ICI hop, so the ring needs a "
              "larger payload to win than on ICI)."),
+        Flag("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "int",
+             DEFAULT_ALLTOALL_CROSSOVER_BYTES,
+             "Payload size (bytes) at which a multi-host alltoall "
+             "switches from the flat single-exchange lowering to the "
+             "two-level hierarchical one (ops/_hierarchy.py: intra-host "
+             "transpose over ICI, inter-host exchange of host-aggregated "
+             "contiguous blocks over DCN — 1/r the DCN message count of "
+             "flat).  Default 1 MiB; bit-identical results either way "
+             "(docs/moe.md)."),
+        Flag("MPI4JAX_TPU_MOE_CAPACITY_CHUNKS", "int",
+             DEFAULT_MOE_CAPACITY_CHUNKS,
+             "Capacity-chunk count of the expert-parallel MoE helper "
+             "(parallel/moe.py): expert compute and the combine-alltoall "
+             "split into this many chunks so chunk i's combine exchange "
+             "(alltoall_start) overlaps chunk i+1's expert MLP.  1 "
+             "disables the overlap pipeline (one synchronous combine).  "
+             "Default 2 (docs/moe.md)."),
         Flag("MPI4JAX_TPU_ANALYZE", "choice", "off",
              "Trace-time collective verifier (analysis/): ``warn`` runs "
              "the MPX checkers over every spmd region / eager op as it "
@@ -534,12 +567,14 @@ def tuning_snapshot() -> Optional[dict]:
     defaults = {
         "ring_crossover_bytes": DEFAULT_RING_CROSSOVER_BYTES,
         "dcn_crossover_bytes": DEFAULT_DCN_CROSSOVER_BYTES,
+        "alltoall_crossover_bytes": DEFAULT_ALLTOALL_CROSSOVER_BYTES,
         "fusion_bucket_bytes": DEFAULT_FUSION_BUCKET_BYTES,
         "overlap_chunks": DEFAULT_OVERLAP_CHUNKS,
     }
     getters = {
         "ring_crossover_bytes": ring_crossover_bytes,
         "dcn_crossover_bytes": dcn_crossover_bytes,
+        "alltoall_crossover_bytes": alltoall_crossover_bytes,
         "fusion_bucket_bytes": fusion_bucket_bytes,
         "overlap_chunks": overlap_chunks,
     }
@@ -798,6 +833,28 @@ def dcn_crossover_bytes() -> int:
     return _env_or_tuned(
         "MPI4JAX_TPU_DCN_CROSSOVER_BYTES", "dcn_crossover_bytes",
         DEFAULT_DCN_CROSSOVER_BYTES,
+    )
+
+
+def alltoall_crossover_bytes() -> int:
+    """Payload bytes at which a multi-host alltoall prefers the
+    two-level hierarchical lowering
+    (``MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES``; default 1 MiB — see
+    docs/moe.md; a tuning layer's measured value applies when the flag
+    is not explicitly set)."""
+    return _env_or_tuned(
+        "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "alltoall_crossover_bytes",
+        DEFAULT_ALLTOALL_CROSSOVER_BYTES,
+    )
+
+
+def moe_capacity_chunks() -> int:
+    """Capacity-chunk count of the MoE combine/compute pipeline
+    (``MPI4JAX_TPU_MOE_CAPACITY_CHUNKS``; default 2, minimum 1 — see
+    parallel/moe.py and docs/moe.md)."""
+    return _parse_env_positive_int(
+        "MPI4JAX_TPU_MOE_CAPACITY_CHUNKS", DEFAULT_MOE_CAPACITY_CHUNKS,
+        minimum=1,
     )
 
 

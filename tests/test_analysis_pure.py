@@ -432,6 +432,77 @@ def test_config_snapshot_records_fusion():
     snap = hook.config_snapshot()
     assert snap["fusion"] in config.FUSION_MODES
     assert snap["fusion_bucket_bytes"] == config.fusion_bucket_bytes()
+    assert snap["alltoall_crossover_bytes"] == \
+        config.alltoall_crossover_bytes()
+
+
+# ---------------------------------------------------------------------------
+# MPX137 — flat alltoall on a multi-host comm (the MPX113 analog)
+# ---------------------------------------------------------------------------
+
+
+def _a2a_meta(crossover=1024):
+    return {"alltoall_crossover_bytes": crossover}
+
+
+def test_mpx137_flat_multihost_alltoall_fires():
+    g = G(events=[E(0, "alltoall", comm_uid=1, comm_size=8, hosts=2,
+                    payload_bytes=4096, algo="native")],
+          meta=_a2a_meta())
+    found = [f for f in checkers.run_checkers(g) if f.code == "MPX137"]
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "advisory"
+    assert "2 hosts" in f.message and "4x the DCN message count" in f.message
+    assert "hier" in f.suggestion
+
+
+def test_mpx137_async_start_counts_like_the_blocking_op():
+    g = G(events=[E(0, "alltoall_start", comm_uid=1, comm_size=8, hosts=2,
+                    payload_bytes=4096, algo="pairwise", span=7)],
+          meta=_a2a_meta())
+    assert "MPX137" in [f.code for f in checkers.run_checkers(g)]
+
+
+def test_mpx137_cites_measured_crossover():
+    # a calibrated file's measured value replaces the static one as the
+    # threshold AND in the text (the MPX113 contract, mirrored)
+    meta = {"alltoall_crossover_bytes": 1 << 20,
+            "measured_alltoall_crossover_bytes": 1024,
+            "tuned_stamp": "abc123def456"}
+    g = G(events=[E(0, "alltoall", comm_uid=1, comm_size=8, hosts=2,
+                    payload_bytes=4096, algo="native")], meta=meta)
+    (f,) = [x for x in checkers.run_checkers(g) if x.code == "MPX137"]
+    assert "measured alltoall crossover" in f.message
+    assert "tuned@abc123def456" in f.message
+    assert "1024 B" in f.message
+
+
+def test_mpx137_negatives():
+    # hier selected: nothing to advise
+    g = G(events=[E(0, "alltoall", comm_uid=1, comm_size=8, hosts=2,
+                    payload_bytes=4096, algo="hier", hier=(2, 4))],
+          meta=_a2a_meta())
+    assert "MPX137" not in codes_of(g)
+    # below the crossover: the flat exchange is the right call
+    g = G(events=[E(0, "alltoall", comm_uid=1, comm_size=8, hosts=2,
+                    payload_bytes=512, algo="native")],
+          meta=_a2a_meta())
+    assert "MPX137" not in codes_of(g)
+    # no hosts annotation (no plan was derivable): flat is the only option
+    g = G(events=[E(0, "alltoall", comm_uid=1, comm_size=8,
+                    payload_bytes=4096, algo="native")],
+          meta=_a2a_meta())
+    assert "MPX137" not in codes_of(g)
+    # one rank per host: the hierarchy degenerates — nothing to advise
+    g = G(events=[E(0, "alltoall", comm_uid=1, comm_size=2, hosts=2,
+                    payload_bytes=4096, algo="native")],
+          meta=_a2a_meta())
+    assert "MPX137" not in codes_of(g)
+    # hand-built graph without the crossover meta: testing other rules
+    g = G(events=[E(0, "alltoall", comm_uid=1, comm_size=8, hosts=2,
+                    payload_bytes=4096, algo="native")])
+    assert "MPX137" not in codes_of(g)
 
 
 # ---------------------------------------------------------------------------

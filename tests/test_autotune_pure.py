@@ -277,6 +277,46 @@ def test_layer_serves_every_knob(monkeypatch):
     assert config.overlap_chunks(100) == 8  # env wins over buckets too
 
 
+def test_layer_precedence_alltoall_crossover(monkeypatch):
+    # the PR-15 knob rides the same default < tuning < env precedence —
+    # and its arrival needed NO schema bump (the content stamp retraces
+    # new files, old files simply leave it untuned)
+    assert config.alltoall_crossover_bytes() == \
+        config.DEFAULT_ALLTOALL_CROSSOVER_BYTES
+    config.load_tuning(_payload(tuned={"alltoall_crossover_bytes": 2048}))
+    assert config.alltoall_crossover_bytes() == 2048
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "555")
+    assert config.alltoall_crossover_bytes() == 555  # env wins
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "")
+    assert config.alltoall_crossover_bytes() == 2048  # empty = unset
+    config.load_tuning(None)
+    assert config.alltoall_crossover_bytes() == \
+        config.DEFAULT_ALLTOALL_CROSSOVER_BYTES
+    # old files (no alltoall key) validate unchanged and leave the
+    # knob at its default
+    config.load_tuning(_payload())
+    assert config.alltoall_crossover_bytes() == \
+        config.DEFAULT_ALLTOALL_CROSSOVER_BYTES
+
+
+def test_alltoall_crossover_topology_override_and_token(monkeypatch):
+    tf = config.load_tuning(_payload(
+        tuned={"alltoall_crossover_bytes": 2048},
+        topologies={"2x4": {"alltoall_crossover_bytes": 4096}},
+    ))
+    assert config.alltoall_crossover_bytes() == 2048
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", "2x4")
+    assert config.alltoall_crossover_bytes() == 4096
+    # the cache-token fold: the stamp rides algo_cache_token, and the
+    # raw knob itself is in the base tuple — either move retraces
+    tok = algos.algo_cache_token()
+    assert tok[-1] == ("tuning", tf.stamp)
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "999")
+    assert algos.algo_cache_token() != tok
+    monkeypatch.delenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES")
+    config.load_tuning(None)
+
+
 def test_layer_topology_scope(monkeypatch):
     config.load_tuning(_payload())
     assert config.ring_crossover_bytes() == 4096
@@ -288,7 +328,10 @@ def test_layer_topology_scope(monkeypatch):
 
 def test_cache_token_folds_the_stamp():
     tok0 = algos.algo_cache_token()
-    assert len(tok0) == 4  # no layer: exactly the pre-tuning token
+    # no layer: exactly the pre-tuning token (5 knobs since the
+    # alltoall crossover joined in PR 15 — algo, ring, dcn, topology,
+    # alltoall), no trailing stamp entry
+    assert len(tok0) == 5
     tf = config.load_tuning(_payload())
     tok1 = algos.algo_cache_token()
     assert tok1[-1] == ("tuning", tf.stamp)
@@ -680,6 +723,13 @@ def _scripted_micro():
                  "hier_speedup": None}
                 for t in topologies for mb in sizes_mb]
 
+    def bench_alltoall(comm, sizes_mb, topologies, iters):
+        # the two-level exchange wins at >= 0.5 MB
+        return [{"size_mb": mb, "topology": t or "derived",
+                 "flat_us": 20.0 * mb, "hier_us": 6.0 + 8.0 * mb,
+                 "async_us": 18.0 * mb, "hier_speedup": None}
+                for t in topologies for mb in sizes_mb]
+
     def bench_fusion(comm, counts, size_kb, iters):
         # 1 MiB bucket is the scripted sweet spot
         cap = int(os.environ["MPI4JAX_TPU_FUSION_BUCKET_BYTES"])
@@ -713,8 +763,8 @@ def _scripted_micro():
         return None
 
     for fn in (bench_sendrecv_ring, bench_allreduce_algos,
-               bench_hierarchy, bench_fusion, bench_overlap,
-               fit_alpha_beta, measured_ring_crossover):
+               bench_hierarchy, bench_alltoall, bench_fusion,
+               bench_overlap, fit_alpha_beta, measured_ring_crossover):
         setattr(mod, fn.__name__, fn)
     return mod
 
@@ -745,6 +795,16 @@ def test_autotune_pipeline_on_scripted_sweeps(tmp_path, monkeypatch):
     assert payload["tuned"]["commit"]["pack_gb_per_s"] > 0
     # per-topology override from the scripted hier sweep
     assert payload["topologies"]["2x4"]["ring_crossover_bytes"] > 0
+    # the PR-15 knob: fitted from the scripted flat-vs-hier alltoall
+    # sweep (hier wins at >= 0.5 MB), per-topology AND flat-seeded,
+    # with the fit source recorded in provenance
+    a2a = payload["topologies"]["2x4"]["alltoall_crossover_bytes"]
+    assert 0 < a2a <= int(5e5)
+    assert payload["tuned"]["alltoall_crossover_bytes"] == a2a
+    assert payload["measured"]["alltoall_crossover_bytes"] == a2a
+    assert payload["provenance"]["fit_sources"][
+        "alltoall_crossover_bytes"] == "sweep @ 2x4"
+    assert config.alltoall_crossover_bytes() == a2a  # layer serves it
     # provenance self-description
     prov = payload["provenance"]
     assert prov["n_devices"] == 8 and prov["budget_s"] == 30.0

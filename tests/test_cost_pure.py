@@ -144,6 +144,66 @@ def test_dcn_algo_rule_matches_algos():
                     algos.resolve_dcn_algo(shard, h, ring_ok)
 
 
+def test_hier_alltoall_formula_rows():
+    # the two-level alltoall: intra transpose (r-1 rounds of size/r
+    # blocks over ICI) + inter exchange of host-aggregated blocks (h-1
+    # rounds of size/h over DCN) — bytes reused from the pinned byte
+    # model so cost and lowering can never drift
+    for h, r in ((2, 4), (4, 2), (8, 1)):
+        k = h * r
+        c = cm.collective_cost("alltoall", "hier", N, k, hosts=h,
+                               hier=(h, r))
+        intra_b, inter_b = hierarchy.hier_link_bytes("alltoall", N, h, r)
+        assert (c.ici.rounds, c.ici.nbytes) == \
+            (r - 1 if r > 1 else 0, intra_b)
+        assert (c.dcn.rounds, c.dcn.nbytes) == (h - 1, inter_b)
+        assert c.gamma_bytes == 0  # a permutation folds nothing
+    # flat multi-host: every round gated on DCN (the MPX137 shape)
+    c = cm.collective_cost("alltoall", "native", N, K, hosts=2)
+    assert (c.dcn.rounds, c.dcn.nbytes) == (7, 7 * CHUNK)
+    assert not c.ici
+    # the 2x4 time comparison the replay artifact commits: fewer DCN
+    # rounds AND fewer DCN bytes make hier strictly faster here
+    flat = cm.collective_cost("alltoall", "native", N, 8, hosts=2)
+    hier = cm.collective_cost("alltoall", "hier", N, 8, hosts=2,
+                              hier=(2, 4))
+    assert t_us(hier) < t_us(flat)
+
+
+def test_chunked_async_formula_rows():
+    # the C-chunk async split: bytes invariant, C-1 extra pipeline-fill
+    # rounds per active link; C=1 is the identity
+    base = cm.collective_cost("alltoall", "hier", N, 8, hosts=2,
+                              hier=(2, 4))
+    assert cm.chunked_async_cost(base, 1) is base
+    split = cm.chunked_async_cost(base, 4)
+    assert split.ici.nbytes == base.ici.nbytes
+    assert split.dcn.nbytes == base.dcn.nbytes
+    assert split.ici.rounds == base.ici.rounds + 3
+    assert split.dcn.rounds == base.dcn.rounds + 3
+    # inactive links stay inactive (no phantom fill rounds)
+    p2p = cm.p2p_cost(N, same_host=True)
+    split = cm.chunked_async_cost(p2p, 2)
+    assert not split.dcn and split.ici.rounds == 2
+    # the fill is pure alpha: the time delta is exactly (C-1) rounds
+    assert t_us(cm.chunked_async_cost(base, 4)) == pytest.approx(
+        t_us(base) + 3 * (MODEL.params["links"]["ici"]["alpha_us"]
+                          + MODEL.params["links"]["dcn"]["alpha_us"]))
+
+
+def test_best_algo_alltoall_candidates():
+    model = cm.CostModel()
+    # multi-host, hier expressible: the model prefers the two-level
+    # split once the payload is DCN-round-bound
+    best, times = cm.best_algo("alltoall", 1 << 20, 8, model, hosts=2,
+                               hier=(2, 4))
+    assert set(times) == {"native", "hier"}
+    assert best == "hier"
+    # no hierarchy: flat is the only candidate
+    best, times = cm.best_algo("alltoall", 1 << 20, 8, model)
+    assert set(times) == {"native"} and best == "native"
+
+
 def test_remaining_collectives():
     c = cm.collective_cost("allgather", None, N, K)
     assert (c.ici.rounds, c.ici.nbytes) == (7, 7 * N)
@@ -598,6 +658,42 @@ def test_mpx135_serialized_chain_positive_negative():
     }
     _, findings = run(schedules)
     assert not [x for x in findings if x.code == "MPX135"]
+
+
+def test_moe_fixture_mpx133_and_mpx131():
+    # the seeded naive-MoE shape: dispatch alltoall -> expert compute ->
+    # combine alltoall, both exchanges run FLAT on a 2x4 multi-host comm
+    # at a payload where the model prefers the two-level split, with
+    # enough adjacent compute to hide most of the wire.  The critic must
+    # flag BOTH levers this PR builds: the algorithm mispick (MPX133 ->
+    # hier) and the overlap opportunity (MPX131 -> alltoall_start).
+    ranks = 8
+    schedules = {
+        r: [coll(r, 0, op="alltoall", seq=0, parts=tuple(range(ranks)),
+                 nbytes=1 << 20, algo="native", hosts=2),
+            coll(r, 1, op="alltoall", seq=1, parts=tuple(range(ranks)),
+                 nbytes=1 << 20, algo="native", hosts=2)]
+        for r in range(ranks)
+    }
+    closed = {r: FakeJaxpr([FakeEqn([FakeVar((1 << 25,))])])
+              for r in range(ranks)}
+    rep, findings = run(schedules, closed=closed)
+    assert rep is not None
+    mispicks = [x for x in findings if x.code == "MPX133"]
+    assert len(mispicks) == 1  # deduped per (op, comm, bytes, pick)
+    assert "'hier'" in mispicks[0].message
+    assert "alltoall" in mispicks[0].message
+    overlaps = [x for x in findings if x.code == "MPX131"]
+    assert len(overlaps) == 1
+    assert "alltoall_start/alltoall_wait" in overlaps[0].suggestion
+    # negative: the hier pick with no idle compute is clean on both
+    schedules = {
+        r: [coll(r, 0, op="alltoall", seq=0, parts=tuple(range(ranks)),
+                 nbytes=1 << 20, algo="hier", hosts=2, hier=(2, 4))]
+        for r in range(ranks)
+    }
+    _, findings = run(schedules)
+    assert not [x for x in findings if x.code in ("MPX131", "MPX133")]
 
 
 def test_wildcard_recv_skips_sends_consumed_by_specific_recvs():

@@ -33,7 +33,8 @@ from helpers import per_rank, ranks_arange, world
 def _clean_topology_env(monkeypatch):
     for flag in ("MPI4JAX_TPU_TOPOLOGY", "MPI4JAX_TPU_DCN_CROSSOVER_BYTES",
                  "MPI4JAX_TPU_COLLECTIVE_ALGO",
-                 "MPI4JAX_TPU_RING_CROSSOVER_BYTES"):
+                 "MPI4JAX_TPU_RING_CROSSOVER_BYTES",
+                 "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES"):
         monkeypatch.delenv(flag, raising=False)
     yield
 
@@ -386,6 +387,130 @@ def test_start_wait_pair_splits_the_two_levels(monkeypatch):
     out_rs = np.asarray(split_rs(xr))
     for r in range(size):
         assert np.allclose(out_rs[r], rs_vals[:, r].sum(axis=0)), r
+
+
+# ---------------------------------------------------------------------------
+# alltoall: the two-level exchange + its HLO/selection pins
+# ---------------------------------------------------------------------------
+
+
+def _a2a_vals(size, per=6):
+    # vals[g][d] distinct per (source, destination): any misrouting in
+    # the two-level composition flips a visible value
+    return np.arange(size * size * per, dtype=np.float32).reshape(
+        size, size, per)
+
+
+def test_hier_alltoall_matches_flat(monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    vals = _a2a_vals(size)
+    x = jnp.asarray(vals)
+    outs = {}
+    for algo in ("butterfly", "hier"):  # butterfly = forced flat
+        _forced(monkeypatch, algo)
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.alltoall(xl)
+            return mpx.varying(res)
+
+        outs[algo] = np.asarray(f(x))
+    # a fixed permutation: bit-identical across lowerings, and equal to
+    # the transposed global array
+    assert np.array_equal(outs["hier"], outs["butterfly"])
+    assert np.array_equal(outs["hier"], vals.transpose(1, 0, 2))
+
+
+def test_hier_alltoall_on_color_split_spanning_hosts(monkeypatch):
+    _, size = world()
+    if size < 4:
+        pytest.skip("needs >= 4 ranks for a 2-group split")
+    _two_hosts(monkeypatch)
+    comm, _ = world()
+    split = comm.Split([r % 2 for r in range(size)])
+    g = size // 2
+    vals = np.arange(size * g * 3, dtype=np.float32).reshape(size, g, 3)
+    x = jnp.asarray(vals)
+    outs = {}
+    for algo in ("butterfly", "hier"):
+        _forced(monkeypatch, algo)
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.alltoall(xl, comm=split)
+            return mpx.varying(res)
+
+        outs[algo] = np.asarray(f(x))
+    assert np.array_equal(outs["hier"], outs["butterfly"])
+    # group semantics: out[j] = group-member j's row for my group index
+    groups = ([r for r in range(size) if r % 2 == 0],
+              [r for r in range(size) if r % 2 == 1])
+    for members in groups:
+        for pos, r in enumerate(members):
+            for j, src in enumerate(members):
+                assert np.array_equal(outs["hier"][r][j],
+                                      vals[src][pos]), (r, j)
+
+
+def test_auto_alltoall_picks_hier_above_crossover_only(monkeypatch):
+    _two_hosts(monkeypatch)
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "1024")
+
+    def a2a(x):
+        res, _ = mpx.alltoall(x)
+        return res
+
+    _, size = world()
+    report = mpx.analyze(a2a, jnp.ones((size, size, 256), jnp.float32))
+    (evt,) = report.events
+    assert evt.algo == "hier" and evt.hosts == 2
+    assert evt.hier == (2, size // 2)
+    report = mpx.analyze(a2a, jnp.ones((size, size, 2), jnp.float32))
+    (evt,) = report.events
+    assert evt.algo == "native" and evt.hier is None
+
+
+def _lowered_a2a(x):
+    @mpx.spmd
+    def f(xl):
+        res, _ = mpx.alltoall(xl)
+        return mpx.varying(res)
+
+    return jax.jit(f).lower(x).as_text()
+
+
+def test_alltoall_hlo_byte_identical_below_crossover(monkeypatch):
+    """The zero-cost contract for the permutation family: single-host
+    comms and below-crossover payloads lower to the SAME program with
+    and without the topology/crossover knobs in play."""
+    _, size = world()
+    x = jnp.ones((size, size, 8), jnp.float32)  # 256 B: far below
+    base = _lowered_a2a(x)
+    assert "all-to-all" in base or "all_to_all" in base  # the native HLO
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"1x{size}")
+    assert _lowered_a2a(x) == base
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    assert _lowered_a2a(x) == base  # below the crossover: flat unchanged
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"{size - 3},3")
+    assert _lowered_a2a(x) == base  # non-uniform: flat is the only form
+
+
+def test_alltoall_crossover_toggle_retraces_eager_program(monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    mpx.clear_caches()
+    x = jnp.asarray(_a2a_vals(size))
+    mpx.alltoall(x)
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "1")
+    out, _ = mpx.alltoall(x)  # new crossover: must retrace (hier now)
+    assert np.array_equal(np.asarray(out),
+                          _a2a_vals(size).transpose(1, 0, 2))
+    monkeypatch.delenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES")
+    mpx.alltoall(x)  # back to the first program
+    s = mpx.cache_stats()
+    assert s["misses"] == 2 and s["hits"] == 1
+    mpx.clear_caches()
 
 
 # ---------------------------------------------------------------------------

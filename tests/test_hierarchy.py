@@ -83,6 +83,7 @@ def _clean_env():
         for k in ("MPI4JAX_TPU_COLLECTIVE_ALGO",
                   "MPI4JAX_TPU_RING_CROSSOVER_BYTES",
                   "MPI4JAX_TPU_DCN_CROSSOVER_BYTES",
+                  "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES",
                   "MPI4JAX_TPU_TOPOLOGY")
     }
     yield
@@ -670,6 +671,155 @@ def test_algo_cache_token_reflects_topology_knobs():
     assert len(tokens) == 4
     del os.environ["MPI4JAX_TPU_TOPOLOGY"]
     del os.environ["MPI4JAX_TPU_DCN_CROSSOVER_BYTES"]
+    assert al.algo_cache_token() == base
+
+
+# ---------------------------------------------------------------------------
+# alltoall: pairwise exchange + the two-level hierarchical split
+# ---------------------------------------------------------------------------
+
+
+def sim_pairwise_alltoall(blocks, k):
+    """Pure-Python lockstep of ``apply_pairwise_alltoall`` driving the
+    REAL index formulas (``rotation_pairs``/``a2a_send_block``/
+    ``a2a_recv_slot``): ``blocks[p][q]`` is position ``p``'s block
+    addressed to ``q``; returns ``out`` with ``out[q][p]`` = the block
+    ``p`` addressed to ``q`` (the alltoall contract)."""
+    out = [[None] * k for _ in range(k)]
+    for p in range(k):
+        out[p][p] = blocks[p][p]
+    groups = (tuple(range(k)),)
+    for t in range(1, k):
+        pairs = al.rotation_pairs(groups, t)
+        sent = {src: blocks[src][al.a2a_send_block(src, t, k)]
+                for src, _ in pairs}
+        for src, dst in pairs:
+            slot = al.a2a_recv_slot(dst, t, k)
+            assert slot == src, (k, t, src, dst)  # the rotation inverse
+            out[dst][slot] = sent[src]
+    return out
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 4, 8])
+def test_pairwise_alltoall_routing(k):
+    blocks = [[("B", p, q) for q in range(k)] for p in range(k)]
+    out = sim_pairwise_alltoall(blocks, k)
+    for q in range(k):
+        for p in range(k):
+            assert out[q][p] == ("B", p, q), (k, p, q)
+
+
+def sim_hier_alltoall(blocks, h, r):
+    """Lockstep of ``apply_hier_alltoall`` phase for phase: intra-host
+    transpose (pairwise over each host block) → inter-host exchange of
+    host-aggregated blocks (pairwise over each position group) → local
+    de-interleave.  ``blocks[g][g']`` = rank ``g``'s block addressed to
+    group position ``g'``; returns ``final[g][g']`` = the block ``g'``
+    addressed to ``g``."""
+    k = h * r
+    A = {}
+    for b in range(h):
+        payload = [
+            [[blocks[b * r + i][bp * r + j] for bp in range(h)]
+             for j in range(r)]
+            for i in range(r)
+        ]
+        out1 = sim_pairwise_alltoall(payload, r)
+        for j in range(r):
+            A[(b, j)] = out1[j]  # A[(b,j)][i][b'] = x_{(b,i)}[b'·r+j]
+    final = [[None] * k for _ in range(k)]
+    for j in range(r):
+        payload2 = [
+            [[A[(b, j)][i][bp] for i in range(r)] for bp in range(h)]
+            for b in range(h)
+        ]
+        out2 = sim_pairwise_alltoall(payload2, h)
+        for b in range(h):
+            for bpp in range(h):
+                for i in range(r):
+                    final[b * r + j][bpp * r + i] = out2[b][bpp][i]
+    return final
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_alltoall_bit_identical_to_flat(h, r):
+    # pure routing: the two-level composition must deliver EXACTLY the
+    # flat permutation — symbolic blocks make any misrouting visible
+    k = h * r
+    blocks = [[("B", g, d) for d in range(k)] for g in range(k)]
+    final = sim_hier_alltoall(blocks, h, r)
+    for g in range(k):
+        for src in range(k):
+            assert final[g][src] == ("B", src, g), (h, r, g, src)
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_hier_alltoall_numpy_bit_for_bit(h, r):
+    import zlib
+
+    k = h * r
+    rng = np.random.default_rng(zlib.crc32(f"a2a/{h}x{r}".encode()))
+    data = rng.standard_normal((k, k, 3)).astype(np.float32)
+    blocks = [[data[g, d] for d in range(k)] for g in range(k)]
+    final = sim_hier_alltoall(blocks, h, r)
+    for g in range(k):
+        for src in range(k):
+            assert np.array_equal(final[g][src], data[src, g]), (h, r, g)
+
+
+@pytest.mark.parametrize("h,r", TOPOLOGIES)
+def test_alltoall_byte_and_message_models(h, r):
+    n = 64 * 1024
+    k = h * r
+    intra, inter = hi.hier_link_bytes("alltoall", n, h, r)
+    # phase 1 ships (r-1) destination blocks of size/r over ICI; phase 2
+    # ships (h-1) host-aggregated blocks of size/h over DCN
+    assert intra == (r - 1) * (-(-n // r))
+    assert inter == (h - 1) * (-(-n // h))
+    flat_intra, flat_inter = hi.flat_link_bytes("alltoall", "native", n,
+                                                k, h)
+    assert (flat_intra, flat_inter) == (0, (k - 1) * (n // k))
+    # single host / unknown topology: the flat volume lands on ICI
+    assert hi.flat_link_bytes("alltoall", "native", n, k, 1) == \
+        ((k - 1) * (n // k), 0)
+    assert hi.flat_link_bytes("alltoall", "pairwise", n, k, None) == \
+        ((k - 1) * (n // k), 0)
+    # hier never ships MORE DCN bytes than the flat attribution...
+    assert inter <= flat_inter
+    # ...and the DCN message model is exactly 1/r of flat — the
+    # acceptance claim of BENCH_alltoall.json
+    msgs_flat, msgs_hier = hi.alltoall_dcn_messages(h, r)
+    assert msgs_flat == r * r * h * (h - 1)
+    assert msgs_hier * r == msgs_flat
+
+
+def test_resolve_alltoall_algo_rules():
+    cross = config.alltoall_crossover_bytes()
+    assert cross == config.DEFAULT_ALLTOALL_CROSSOVER_BYTES
+    # auto: hier only when expressible AND at/above the crossover
+    assert al.resolve_alltoall_algo("auto", cross, True) == "hier"
+    assert al.resolve_alltoall_algo("auto", cross - 1, True) == "native"
+    assert al.resolve_alltoall_algo("auto", cross, False) == "native"
+    # forced hier wins where expressible, falls back flat otherwise
+    assert al.resolve_alltoall_algo("hier", 1, True) == "hier"
+    assert al.resolve_alltoall_algo("hier", 1, False) == "native"
+    # forced flat algorithms keep the flat exchange (MPX137's trigger)
+    assert al.resolve_alltoall_algo("butterfly", cross, True) == "native"
+    assert al.resolve_alltoall_algo("ring", cross, True) == "native"
+    # the async split's flat form is the pairwise exchange
+    assert al.resolve_alltoall_algo("auto", 1, True,
+                                    flat="pairwise") == "pairwise"
+    os.environ["MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES"] = "256"
+    assert al.resolve_alltoall_algo("auto", 256, True) == "hier"
+    assert al.resolve_alltoall_algo("auto", 255, True) == "native"
+
+
+def test_algo_cache_token_reflects_alltoall_crossover():
+    base = al.algo_cache_token()
+    os.environ["MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES"] = "123"
+    tok = al.algo_cache_token()
+    assert tok != base
+    del os.environ["MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES"]
     assert al.algo_cache_token() == base
 
 

@@ -243,20 +243,20 @@ def test_docs_list_every_registered_flag():
     """Docs-sync: each declared flag must appear in the docs flag tables
     (docs/usage.md, docs/resilience.md, docs/observability.md,
     docs/overlap.md, docs/topology.md, docs/aot.md, docs/autotune.md,
-    or docs/serving.md) — a flag without documentation is
+    docs/serving.md, or docs/moe.md) — a flag without documentation is
     indistinguishable from an undocumented sharp bit."""
     config = _load_config()
     docs = "\n".join(
         (REPO / "docs" / f).read_text()
         for f in ("usage.md", "resilience.md", "observability.md",
                   "overlap.md", "topology.md", "aot.md", "autotune.md",
-                  "serving.md")
+                  "serving.md", "moe.md")
     )
     missing = [name for name in config.FLAGS if name not in docs]
     assert not missing, (
         "flags declared in utils/config.py but absent from the docs flag "
         "tables (docs/usage.md / docs/resilience.md / "
         "docs/observability.md / docs/overlap.md / docs/topology.md / "
-        "docs/aot.md / docs/autotune.md / docs/serving.md): "
-        + ", ".join(missing)
+        "docs/aot.md / docs/autotune.md / docs/serving.md / "
+        "docs/moe.md): " + ", ".join(missing)
     )

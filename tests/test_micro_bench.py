@@ -110,6 +110,59 @@ def test_bench_hierarchy_schema():
     assert (r["hier_speedup"] is None) == (comm.Get_size() == 1)
 
 
+def test_bench_alltoall_schema():
+    # compiles all three alltoall execution shapes — flat single
+    # exchange, the forced two-level lowering, and the chunked async
+    # start/wait split — under a faked 2x4 host topology at a tiny
+    # size, and checks the modeled DCN byte/message columns ride every
+    # uniform-topology row (docs/moe.md); a non-covering spec is
+    # skipped, not an error
+    comm = _world_comm()
+    saved = {k: os.environ.get(k) for k in
+             ("MPI4JAX_TPU_TOPOLOGY", "MPI4JAX_TPU_COLLECTIVE_ALGO",
+              "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES")}
+    rows = micro.bench_alltoall(comm, sizes_mb=[0.0001],
+                                topologies=("2x4", "3x9"), iters=2)
+    for k, v in saved.items():
+        assert os.environ.get(k) == v, k  # restored
+    assert len(rows) == 1  # 3x9 covers 27 ranks, not this mesh: skipped
+    r = rows[0]
+    assert r["topology"] == "2x4"
+    assert r["flat_us"] > 0 and r["hier_us"] > 0 and r["async_us"] > 0
+    assert (r["hier_speedup"] is None) == (comm.Get_size() == 1)
+    # the modeled DCN columns: the 1/r message aggregation is stamped
+    # into every saved row (the acceptance artifact's claim)
+    assert r["dcn_msgs_flat"] == r["dcn_msgs_hier"] * r["dcn_msg_reduction"]
+    assert r["dcn_msg_reduction"] == 4  # 2x4: r = 4
+    assert r["dcn_bytes_hier"] <= r["dcn_bytes_flat"]
+
+
+def test_alltoall_replay_artifact_current(tmp_path):
+    # the committed cost-model replay (BENCH_alltoall.json) must be
+    # reproducible from its embedded recipe and carry the acceptance
+    # invariants: 1/r DCN message reduction on every row, overlapped
+    # MoE step beating the synchronous one
+    import json
+    import pathlib
+    import subprocess
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    committed = json.loads((repo / "BENCH_alltoall.json").read_text())
+    assert committed["schema"] == "mpx-alltoall-replay/1"
+    for row in committed["sweep"]:
+        assert row["dcn_msgs_flat"] == \
+            row["dcn_msgs_hier"] * row["dcn_msg_reduction"], row
+    for row in committed["moe_step"]:
+        assert row["overlap_speedup"] > 1.0, row
+    out = tmp_path / "replay.json"
+    subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "alltoall_replay.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert json.loads(out.read_text()) == committed
+
+
 def test_bench_dispatch_schema():
     # compiles all three execution surfaces — eager one-op, spmd, and
     # the mpx.compile-pinned artifact — for the same allreduce at a tiny

@@ -1,0 +1,209 @@
+"""Expert-parallel MoE layer (parallel/moe.py): the traced half.
+
+Needs a real ``mpi4jax_tpu`` import (jax>=0.6) and the 8-device mesh:
+
+- the 8-device dryrun pin: the distributed layer against the pure
+  single-device ``reference_moe`` fold;
+- overlap == synchronous bit-identity (the async combine split is pure
+  routing) and gradient parity through the differentiable layer;
+- MPX137 positive/negative through ``mpx.analyze`` AND the ambient
+  ``MPI4JAX_TPU_ANALYZE=error`` path;
+- the rank-divergent capacity shape flagged MPX120 by the cross-rank
+  pass (the examples/broken/ fixture's in-suite twin).
+
+The pure gate/capacity math half lives in tests/test_moe_pure.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.parallel import moe
+from helpers import world
+
+TOKENS = 16
+D = 8
+D_FF = 12
+SEED = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for flag in ("MPI4JAX_TPU_TOPOLOGY", "MPI4JAX_TPU_COLLECTIVE_ALGO",
+                 "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES",
+                 "MPI4JAX_TPU_MOE_CAPACITY_CHUNKS",
+                 "MPI4JAX_TPU_OVERLAP_CHUNKS"):
+        monkeypatch.delenv(flag, raising=False)
+    yield
+    mpx.set_analyze_mode(None)
+    mpx.clear_caches()
+
+
+def _inputs(size):
+    rng = np.random.default_rng(SEED)
+    x = rng.standard_normal((size, TOKENS, D)).astype(np.float32)
+    params = [moe.init_moe_params(D, D_FF, size, rank=r, seed=SEED)
+              for r in range(size)]
+    w_gate = jnp.asarray(np.stack([p.w_gate for p in params]))
+    w_in = jnp.asarray(np.stack([p.w_in for p in params]))
+    w_out = jnp.asarray(np.stack([p.w_out for p in params]))
+    return jnp.asarray(x), w_gate, w_in, w_out
+
+
+def _fwd(comm, chunks):
+    @mpx.spmd(comm=comm)
+    def prog(xv, wg, wi, wo):
+        y, _ = moe.moe_layer(xv, moe.MoEParams(wg, wi, wo), comm=comm,
+                             chunks=chunks)
+        return mpx.varying(y)
+
+    return prog
+
+
+def test_moe_layer_pinned_against_single_device_reference():
+    comm, size = world()
+    x, wg, wi, wo = _inputs(size)
+    got = np.asarray(_fwd(comm, 1)(x, wg, wi, wo))
+    want = moe.reference_moe(np.asarray(x), D_FF, size, seed=SEED)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("chunks", [2, 3])
+def test_overlapped_combine_bit_identical_to_sync(chunks):
+    comm, size = world()
+    x, wg, wi, wo = _inputs(size)
+    sync = np.asarray(_fwd(comm, 1)(x, wg, wi, wo))
+    ovl = np.asarray(_fwd(comm, chunks)(x, wg, wi, wo))
+    np.testing.assert_array_equal(sync, ovl)
+
+
+def test_moe_capacity_chunks_env_default(monkeypatch):
+    comm, size = world()
+    x, wg, wi, wo = _inputs(size)
+    sync = np.asarray(_fwd(comm, 1)(x, wg, wi, wo))
+    monkeypatch.setenv("MPI4JAX_TPU_MOE_CAPACITY_CHUNKS", "2")
+    got = np.asarray(_fwd(comm, None)(x, wg, wi, wo))
+    np.testing.assert_array_equal(sync, got)
+
+
+def test_gradients_match_between_sync_and_overlap():
+    comm, size = world()
+    x, wg, wi, wo = _inputs(size)
+
+    def grads(chunks):
+        @mpx.spmd(comm=comm)
+        def prog(xv, wg_, wi_, wo_):
+            def loss(wi__):
+                y, _ = moe.moe_layer(
+                    xv, moe.MoEParams(wg_, wi__, wo_), comm=comm,
+                    chunks=chunks)
+                return jnp.sum(y * y)
+
+            return mpx.varying(jax.grad(loss)(wi_))
+
+        return np.asarray(prog(x, wg, wi, wo))
+
+    np.testing.assert_allclose(grads(1), grads(2), rtol=1e-5, atol=1e-6)
+
+
+def test_moe_layer_under_faked_two_host_topology(monkeypatch):
+    comm, size = world()
+    if size % 2:
+        pytest.skip("needs an even mesh for the 2-host fake")
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "1")
+    x, wg, wi, wo = _inputs(size)
+    got = np.asarray(_fwd(comm, 2)(x, wg, wi, wo))  # hier + overlap
+    want = moe.reference_moe(np.asarray(x), D_FF, size, seed=SEED)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# MPX137 — traced positive/negative through analyze and env=error
+# ---------------------------------------------------------------------------
+
+
+def _a2a(x):
+    res, _ = mpx.alltoall(x)
+    return res
+
+
+def test_mpx137_traced_positive_and_negative(monkeypatch):
+    comm, size = world()
+    if size % 2:
+        pytest.skip("needs an even mesh for the 2-host fake")
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "1024")
+    x = jnp.ones((size, size, 256), jnp.float32)  # 8 KiB: above
+    # positive: a forced flat algorithm keeps the single-level exchange
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "butterfly")
+    report = mpx.analyze(_a2a, x, comm=comm)
+    found = [f for f in report.findings if f.code == "MPX137"]
+    assert len(found) == 1
+    assert found[0].severity == "advisory"
+    assert "DCN message count" in found[0].message
+    # negative: auto picks the hierarchy — nothing to advise
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "auto")
+    report = mpx.analyze(_a2a, x, comm=comm)
+    assert not [f for f in report.findings if f.code == "MPX137"]
+    # negative: below the crossover the flat exchange is the right call
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "butterfly")
+    report = mpx.analyze(_a2a, jnp.ones((size, size, 2), jnp.float32),
+                         comm=comm)
+    assert not [f for f in report.findings if f.code == "MPX137"]
+
+
+def test_mpx137_fires_through_env_error_mode(monkeypatch):
+    comm, size = world()
+    if size % 2:
+        pytest.skip("needs an even mesh for the 2-host fake")
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "1024")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "butterfly")
+    x = jnp.ones((size, size, 256), jnp.float32)
+    mpx.set_analyze_mode("error")
+    try:
+        with pytest.raises(mpx.AnalysisError) as exc:
+            mpx.run(_a2a, x, comm=comm)
+        assert any(f.code == "MPX137" for f in exc.value.findings)
+    finally:
+        mpx.set_analyze_mode(None)
+        mpx.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# MPX120 — the rank-divergent capacity shape (the broken fixture's twin)
+# ---------------------------------------------------------------------------
+
+
+def test_rank_divergent_capacity_flags_mpx120():
+    comm, size = world()
+    if size < 2:
+        pytest.skip("needs >= 2 ranks to diverge")
+    cap = 4
+
+    def combine(buckets):
+        r = comm.Get_rank()
+
+        def even_path(b):
+            lo, _ = mpx.alltoall(b[:, :cap // 2], comm=comm)
+            hi, _ = mpx.alltoall(b[:, cap // 2:], comm=comm)
+            return jnp.concatenate([lo, hi], axis=1)
+
+        def odd_path(b):
+            out, _ = mpx.alltoall(b, comm=comm)
+            return out
+
+        combined = lax.cond(r % 2 == 0, even_path, odd_path, buckets)
+        load, _ = mpx.allreduce(jnp.sum(combined), op=mpx.SUM, comm=comm)
+        return combined, load
+
+    x = jnp.stack([jnp.full((size, cap, 3), float(r))
+                   for r in range(size)])
+    report = mpx.analyze(combine, x, comm=comm, ranks="all")
+    codes = {f.code for f in report.findings}
+    assert "MPX120" in codes, sorted(codes)

@@ -187,6 +187,94 @@ def test_reduce_scatter_start_wait_matches(chunks, monkeypatch):
 
 
 @needs_mpx
+@pytest.mark.parametrize("chunks", [1, 2, 4])
+def test_alltoall_start_wait_matches(chunks, monkeypatch):
+    """8-device pin: the chunked pairwise start/wait pair reproduces the
+    synchronous alltoall BIT FOR BIT (pure routing), for every chunk
+    count and an odd per-block payload (chunk-split reassembly)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MPI4JAX_TPU_OVERLAP_CHUNKS", str(chunks))
+    mpx, comm, size = _world()
+    x = np.arange(size * size * 5, dtype=np.float32).reshape(size, size, 5)
+
+    def sync(v):
+        s, _ = mpx.alltoall(v)
+        return mpx.varying(s * 1.0)
+
+    def split(v):
+        h, _ = mpx.alltoall_start(v)
+        w = v * 2.0  # independent compute in the gap
+        s, _ = mpx.alltoall_wait(h)
+        return mpx.varying(s + 0 * w)
+
+    want = np.asarray(mpx.run(sync, jnp.asarray(x)))
+    got = np.asarray(mpx.run(split, jnp.asarray(x)))
+    np.testing.assert_array_equal(want, got)
+    np.testing.assert_array_equal(got, x.transpose(1, 0, 2))
+
+
+@needs_mpx
+def test_alltoall_start_wait_hier_composition(monkeypatch):
+    """Under a faked 2-host topology with the crossover dropped, every
+    chunk's start phase runs the two-level exchange (intra transpose +
+    DCN exchange at start, reassembly-only wait) — results stay the
+    exact permutation."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "1")
+    mpx, comm, size = _world()
+    if size < 4 or size % 2:
+        pytest.skip("needs an even mesh of >= 4 for the 2-host fake")
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    x = np.arange(size * size * 3, dtype=np.float32).reshape(size, size, 3)
+
+    def split(v):
+        h, _ = mpx.alltoall_start(v)
+        s, _ = mpx.alltoall_wait(h)
+        return mpx.varying(s * 1.0)
+
+    got = np.asarray(mpx.run(split, jnp.asarray(x)))
+    np.testing.assert_array_equal(got, x.transpose(1, 0, 2))
+
+
+@needs_mpx
+def test_alltoall_double_wait_raises():
+    import jax.numpy as jnp
+
+    mpx, comm, size = _world()
+
+    def prog(v):
+        h, _ = mpx.alltoall_start(v)
+        s, _ = mpx.alltoall_wait(h)
+        with pytest.raises(RuntimeError, match="MPX112"):
+            mpx.alltoall_wait(h)
+        return mpx.varying(s * 1.0)
+
+    np.asarray(mpx.run(prog, jnp.ones((size, size, 2), jnp.float32)))
+
+
+@needs_mpx
+def test_overlap_region_splits_alltoall():
+    """Inside mpx.overlap(), a plain alltoall auto-splits into the
+    start/deferred-wait pair and materializes on first use."""
+    import jax.numpy as jnp
+
+    mpx, comm, size = _world()
+    x = np.arange(size * size * 2, dtype=np.float32).reshape(size, size, 2)
+
+    def prog(v):
+        with mpx.overlap():
+            s, _ = mpx.alltoall(v)
+            w = v * 3.0  # overlaps the exchange phases
+            out = s + w * 0
+        return mpx.varying(out)
+
+    got = np.asarray(mpx.run(prog, jnp.asarray(x)))
+    np.testing.assert_array_equal(got, x.transpose(1, 0, 2))
+
+
+@needs_mpx
 def test_overlap_region_lazy_routing():
     """Inside mpx.overlap(), plain allreduce auto-splits and the result
     materializes on first use; unforced handles are waited at region
