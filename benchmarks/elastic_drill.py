@@ -1,0 +1,167 @@
+"""Chaos-drill matrix for the elastic control plane -> BENCH_elastic.json.
+
+The committed acceptance artifact of the pod-scale control-plane PR
+(docs/resilience.md "Chaos drills"): runs every kill pattern of
+``resilience/drill.py`` — single rank, host row, coordinator, cascading
+double fault — over 8/16/64 simulated ranks, asserts the agreement and
+restore invariants inline, and records the analytic cost numbers the
+acceptance criteria name:
+
+- coordinator-mediated agreement stays O(k): at most ``k`` report
+  connections per round at every world size (vs the gossip fallback's
+  O(k²), recorded alongside for the ratio);
+- restore stays ~flat per survivor: repair bytes per surviving rank do
+  not grow with k for a fixed committed state;
+- the host-row kill restores bit-identically under the striped
+  placement at 2x4 AND 4x2, and is asserted UNRECOVERABLE under the old
+  neighbor placement on the same matrices (the negative control).
+
+Everything is deterministic (pure simulation, no clocks, no sockets), so
+CI regenerates the artifact and diffs it byte-for-byte against the
+committed copy.
+
+Run:  python benchmarks/elastic_drill.py [--save | --out PATH]
+
+Loads the library under an isolated package name (the tests' loader
+pattern), so it runs under any installed JAX.
+"""
+
+import argparse
+import importlib
+import json
+import pathlib
+import sys
+import types
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_elastic_drill"
+
+
+def _load():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "resilience"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "resilience.faultinject",
+                "resilience.retry", "resilience.watchdog",
+                "resilience.elastic", "resilience.drill"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+SCHEMA = "mpx-elastic-drill/1"
+
+KS = (8, 16, 64)
+
+# the host-row acceptance matrices: 2 hosts x 4 ranks and 4 hosts x 2
+# ranks — the two 8-rank shapes the striped-placement goldens pin
+HOST_ROW_TOPOLOGIES = ((4, 4), (2, 2, 2, 2))
+
+
+def per_k_summary(matrix):
+    """One row per world size: the headline cost numbers."""
+    rows = []
+    for k in KS:
+        entries = [m for m in matrix if m["k"] == k]
+        # the O(k) claim is judged on live-coordinator rounds (a dead
+        # coordinator degrades to gossip by design, priced separately)
+        live = [m["agreement"]["coordinator_connections"]
+                for m in entries if m["pattern"] != "coordinator"]
+        single = next(m for m in entries if m["pattern"] == "single")
+        rows.append({
+            "k": k,
+            "coordinator_connections_max": max(live),
+            "gossip_connections":
+                single["agreement"]["gossip_connections"],
+            "connection_ratio": round(
+                single["agreement"]["gossip_connections"]
+                / max(1, max(live)), 1),
+            "repair_bytes_per_survivor_single":
+                single["restore"]["repair_bytes_per_survivor"],
+            "repair_bytes_per_survivor_host_row":
+                next(m for m in entries if m["pattern"] == "host-row")
+                ["restore"]["repair_bytes_per_survivor"],
+        })
+    return rows
+
+
+def host_row_proof(drill):
+    """The stripe-vs-neighbor acceptance drills at 2x4 and 4x2: stripe
+    restores (asserted inside run_drill, with the neighbor negative
+    control asserted unrecoverable on the same kill)."""
+    out = []
+    for counts in HOST_ROW_TOPOLOGIES:
+        k = sum(counts)
+        m = drill.run_drill("host-row", k, counts=counts)
+        assert m["recovered"] and m.get("neighbor_unrecoverable"), m
+        out.append({
+            "topology": "x".join(
+                [str(len(counts)), str(counts[0])]
+                if len(set(counts)) == 1 else map(str, counts)),
+            "k": k,
+            "killed": m["killed"],
+            "stripe_recovered": True,
+            "neighbor_unrecoverable": True,
+            "repair_bytes": m["restore"]["repair_bytes"],
+        })
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="write the payload to PATH")
+    ap.add_argument("--save", action="store_true",
+                    help="write the committed artifact "
+                         "(BENCH_elastic.json at the repo root)")
+    args = ap.parse_args()
+    root = _load()
+    drill = sys.modules[f"{_ISO_NAME}.resilience.drill"]
+
+    matrix = drill.drill_matrix(ks=KS)
+    summary = per_k_summary(matrix)
+    # the O(k) acceptance assertion, at capture time: a stale artifact
+    # can never claim the budget silently
+    for row in summary:
+        assert row["coordinator_connections_max"] <= row["k"], row
+    payload = {
+        "schema": SCHEMA,
+        "per_k": summary,
+        "host_row_proof": host_row_proof(drill),
+        "matrix": matrix,
+        "provenance": {
+            "kind": "deterministic simulated-rank chaos drills (pure "
+                    "protocol models; the 2-process TCP lane is the CI "
+                    "faults/elastic steps — protocol in "
+                    "docs/resilience.md)",
+            "recipe": "python benchmarks/elastic_drill.py --save",
+            "ks": list(KS),
+            "patterns": list(drill.PATTERNS),
+            "redundancy": 1,
+        },
+    }
+    out = args.out or (str(REPO / "BENCH_elastic.json") if args.save
+                       else None)
+    text = json.dumps(payload, indent=2) + "\n"
+    if out:
+        with open(out, "w") as f:
+            f.write(text)
+        print(f"wrote {out}")
+    for row in summary:
+        print(f"k={row['k']:>3}: coordinator {row['coordinator_connections_max']:>3} "
+              f"conns vs gossip {row['gossip_connections']:>5} "
+              f"({row['connection_ratio']}x), repair/survivor "
+              f"{row['repair_bytes_per_survivor_single']}B")
+    del root
+
+
+if __name__ == "__main__":
+    main()

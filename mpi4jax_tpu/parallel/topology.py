@@ -35,6 +35,14 @@ does not match this comm's world, a mesh whose axis slabs disagree on
 the co-location pattern), ``derive_world_topology`` returns ``None`` and
 the caller keeps the flat single-level algorithms — topology support
 never turns a working program into an error.
+
+Besides the hierarchical lowerings, the elastic layer consumes this
+partition for *placement*: ``resilience/elastic.stripe_placement``
+stripes every shard replica onto a different host than its owner, so a
+whole-host loss stays recoverable (docs/resilience.md "Replica
+placement").  The same best-effort convention applies — no derivable
+topology means the stripe degrades to the neighbor ring, never an
+error.
 """
 
 from __future__ import annotations
@@ -77,7 +85,8 @@ class Topology:
 
     @property
     def ranks_per_host(self) -> Tuple[int, ...]:
-        """Rank count per host, in host order."""
+        """Rank count per host, in host order (the shape the elastic
+        stripe placement and the hierarchical plans both consume)."""
         counts: dict = {}
         for h in self.host_of_rank:
             counts[h] = counts.get(h, 0) + 1

@@ -16,11 +16,17 @@ above:
 - :mod:`.numerics` — opt-in ``MPI4JAX_TPU_CHECK_NUMERICS`` NaN/Inf guards on
   each collective's inputs/outputs, tied into ``abort_if``;
 - :mod:`.retry` — exponential-backoff (full-jitter) retry with a total
-  deadline, used by ``init_distributed``'s coordinator connection;
+  deadline, used by ``init_distributed``'s coordinator connection and the
+  elastic agreement star;
 - :mod:`.elastic` — the RECOVERY half (ULFM-style shrink-and-resume):
-  communication epochs, failure agreement, the :class:`~.elastic.ShardStore`
-  in-memory sharded checkpoint with k-redundant neighbor replication, and
-  :func:`~.elastic.run`, the training loop that survives rank loss;
+  communication epochs, coordinator-mediated failure agreement (with
+  gossip degradation), the :class:`~.elastic.ShardStore` in-memory sharded
+  checkpoint with topology-aware striped replication (every replica on a
+  different host than its owner), and :func:`~.elastic.run`, the training
+  loop that survives rank — and whole-host — loss;
+- :mod:`.drill` — the deterministic chaos-drill harness: simulated-rank
+  kill patterns (single rank, host row, coordinator, cascading double
+  fault) asserting the agreement + restore invariants at drill scale;
 - :mod:`.runtime` — config resolution and the per-op :class:`~.runtime.Plan`
   the dispatch layer consults.  All features default OFF, and when off the
   lowered HLO is byte-identical to an uninstrumented build.
@@ -33,8 +39,12 @@ from . import elastic  # noqa: F401
 from .elastic import (  # noqa: F401
     RankFailure,
     ShardStore,
+    coordinator_agreement,
+    gossip_agreement,
     install_preemption_handler,
+    neighbor_placement,
     request_drain,
+    stripe_placement,
 )
 from .faultinject import (  # noqa: F401
     FaultClause,
@@ -42,7 +52,7 @@ from .faultinject import (  # noqa: F401
     parse_fault_spec,
     reset_fault_state,
 )
-from .retry import retry_with_backoff  # noqa: F401
+from .retry import backoff_delay, retry_with_backoff  # noqa: F401
 from .runtime import (  # noqa: F401
     cache_token,
     plan_for,
@@ -63,6 +73,7 @@ __all__ = [
     "parse_fault_spec",
     "canonical_spec",
     "reset_fault_state",
+    "backoff_delay",
     "retry_with_backoff",
     "plan_for",
     "cache_token",
@@ -77,6 +88,10 @@ __all__ = [
     "elastic",
     "RankFailure",
     "ShardStore",
+    "stripe_placement",
+    "neighbor_placement",
+    "gossip_agreement",
+    "coordinator_agreement",
     "request_drain",
     "install_preemption_handler",
 ]

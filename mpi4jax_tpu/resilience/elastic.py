@@ -10,10 +10,17 @@ Elastic Horovod (resume from replicated in-memory state, not disk):
 1. **Failure commit** — a watchdog expiry (claimed via
    ``resilience.set_on_timeout``) or a peer-death error raises
    :class:`RankFailure` carrying the *suspected* global ranks.  The
-   survivors then agree on the failed set: a gossip round over
-   still-healthy links (:func:`gossip_agreement` is the pure model the
-   tests pin; :func:`exchange_suspects` is the TCP runtime form), so
-   every survivor commits the SAME set even when each observed a
+   survivors then agree on the failed set.  The default route is
+   coordinator-mediated (O(k) connections: survivors report local
+   suspect sets to rank 0, which unions and rebroadcasts —
+   :func:`coordinator_agreement` is the pure model,
+   :func:`coordinator_exchange_suspects` the TCP runtime form); when
+   the coordinator itself is a suspect (or
+   ``MPI4JAX_TPU_ELASTIC_AGREEMENT=gossip``), agreement degrades to a
+   gossip round over still-healthy links (:func:`gossip_agreement` /
+   :func:`exchange_suspects`).  The gossip fixpoint stays the arbiter —
+   the coordinator verdict provably equals it on every drill matrix —
+   so every survivor commits the SAME set even when each observed a
    different symptom.
 2. **Revoke + shrink** — the current *communication epoch* is revoked:
    :func:`advance_epoch` bumps a monotonic counter that is folded into
@@ -26,11 +33,19 @@ Elastic Horovod (resume from replicated in-memory state, not disk):
    ranks compacted (:func:`compact_rank_map`).
 3. **Resume** — :class:`ShardStore` keeps an in-memory, sharded copy of
    registered state (the natural shard unit ``reduce_scatter`` produces:
-   rank ``r`` owns flat-byte shard ``r``) with **k-redundant neighbor
-   replication**: shard ``s`` is replicated on ranks ``s, s+1, ...,
-   s+redundancy (mod k)``, so ANY ``redundancy`` simultaneous rank
-   losses leave at least one live copy of every shard
-   (:func:`recoverable`).  :func:`run` wraps the training loop: on
+   rank ``r`` owns flat-byte shard ``r``) with **topology-aware striped
+   replication** (:func:`stripe_placement`, the default): every replica
+   of shard ``s`` lands on a *different host* than its owner (and than
+   each other, while hosts allow), so losing a whole host still leaves
+   ≥1 live copy of every shard whenever ``redundancy ≥ 1`` and ``hosts
+   ≥ 2``.  Without topology information (or under
+   ``MPI4JAX_TPU_ELASTIC_PLACEMENT=neighbor``) placement degrades to
+   the classic neighbor ring (:func:`neighbor_placement`: shard ``s``
+   on ranks ``s, s+1, ..., s+redundancy (mod k)``), which tolerates any
+   ``redundancy`` simultaneous *rank* losses (:func:`recoverable`) but
+   not a host-row loss.  The table in force is recorded on each commit
+   record, and restores follow the RECORDED table.  :func:`run` wraps
+   the training loop: on
    ``RankFailure`` it commits the failure, shrinks, restores the last
    committed state (reassembled from surviving replicas — one SUM
    allreduce over the *new* comm in multi-process mode), and continues
@@ -52,6 +67,7 @@ import json
 import socket
 import threading
 import time
+import warnings
 from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
 
 from ..utils import config
@@ -71,14 +87,22 @@ __all__ = [
     "shrunken_shape",
     "replica_ranks",
     "shards_held_by",
+    "neighbor_placement",
+    "stripe_placement",
+    "placement_shards_held_by",
+    "placement_recoverable",
+    "plan_from_placement",
     "recoverable",
     "reconstruction_plan",
     "shard_bounds",
     "gossip_agreement",
+    "coordinator_agreement",
     "majority_survives",
     "reassemble_from_stores",
     "revoke_epoch",
     "exchange_suspects",
+    "coordinator_exchange_suspects",
+    "negotiate_failed",
     "classify_failure",
     "take_pending_failure",
     "request_drain",
@@ -89,6 +113,7 @@ __all__ = [
     "coordinator_port",
     "join_port",
     "control_port",
+    "agree_port",
     "mark_comm_draining",
     "comm_drained",
     "pack_leaves",
@@ -224,7 +249,25 @@ def elastic_cache_token():
 
 
 # ---------------------------------------------------------------------------
-# shard ownership + k-redundant neighbor replication (pure)
+# shard ownership + replica placement (pure)
+#
+# Two placement policies share one table shape — ``table[s]`` is the tuple
+# of ranks holding shard s, owner first:
+#
+#   neighbor  (replica_ranks / neighbor_placement): shard s on ranks
+#             s..s+redundancy mod k.  Host-blind: a whole-host loss kills
+#             a contiguous rank block PLUS the neighbors holding its
+#             replicas, so a host-row kill can erase every copy of a
+#             shard even within the redundancy budget.
+#   stripe    (stripe_placement): topology-aware — every replica lands on
+#             a DIFFERENT host than the owner (and than each other, while
+#             hosts allow), so any single-host loss leaves every shard a
+#             live copy whenever redundancy >= 1 and hosts >= 2.
+#
+# The stripe is the default (MPI4JAX_TPU_ELASTIC_PLACEMENT); with no
+# topology it degrades to exactly the neighbor table, so single-host
+# (and topology-less test) deployments see identical placement to the
+# pre-stripe builds.  benchmarks/elastic_drill.py drills the difference.
 # ---------------------------------------------------------------------------
 
 
@@ -261,39 +304,171 @@ def shards_held_by(rank: int, k: int, redundancy: int) -> Tuple[int, ...]:
     return tuple(sorted((rank - j) % k for j in range(r + 1)))
 
 
-def recoverable(failed: Iterable[int], k: int, redundancy: int) -> bool:
-    """True iff every shard still has at least one surviving copy after
-    losing ``failed`` — i.e. no shard's whole replica set died."""
+def neighbor_placement(k: int, redundancy: int) -> Tuple[Tuple[int, ...], ...]:
+    """The full neighbor placement table: ``table[s] == replica_ranks(s)``.
+    Kept reachable (``MPI4JAX_TPU_ELASTIC_PLACEMENT=neighbor``) as the
+    drill harness's negative control — the placement a host-row kill
+    provably defeats (benchmarks/elastic_drill.py)."""
+    return tuple(replica_ranks(s, k, redundancy) for s in range(k))
+
+
+def _host_of_rank(topology, k: int) -> Optional[Tuple[int, ...]]:
+    """Normalize ``topology`` to a length-``k`` host-id tuple, or ``None``.
+
+    Accepts an object with ``host_of_rank`` (parallel/topology.Topology),
+    a per-host rank-count sequence (``(4, 4)``), or a spec string in the
+    ``MPI4JAX_TPU_TOPOLOGY`` grammar (``'2x4'`` / ``'3,5'``).  A topology
+    that does not cover exactly ``k`` ranks resolves to ``None`` (the
+    caller falls back to the topology-less table): placement silently
+    guessing host boundaries would void the stripe guarantee."""
+    if topology is None:
+        return None
+    hor = getattr(topology, "host_of_rank", None)
+    if hor is None:
+        counts = (config.parse_topology_spec(topology)
+                  if isinstance(topology, str)
+                  else tuple(int(c) for c in topology))
+        if counts is None:
+            return None
+        if any(c < 1 for c in counts):
+            raise ValueError(
+                f"topology host counts must be positive, got {counts}")
+        hor = tuple(h for h, c in enumerate(counts) for _ in range(c))
+    else:
+        hor = tuple(int(h) for h in hor)
+    return hor if len(hor) == k else None
+
+
+def stripe_placement(k: int, redundancy: int,
+                     topology=None) -> Tuple[Tuple[int, ...], ...]:
+    """Topology-aware replica placement: ``table[s]`` is the tuple of
+    ranks holding shard ``s``, owner (rank ``s``) first.
+
+    Candidate replicas are ordered one rank per host, hosts in
+    increasing (wrapping) distance from the owner's host, the owner's
+    own host strictly last; within a host the candidate local index
+    wraps from the owner's local index, keeping per-host shard load
+    balanced.  Consequences the tests pin:
+
+    - every replica lands on a DIFFERENT host than the owner, and than
+      the other replicas, while hosts allow (``redundancy < hosts``);
+    - any SINGLE-host loss leaves every shard >= 1 live copy whenever
+      ``redundancy >= 1`` and ``hosts >= 2`` (the first replica is
+      always off-host) — the property neighbor placement lacks;
+    - with no topology (or one host) the table degrades to exactly
+      :func:`neighbor_placement`;
+    - ``redundancy >= hosts`` forces replica co-location on hosts: the
+      placement warns once and wraps gracefully (copies still land on
+      distinct ranks while ``k`` allows — the extra copies buy rank-loss
+      budget, not host-loss budget).
+    """
+    if k < 1:
+        raise ValueError(f"need at least one rank, got k={k}")
+    if redundancy < 0:
+        raise ValueError(f"redundancy must be >= 0, got {redundancy}")
+    r = min(redundancy, k - 1)
+    hor = _host_of_rank(topology, k)
+    if hor is None:
+        return neighbor_placement(k, redundancy)
+    members: Dict[int, List[int]] = {}
+    for rank, h in enumerate(hor):
+        members.setdefault(h, []).append(rank)
+    order = sorted(members)
+    hosts = len(order)
+    hidx = {h: i for i, h in enumerate(order)}
+    lidx = {}
+    for ranks in members.values():
+        for i, rank in enumerate(ranks):
+            lidx[rank] = i
+    if hosts > 1 and r >= hosts:
+        warnings.warn(
+            f"stripe_placement: redundancy {redundancy} >= hosts {hosts}: "
+            "replica copies must co-locate on hosts (a single-host loss "
+            "stays recoverable; the extra copies only add rank-loss "
+            "budget) — wrapping the stripe around the hosts",
+            RuntimeWarning, stacklevel=2)
+    table = []
+    for s in range(k):
+        h = hidx[hor[s]]
+        l = lidx[s]
+        cands = []
+        for c in range(k):
+            if c == s:
+                continue
+            ch = hidx[hor[c]]
+            d = (ch - h) % hosts
+            q = (lidx[c] - l) % len(members[order[ch]])
+            # one rank per host per wrap q, hosts in distance order,
+            # the owner's own host (d == 0) strictly after every other
+            cands.append(((1, q, 0) if d == 0 else (0, q, d), c))
+        cands.sort()
+        table.append((s,) + tuple(c for _, c in cands[:r]))
+    return tuple(table)
+
+
+def placement_shards_held_by(rank: int, placement) -> Tuple[int, ...]:
+    """Inverse of a placement table: the shards ``rank`` holds."""
+    return tuple(sorted(s for s, holders in enumerate(placement)
+                        if rank in holders))
+
+
+def placement_recoverable(failed: Iterable[int], placement) -> bool:
+    """True iff every shard keeps >= 1 surviving copy under ``placement``
+    after losing ``failed``."""
     dead = frozenset(failed)
-    return all(
-        any(r not in dead for r in replica_ranks(s, k, redundancy))
-        for s in range(k)
-    )
+    return all(any(r not in dead for r in holders)
+               for holders in placement)
 
 
-def reconstruction_plan(
-    failed: Iterable[int], k: int, redundancy: int
-) -> Dict[int, int]:
-    """``{shard: provider}`` naming, for EVERY shard, the lowest-numbered
-    surviving rank holding a copy — the deterministic choice every
-    survivor computes independently (no coordination needed), so the
-    restore exchange has exactly one contributor per shard.  Raises
-    ``RankFailure`` when a shard lost all its copies (more simultaneous
-    failures than the redundancy budget)."""
+def plan_from_placement(failed: Iterable[int], placement) -> Dict[int, int]:
+    """``{shard: provider}`` over an arbitrary placement table: for EVERY
+    shard, the lowest-numbered surviving holder — the deterministic
+    choice every survivor computes independently from the same committed
+    table, so the restore exchange has exactly one contributor per
+    shard.  Raises ``RankFailure`` when a shard lost every copy."""
     dead = frozenset(failed)
     plan = {}
-    for s in range(k):
-        live = [r for r in replica_ranks(s, k, redundancy) if r not in dead]
+    for s, holders in enumerate(placement):
+        live = [r for r in holders if r not in dead]
         if not live:
             raise RankFailure(
                 dead,
-                f"shard {s} unrecoverable: all {redundancy + 1} replica "
-                f"ranks {replica_ranks(s, k, redundancy)} failed "
-                f"(redundancy={redundancy} tolerates at most {redundancy} "
-                "simultaneous failures)",
+                f"shard {s} unrecoverable: all {len(holders)} replica "
+                f"ranks {tuple(holders)} failed (the placement tolerates "
+                f"at most {len(holders) - 1} simultaneous losses of a "
+                "shard's holders)",
             )
         plan[s] = min(live)
     return plan
+
+
+def recoverable(failed: Iterable[int], k: int, redundancy: int,
+                placement=None) -> bool:
+    """True iff every shard still has at least one surviving copy after
+    losing ``failed`` — i.e. no shard's whole replica set died.
+    ``placement`` defaults to the neighbor table (back-compat); pass a
+    :func:`stripe_placement` table to judge the striped layout."""
+    table = (neighbor_placement(k, redundancy)
+             if placement is None else placement)
+    return placement_recoverable(failed, table)
+
+
+def reconstruction_plan(
+    failed: Iterable[int], k: int, redundancy: int, placement=None
+) -> Dict[int, int]:
+    """``{shard: provider}`` naming, for EVERY shard, the lowest-numbered
+    surviving rank holding a copy (:func:`plan_from_placement`).  Raises
+    ``RankFailure`` when a shard lost all its copies (more simultaneous
+    failures than the placement tolerates).  ``placement`` defaults to
+    the neighbor table; the runtime passes the table recorded on the
+    commit, so restore always follows the placement the bytes actually
+    landed under."""
+    table = (neighbor_placement(k, redundancy)
+             if placement is None else placement)
+    if len(table) != k:
+        raise ValueError(
+            f"placement table covers {len(table)} shards, expected {k}")
+    return plan_from_placement(failed, table)
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +586,9 @@ def shrunken_shape(shape, expanded_failed: Iterable[int], fail_unit: str):
 #                                              (two alternating epoch banks,
 #                                              so consecutive epochs never
 #                                              contend for a port)
+#   [port_base + 4*span, port_base + 5*span)   agreement listener (rank 0):
+#                                              the coordinator-mediated
+#                                              suspect-report star
 #
 # A wrap collision (epoch e vs e+span) lands on a socket the revoked world
 # closed span epochs ago; the residual TIME_WAIT case is absorbed by the
@@ -456,6 +634,15 @@ def control_port(port_base: int, rank: int, epoch: int,
     return int(port_base) + 2 * span + bank * span + int(rank)
 
 
+def agree_port(port_base: int, epoch: int, span: Optional[int] = None) -> int:
+    """The coordinator's agreement-listener port for ``epoch`` — where
+    survivors report their suspect sets in the coordinator-mediated
+    agreement (its own span-wide bank above the control windows, so a
+    report can never poke a jax.distributed or control socket)."""
+    span = config.elastic_port_span() if span is None else int(span)
+    return int(port_base) + 4 * span + wrapped_epoch(epoch, span)
+
+
 # ---------------------------------------------------------------------------
 # failure agreement (pure model + TCP runtime form)
 # ---------------------------------------------------------------------------
@@ -478,8 +665,26 @@ def gossip_agreement(
     result is identical on every member — the agreement property the
     runtime form inherits.  Disconnected components can disagree; that is
     the split-brain case :func:`majority_survives` arbitrates.
+
+    Gossip is read over EVERY healthy link, including from a peer already
+    in the reader's suspect set — matching the runtime form, whose inbox
+    unions every message that lands regardless of the reader's current
+    suspicion.  (An earlier revision skipped suspected peers' gossip,
+    which made convergence order-dependent: a rank could hearsay-suspect
+    a live peer mid-round and then permanently miss a suspect known only
+    to that peer — the "something died but unnamed" case under a
+    partitioned link matrix, where the only name-carrier may itself be
+    partition-suspected.)  Suspect values outside ``range(world)`` raise
+    ``ValueError`` — a stale-numbering suspect silently joining the
+    fixpoint would poison every survivor's verdict.
     """
     world = len(links)
+    for r, named in suspects.items():
+        bad = sorted(int(p) for p in named if not 0 <= int(p) < world)
+        if bad:
+            raise ValueError(
+                f"gossip_agreement: rank {r} names suspects {bad} outside "
+                f"the world of {world} ranks (stale numbering?)")
     # every rank computes (a dead rank's output is simply ignored by its
     # peers — they have no healthy link to read it over)
     agreed = {r: set(map(int, suspects.get(r, ()))) for r in range(world)}
@@ -498,11 +703,74 @@ def gossip_agreement(
                 healthy = links[r][p] and links[p][r]
                 if not healthy:
                     mine.add(p)          # unreachable peer => suspect
-                elif p not in mine:
+                else:
                     mine |= snapshot[p]  # gossip over the healthy link
             if len(mine) != before:
                 changed = True
     return {r: frozenset(s) for r, s in agreed.items()}
+
+
+def coordinator_agreement(
+    suspects: Dict[int, Iterable[int]],
+    links,
+    coordinator: int = 0,
+) -> Dict[int, FrozenSet[int]]:
+    """Pure model of the coordinator-mediated agreement round — the O(k)
+    star that replaces the O(k²) all-pairs gossip at pod scale.
+
+    Every rank's effective REPORT is its local suspect set plus every
+    peer it has no healthy link to (the same link-derived suspicion
+    :func:`gossip_agreement` applies).  Ranks with a healthy link to
+    ``coordinator`` that do not locally name it a suspect form the star:
+    each sends one report, the coordinator unions them (its own
+    included), adds every rank that never reported, and rebroadcasts one
+    verdict — 2 messages over k-1 connections.  Ranks outside the star
+    degrade to peer gossip among themselves; the star ranks are parked
+    at the coordinator and answer no gossip, so the degraded matrix
+    masks them out (an isolated degraded rank therefore suspects
+    everyone and aborts on the majority guard — conservative, never
+    split-brained).
+
+    Arbiter property (pinned by the tests): whenever the coordinator has
+    a healthy link to every live rank, the star verdict equals
+    :func:`gossip_agreement`'s fixpoint on the same inputs — the star is
+    a 1-hop spanning tree of the survivor component and both compute the
+    component-wide union.  A dead (or universally-suspected) coordinator
+    degrades EVERY survivor, and the result is exactly the gossip
+    fixpoint — so the pure gossip model stays the arbiter the runtime
+    transport must converge to in every case.
+    """
+    world = len(links)
+
+    def healthy(a: int, b: int) -> bool:
+        return bool(links[a][b] and links[b][a])
+
+    local = {r: set(map(int, suspects.get(r, ()))) for r in range(world)}
+    reports = {
+        r: local[r] | {p for p in range(world)
+                       if p != r and not healthy(r, p)}
+        for r in range(world)
+    }
+    star = [r for r in range(world)
+            if r == coordinator
+            or (healthy(r, coordinator) and coordinator not in local[r])]
+    verdict: set = set()
+    for r in star:
+        verdict |= reports[r]
+    # a rank that never reports is suspected (it is either dead — already
+    # in the coordinator's own link-derived report — or degraded to
+    # gossip the star cannot hear)
+    verdict |= set(range(world)) - set(star)
+    out = {r: frozenset(verdict) for r in star}
+    rest = [r for r in range(world) if r not in star]
+    if rest:
+        keep = set(rest)
+        masked = [[bool(links[i][j]) and i in keep and j in keep
+                   for j in range(world)] for i in range(world)]
+        fallen = gossip_agreement(suspects, masked)
+        for r in rest:
+            out[r] = fallen[r]
+    return out
 
 
 def majority_survives(agreed_failed: Iterable[int], world: int) -> bool:
@@ -643,6 +911,158 @@ def exchange_suspects(
         linger.daemon = True
         linger.start()
     return frozenset(agreed)
+
+
+def coordinator_exchange_suspects(
+    my_rank: int,
+    world: int,
+    suspects: Iterable[int],
+    host: str,
+    port: int,
+    *,
+    coordinator: int = 0,
+    timeout: float = 20.0,
+) -> FrozenSet[int]:
+    """Runtime form of :func:`coordinator_agreement`'s star: O(k)
+    connections instead of the all-pairs gossip's O(k²).
+
+    The coordinator (rank ``coordinator`` of the CURRENT world, normally
+    0) binds ``port`` (:func:`agree_port`), collects one suspect report
+    per survivor, unions them with its own, adds every rank that never
+    reported within ``timeout``, and answers each parked connection with
+    the verdict — one connection per non-coordinator survivor, the
+    verdict riding the report's socket back.  Reporters dial with
+    full-jitter backoff (:mod:`.retry` — the reconnection-stampede cure:
+    k-1 survivors hit one listener at once) until the report lands or
+    ``timeout`` elapses.
+
+    Raises ``RuntimeError``/``OSError`` when the round cannot complete
+    (coordinator unreachable, bind lost, malformed verdict) — the caller
+    (:func:`negotiate_failed`) degrades to :func:`exchange_suspects`
+    peer gossip, the documented fallback when the coordinator itself is
+    the casualty.  Like the gossip form, ``my_rank`` is never gossiped
+    by itself but is KEPT in the returned verdict when peers put it
+    there — a rank its peers declared failed must see the verdict and
+    abort, not silently strip it.
+    """
+    mine = set(int(r) for r in suspects)
+    mine.discard(my_rank)
+
+    if my_rank != coordinator:
+        from .retry import retry_with_backoff
+
+        deadline = time.monotonic() + timeout
+
+        def _report():
+            budget = max(0.1, deadline - time.monotonic())
+            with socket.create_connection((host, port),
+                                          timeout=budget) as c:
+                # once connected the coordinator is known alive; the
+                # verdict waits on ITS collection window, which may have
+                # opened up to a full window after ours — grant the recv
+                # that extra patience so detection skew between survivors
+                # costs latency, never a spurious fallback
+                c.settimeout(max(0.1, deadline - time.monotonic())
+                             + timeout)
+                _send_json(c, {"from": my_rank,
+                               "suspects": sorted(mine)})
+                reply = _recv_json(c)
+                return frozenset(
+                    int(r) for r in reply["verdict"]) | mine
+
+        return retry_with_backoff(
+            _report,
+            what=f"suspect report to agreement coordinator "
+                 f"{host}:{port}",
+            deadline=timeout,
+            base_delay=0.05,
+            max_delay=1.0,
+        )
+
+    # --- coordinator side: collect, union, rebroadcast ---
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(world)
+    srv.settimeout(0.2)
+    reports: Dict[int, FrozenSet[int]] = {my_rank: frozenset(mine)}
+    parked = []
+    deadline = time.monotonic() + timeout
+    try:
+        while time.monotonic() < deadline:
+            union = set().union(*reports.values())
+            # stop waiting once every rank not already suspected (by
+            # anyone) has reported; suspected ranks cost no deadline
+            if not (set(range(world)) - set(reports) - union):
+                break
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            try:
+                conn.settimeout(max(0.1, deadline - time.monotonic()))
+                payload = _recv_json(conn)
+                sender = int(payload["from"])
+                reports[sender] = frozenset(
+                    int(r) for r in payload.get("suspects", ()))
+                parked.append((sender, conn))
+            except (OSError, ValueError, KeyError, TypeError):
+                conn.close()
+        # NOT discarding my_rank: if a report named the (serving)
+        # coordinator, every reporter gets a verdict containing it, so
+        # the coordinator must judge itself by the same verdict —
+        # stripping it locally would hand the survivors divergent sets
+        verdict = set().union(*reports.values())
+        verdict |= set(range(world)) - set(reports)  # non-reporters
+        _meter("elastic.agreement_reports", len(parked))
+        for _, conn in parked:
+            try:
+                _send_json(conn, {"verdict": sorted(verdict)})
+            except OSError:
+                pass
+            finally:
+                conn.close()
+    finally:
+        srv.close()
+    return frozenset(verdict)
+
+
+def negotiate_failed(
+    my_rank: int,
+    world: int,
+    suspects: Iterable[int],
+    host: str,
+    *,
+    agree_port_no: int,
+    gossip_port_base: int,
+    timeout: float = 20.0,
+    mode: Optional[str] = None,
+    coordinator: int = 0,
+) -> FrozenSet[int]:
+    """The runtime agreement entry ``_recover`` uses: coordinator star
+    first (O(k) connections), degradation to all-pairs peer gossip when
+    the coordinator is locally a suspect, unreachable, or the declared
+    mode (``MPI4JAX_TPU_ELASTIC_AGREEMENT``) forces gossip.
+
+    The coordinator phase gets at most HALF the agreement window: a
+    survivor that needed the fallback still reaches the gossip ports
+    well inside its peers' full-window send patience, so a dead
+    coordinator costs latency, never a spurious suspicion."""
+    mine = set(int(r) for r in suspects)
+    mode = config.elastic_agreement() if mode is None else mode
+    if mode == "coordinator" and coordinator not in mine:
+        try:
+            return coordinator_exchange_suspects(
+                my_rank, world, mine, host, agree_port_no,
+                coordinator=coordinator,
+                timeout=max(0.2, timeout / 2.0),
+            )
+        except (OSError, RuntimeError):
+            _meter("elastic.agreement_fallbacks")
+    elif mode == "coordinator":
+        _meter("elastic.agreement_fallbacks")
+    return exchange_suspects(
+        my_rank, world, mine, host, gossip_port_base, timeout=timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -1230,19 +1650,27 @@ def _incident(meter: str, name: str, rank: int, detail: str) -> None:
 
 
 class ShardStore:
-    """In-memory sharded checkpoint of registered state with k-redundant
-    neighbor replication.
+    """In-memory sharded checkpoint of registered state with k-redundant,
+    topology-striped replication.
 
     Each committed state pytree is flattened to one flat byte buffer,
     split into ``k`` equal byte shards (``shard s`` owned by rank ``s`` —
     the unit a ``reduce_scatter`` naturally produces), and this process
-    stores the shards of its *local* ranks plus each local rank's
-    ``redundancy`` left neighbors (:func:`shards_held_by`): every shard
-    lives on ``redundancy + 1`` distinct ranks, so any ``redundancy``
-    simultaneous rank losses are recoverable.  Memory cost per rank is
-    ``(redundancy + 1)/k`` of the state size — for the default
-    ``redundancy=1`` on 8 ranks, a quarter of a full on-disk checkpoint,
-    restored at memory speed.
+    stores the shards its local ranks hold under the commit's placement
+    table: every shard lives on ``redundancy + 1`` distinct ranks, so
+    any ``redundancy`` simultaneous rank losses are recoverable.  Memory
+    cost per rank is ``(redundancy + 1)/k`` of the state size — for the
+    default ``redundancy=1`` on 8 ranks, a quarter of a full on-disk
+    checkpoint, restored at memory speed.
+
+    Placement is the topology-aware stripe by default
+    (:func:`stripe_placement` — replicas land on a different HOST than
+    their owner, so a whole-host loss stays recoverable with
+    ``redundancy >= 1``); ``MPI4JAX_TPU_ELASTIC_PLACEMENT=neighbor`` (or
+    ``placement='neighbor'``) restores the host-blind ring-neighbor
+    table.  The table in force is recorded ON the commit, and restore
+    follows the recorded table — never the current flags — so the bytes
+    are always found where they actually landed.
 
     Single-controller processes driving multiple ranks (the virtual
     multi-device mesh, or multi-host with several devices per process)
@@ -1251,17 +1679,28 @@ class ShardStore:
 
     ``comm`` may be ``None`` (the default world comm resolves lazily).
     ``rank`` pins the store to ONE global rank — the per-rank simulation
-    handle the pure tests (and the protocol docs) use; default derives
-    local ranks from the comm's mesh process layout.
+    handle the pure tests, the chaos drills (resilience/drill.py), and
+    the protocol docs use; default derives local ranks from the comm's
+    mesh process layout.  ``topology`` overrides host-map discovery for
+    placement: a per-host count tuple, a spec string (``'2x4'``), or a
+    ``parallel.topology.Topology``; default consults the declared
+    ``MPI4JAX_TPU_TOPOLOGY`` spec, then the comm's derived topology.
     """
 
     def __init__(self, comm=None, *, redundancy: Optional[int] = None,
-                 rank: Optional[int] = None, bootstrap: Optional[dict] = None):
+                 rank: Optional[int] = None, bootstrap: Optional[dict] = None,
+                 topology=None, placement: Optional[str] = None):
         self.redundancy = (config.elastic_redundancy()
                            if redundancy is None else int(redundancy))
         if self.redundancy < 0:
             raise ValueError(
                 f"redundancy must be >= 0, got {self.redundancy}")
+        if placement is not None and placement not in ("stripe", "neighbor"):
+            raise ValueError(
+                f"placement must be 'stripe' or 'neighbor', got "
+                f"{placement!r}")
+        self._topology = topology
+        self._placement_mode = placement
         self._comm = comm
         self._rank = rank
         # multi-process recovery parameters (coordinator host/ports for
@@ -1304,14 +1743,53 @@ class ShardStore:
             if getattr(d, "process_index", 0) == me
         )
 
-    def held_shards(self, k: Optional[int] = None) -> Tuple[int, ...]:
+    def placement_mode(self) -> str:
+        """``'stripe'`` or ``'neighbor'`` — the constructor override,
+        else the declared ``MPI4JAX_TPU_ELASTIC_PLACEMENT`` flag."""
+        return self._placement_mode or config.elastic_placement()
+
+    def _topology_for(self, k: int):
+        """Host map consulted for placement at world size ``k``: the
+        explicit ``topology`` argument, else the declared
+        ``MPI4JAX_TPU_TOPOLOGY`` spec when it covers ``k`` ranks, else
+        the comm's derived topology, else ``None`` (single host — the
+        stripe degrades to the neighbor table)."""
+        if self._topology is not None:
+            return self._topology
+        spec = config.topology_spec()
+        if spec:
+            counts = config.parse_topology_spec(spec)
+            if counts is not None and sum(counts) == k:
+                return counts
+            return None
+        try:
+            from ..parallel.topology import derive_world_topology
+
+            topo = derive_world_topology(self.comm)
+        except Exception:
+            return None
+        if topo is not None and len(topo.host_of_rank) == k:
+            return topo
+        return None
+
+    def placement_table(self, k: Optional[int] = None
+                        ) -> Tuple[Tuple[int, ...], ...]:
+        """The replica placement table the next commit lands under."""
+        k = self.world_size() if k is None else int(k)
+        if self.placement_mode() == "neighbor":
+            return neighbor_placement(k, self.redundancy)
+        return stripe_placement(k, self.redundancy, self._topology_for(k))
+
+    def held_shards(self, k: Optional[int] = None,
+                    placement=None) -> Tuple[int, ...]:
         """Shards this process stores on commit: the union of
-        :func:`shards_held_by` over its local ranks."""
+        :func:`placement_shards_held_by` over its local ranks."""
         k = self.world_size() if k is None else k
+        table = self.placement_table(k) if placement is None else placement
         held = set()
         for r in self.local_ranks():
             if r < k:
-                held.update(shards_held_by(r, k, self.redundancy))
+                held.update(placement_shards_held_by(r, table))
         return tuple(sorted(held))
 
     # -- commit ------------------------------------------------------------
@@ -1328,13 +1806,14 @@ class ShardStore:
         host_leaves = [np.asarray(a) for a in leaves]
         buf, meta = pack_leaves(host_leaves)
         k = self.world_size()
+        table = self.placement_table(k)
         shard, padded = shard_bounds(buf.nbytes, k)
         if padded > buf.nbytes:
             buf = np.concatenate(
                 [buf, np.zeros(padded - buf.nbytes, np.uint8)])
         shards = {
             s: bytes(buf[s * shard:(s + 1) * shard])
-            for s in self.held_shards(k)
+            for s in self.held_shards(k, table)
         }
         # the structural twin a cold joiner can unflatten with: the pure
         # spec matches jax.tree's structure on dict/list/tuple nests
@@ -1353,6 +1832,7 @@ class ShardStore:
             "meta": meta,
             "treedef": treedef,
             "pure_spec": spec,
+            "placement": table,
             "shards": shards,
         }
         with self._lock:
@@ -1399,6 +1879,27 @@ class ShardStore:
             )
         return rec
 
+    def _rec_placement(self, rec: dict) -> Tuple[Tuple[int, ...], ...]:
+        """The placement table the commit was made under.  Records written
+        before placement tables existed (or adopted from an old peer) fall
+        back to the neighbor table — the only policy such commits can have
+        used."""
+        table = rec.get("placement")
+        if table is None:
+            table = neighbor_placement(rec["k"], self.redundancy)
+        return table
+
+    def restore_plan(self, failed: Iterable[int] = ()) -> Dict[int, int]:
+        """Provider plan for restoring the last commit after losing
+        ``failed`` — computed against the placement table *recorded on the
+        commit*, never against current flags: a commit striped under one
+        policy must be restored under the same table even if the flag
+        changed since.  Raises :class:`RankFailure` when some shard lost
+        every holder."""
+        rec = self._require_commit()
+        return plan_from_placement(frozenset(failed),
+                                   self._rec_placement(rec))
+
     def can_describe_commit(self) -> bool:
         """Whether the last commit carries a validated structural spec —
         the admission gate: a world whose state cannot be described must
@@ -1432,6 +1933,8 @@ class ShardStore:
             "meta": [[list(shape), dtype, nbytes]
                      for shape, dtype, nbytes in rec["meta"]],
             "pure_spec": rec["pure_spec"],
+            "placement": [list(holders)
+                          for holders in self._rec_placement(rec)],
         }
 
     def adopt_commit(self, desc: dict) -> None:
@@ -1440,6 +1943,11 @@ class ShardStore:
         next :meth:`restore` (``force_exchange=True``) contributes zeros
         and receives everything."""
         spec = _spec_from_json(desc["pure_spec"])
+        placement = (
+            tuple(tuple(int(r) for r in holders)
+                  for holders in desc["placement"])
+            if desc.get("placement") is not None
+            else neighbor_placement(int(desc["k"]), self.redundancy))
         record = {
             "step": int(desc["step"]),
             "epoch": int(desc["epoch"]),
@@ -1450,6 +1958,7 @@ class ShardStore:
                      for shape, dtype, nbytes in desc["meta"]],
             "treedef": ("pure", spec),
             "pure_spec": spec,
+            "placement": placement,
             "shards": {},
             "cold": True,
         }
@@ -1463,8 +1972,9 @@ class ShardStore:
 
         When this process holds every needed shard (single-controller
         meshes always do), reassembly is local.  Otherwise each surviving
-        process contributes the shards :func:`reconstruction_plan` makes
-        it the provider of, and ONE ``SUM`` allreduce over the *current*
+        process contributes the shards :meth:`restore_plan` (the provider
+        plan over the commit's recorded placement table) makes it the
+        provider of, and ONE ``SUM`` allreduce over the *current*
         (post-shrink) comm reassembles the full buffer on every rank —
         the exchange runs over the new world, never the revoked one.
 
@@ -1486,7 +1996,7 @@ class ShardStore:
         # matters when shards must move: a process holding every shard —
         # single-controller meshes always do — reassembles locally even
         # when a whole contiguous replica block died (row-shrink)
-        plan = (reconstruction_plan(dead, k, self.redundancy)
+        plan = (plan_from_placement(dead, self._rec_placement(rec))
                 if need_remote else {})
         if rec.get("cold"):
             _meter("elastic.cold_restores")
@@ -1771,10 +2281,10 @@ def reassemble_from_stores(stores: Dict[int, "ShardStore"],
     survivors = {r: s for r, s in stores.items() if r not in dead}
     if not survivors:
         raise RankFailure(dead, "no surviving stores")
-    rec = next(iter(survivors.values()))._require_commit()
+    first = next(iter(survivors.values()))
+    rec = first._require_commit()
     k, shard = rec["k"], rec["shard"]
-    redundancy = next(iter(survivors.values())).redundancy
-    plan = reconstruction_plan(dead, k, redundancy)
+    plan = plan_from_placement(dead, first._rec_placement(rec))
     buf = np.zeros((k * shard,), np.uint8)
     for s, provider in plan.items():
         prec = survivors[provider]._require_commit()
@@ -2475,10 +2985,12 @@ def _recover(rf: RankFailure, store: ShardStore):
     if store.multiprocess():
         bs = store.bootstrap
         my_rank = int(bs["process_id"])
-        failed = exchange_suspects(
+        failed = negotiate_failed(
             my_rank, world, rf.suspects, bs["host"],
-            int(bs.get("agree_port_base",
-                       int(bs["port_base"]) + 1000))
+            agree_port_no=agree_port(int(bs["port_base"]),
+                                     current_epoch()),
+            gossip_port_base=int(bs.get("agree_port_base",
+                                        int(bs["port_base"]) + 1000))
             + 17 * wrapped_epoch(current_epoch()),
             timeout=float(bs.get("agree_timeout", 20.0)),
         )
@@ -2520,8 +3032,9 @@ def _recover(rf: RankFailure, store: ShardStore):
     if store.multiprocess():
         # raises RankFailure when a shard lost its whole replica set —
         # only meaningful when shards must move between processes (a
-        # single controller holds every shard and restores locally)
-        reconstruction_plan(removed, world, store.redundancy)
+        # single controller holds every shard and restores locally);
+        # judged against the placement table recorded on the commit
+        store.restore_plan(removed)
 
     revoke_epoch(removed, rank=my_rank, world=world)
     if store.multiprocess():

@@ -22,10 +22,10 @@ Spec grammar (``MPI4JAX_TPU_FAULT_SPEC``, full reference in
 docs/resilience.md)::
 
     spec    := clause (';' clause)*
-    clause  := verb (':' arg)*
+    clause  := verb (':' arg)* | 'die-host' ':' host ['@' op#]
     verb    := 'delay' | 'die' | 'hang' | 'corrupt' | 'preempt'
     arg     := 'nan' | 'inf' | key '=' value      # bare modes only for corrupt
-    key     := 'rank' | 'op' | 'after' | 'secs' | 'grace'
+    key     := 'rank' | 'host' | 'op' | 'after' | 'secs' | 'grace'
 
 Examples::
 
@@ -36,11 +36,22 @@ Examples::
     preempt:rank=3:after=4:grace=2             # rank 3 gets a drain notice in
                                                # its 5th collective (2s ack
                                                # grace)
+    die-host:1@3                               # every rank the topology maps
+                                               # to host 1 exits in its 4th
+                                               # collective (== die:host=1
+                                               # :after=3) — the host-row kill
 
 Semantics:
 
 - ``rank`` is the GLOBAL mesh rank (row-major over the comm's full axes);
   omitted = every rank.
+- ``host`` scopes a clause to every rank the ``MPI4JAX_TPU_TOPOLOGY``
+  spec maps to that host (mutually exclusive with ``rank``) — the
+  injection point for host-level failures, so the chaos drills and the
+  CI faults lane express a whole-host kill through one clause.
+  ``die-host:<h>[@<op#>]`` is shorthand for ``die:host=<h>[:after=<op#>]``.
+  Without a declared topology a host clause matches nothing (warns once:
+  a drill that silently no-ops would report false confidence).
 - ``op`` is the lowercase op name as dispatched (``allreduce``, ``barrier``,
   ...); omitted = every op.
 - ``after=N``: the first N matching calls (counted per rank, at run time —
@@ -70,19 +81,21 @@ import os
 import sys
 import threading
 import time
+import warnings
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
 _VERBS = ("delay", "die", "hang", "corrupt", "preempt")
-_KEYS = ("rank", "op", "after", "secs", "grace")
+_KEYS = ("rank", "host", "op", "after", "secs", "grace")
 _MODES = ("nan", "inf")
 
 _GRAMMAR = (
     "expected 'verb[:arg]*' clauses joined by ';', verb in "
     f"{_VERBS}, args 'key=value' with key in {_KEYS} (plus a bare "
     f"mode in {_MODES} for corrupt; 'secs' only for delay, 'grace' "
-    "only for preempt) — e.g. "
-    "'delay:rank=1:op=allreduce:after=3:secs=2'"
+    "only for preempt; 'rank' and 'host' are mutually exclusive), or "
+    "the host-kill shorthand 'die-host:<h>[@<op#>]' — e.g. "
+    "'delay:rank=1:op=allreduce:after=3:secs=2' or 'die-host:1@3'"
 )
 
 
@@ -93,6 +106,7 @@ class FaultClause:
     verb: str
     mode: Optional[str] = None  # corrupt only: 'nan' | 'inf'
     rank: Optional[int] = None  # global rank; None = all ranks
+    host: Optional[int] = None  # topology host id; None = no host scope
     op: Optional[str] = None    # lowercase dispatch op name; None = all ops
     after: int = 0
     secs: float = 1.0           # delay only
@@ -102,12 +116,16 @@ class FaultClause:
         return self.op is None or self.op == opname
 
     def canonical(self) -> str:
-        """Canonical spec string; ``parse_fault_spec`` round-trips it."""
+        """Canonical spec string; ``parse_fault_spec`` round-trips it
+        (the ``die-host`` shorthand canonicalizes to its ``die:host=``
+        long form)."""
         parts = [self.verb]
         if self.verb == "corrupt":
             parts.append(self.mode or "nan")
         if self.rank is not None:
             parts.append(f"rank={self.rank}")
+        if self.host is not None:
+            parts.append(f"host={self.host}")
         if self.op is not None:
             parts.append(f"op={self.op}")
         if self.after:
@@ -122,6 +140,24 @@ class FaultClause:
 def _parse_clause(text: str) -> FaultClause:
     fields = [f.strip() for f in text.split(":")]
     verb = fields[0]
+    if verb == "die-host":
+        # shorthand: die-host:<h>[@<op#>] == die:host=<h>[:after=<op#>]
+        if len(fields) != 2 or not fields[1]:
+            raise ValueError(
+                f"fault spec clause {text!r}: die-host takes exactly "
+                f"'<host>[@<op#>]'; {_GRAMMAR}")
+        h_s, sep, after_s = fields[1].partition("@")
+        try:
+            host = int(h_s)
+            after = int(after_s) if sep else 0
+        except ValueError as e:
+            raise ValueError(
+                f"fault spec clause {text!r}: bad die-host operand "
+                f"{fields[1]!r}; {_GRAMMAR}") from e
+        if host < 0 or after < 0:
+            raise ValueError(
+                f"fault spec clause {text!r}: host and op# must be >= 0")
+        return FaultClause(verb="die", host=host, after=after)
     if verb not in _VERBS:
         raise ValueError(
             f"fault spec clause {text!r}: unknown verb {verb!r}; {_GRAMMAR}"
@@ -150,6 +186,8 @@ def _parse_clause(text: str) -> FaultClause:
         try:
             if key == "rank":
                 kw["rank"] = int(value)
+            elif key == "host":
+                kw["host"] = int(value)
             elif key == "after":
                 kw["after"] = int(value)
             elif key == "secs":
@@ -162,6 +200,14 @@ def _parse_clause(text: str) -> FaultClause:
             raise ValueError(
                 f"fault spec clause {text!r}: bad value for {key}: {value!r}"
             ) from e
+    if "rank" in kw and "host" in kw:
+        raise ValueError(
+            f"fault spec clause {text!r}: 'rank' and 'host' are mutually "
+            "exclusive (a host clause already names every rank on that "
+            "host)"
+        )
+    if kw.get("host") is not None and kw["host"] < 0:
+        raise ValueError(f"fault spec clause {text!r}: host must be >= 0")
     if verb != "delay" and "secs" in kw:
         raise ValueError(
             f"fault spec clause {text!r}: 'secs' only applies to delay"
@@ -245,6 +291,37 @@ _state = _FaultState()
 def reset_fault_state() -> None:
     """Forget all per-rank trigger counts (test isolation)."""
     _state.reset()
+    global _warned_no_topology
+    _warned_no_topology = False
+
+
+_warned_no_topology = False
+
+
+def _rank_on_host(rank: int, host: int) -> bool:
+    """Whether the declared ``MPI4JAX_TPU_TOPOLOGY`` spec maps ``rank``
+    to ``host``.  No spec (or a rank past the spec's coverage) matches
+    nothing — with a one-time warning, because a host-scoped drill that
+    silently no-ops would report false confidence."""
+    from ..utils import config
+
+    counts = config.parse_topology_spec(config.topology_spec())
+    if counts is None:
+        global _warned_no_topology
+        if not _warned_no_topology:
+            _warned_no_topology = True
+            warnings.warn(
+                "fault spec uses a host-scoped clause but "
+                "MPI4JAX_TPU_TOPOLOGY is not set — the clause matches no "
+                "rank (set the topology spec so host ids are defined)",
+                RuntimeWarning, stacklevel=3)
+        return False
+    edge = 0
+    for h, c in enumerate(counts):
+        edge += c
+        if rank < edge:
+            return h == host
+    return False
 
 
 def _fault_line(rank: int, text: str) -> None:
@@ -272,6 +349,8 @@ def probe_host(indexed_clauses, mpi_name: str, rank) -> int:
     mask = 0
     for bit, clause in indexed_clauses:
         if clause.rank is not None and clause.rank != r:
+            continue
+        if clause.host is not None and not _rank_on_host(r, clause.host):
             continue
         if _state.bump(clause, r) <= clause.after:
             continue

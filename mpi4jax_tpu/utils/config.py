@@ -35,6 +35,8 @@ COLLECTIVE_ALGOS = ("auto", "butterfly", "ring", "hier")
 TELEMETRY_MODES = ("off", "counters", "events")
 FUSION_MODES = ("off", "auto", "force")
 ELASTIC_FAIL_UNITS = ("rank", "row", "col")
+ELASTIC_PLACEMENTS = ("stripe", "neighbor")
+ELASTIC_AGREEMENTS = ("coordinator", "gossip")
 
 # default fusion bucket: 4 MiB — large enough that a typical optimizer
 # step's small gradient leaves coalesce into a handful of collectives,
@@ -67,8 +69,9 @@ DEFAULT_ELASTIC_REDUNDANCY = 1
 # that churns through hundreds of epochs stays inside a declared
 # span-wide window instead of walking out of the ephemeral port range.
 # 64 keeps the wrapped ports identical to the unwrapped pre-span scheme
-# for the first 64 epochs while bounding the footprint at 4*span ports
-# (coordinator / join / two control banks — resilience/elastic.py).
+# for the first 64 epochs while bounding the footprint at 5*span ports
+# (coordinator / join / two control banks / agreement listener —
+# resilience/elastic.py).
 DEFAULT_ELASTIC_PORT_SPAN = 64
 
 # default seconds a draining (preempted) rank waits for its peers to
@@ -191,6 +194,31 @@ FLAGS = {
              "meshes shrink structurally instead of erroring "
              "(docs/resilience.md 'Grow and graceful drain').",
              choices=ELASTIC_FAIL_UNITS),
+        Flag("MPI4JAX_TPU_ELASTIC_PLACEMENT", "choice", "stripe",
+             "Shard-replica placement policy for the elastic ShardStore "
+             "(resilience/elastic.py): ``stripe`` (default) consults the "
+             "host topology so every replica lands on a different host "
+             "than the shard's owner — a whole-host loss leaves >=1 live "
+             "copy of every shard whenever redundancy >= 1 and hosts >= "
+             "2; ``neighbor`` is the classic ring (shard s on ranks "
+             "s..s+redundancy mod k).  Without topology information "
+             "stripe degrades to neighbor.  Host-side only (never folded "
+             "into compiled-program cache keys) but MUST match across "
+             "processes — commits record the table in force, and "
+             "restores follow the recorded table "
+             "(docs/resilience.md 'Replica placement').",
+             choices=ELASTIC_PLACEMENTS),
+        Flag("MPI4JAX_TPU_ELASTIC_AGREEMENT", "choice", "coordinator",
+             "Failure-agreement transport (resilience/elastic.py): "
+             "``coordinator`` (default) routes suspect reports through "
+             "the epoch coordinator (rank 0) — O(k) connections, with "
+             "automatic degradation to peer gossip when the coordinator "
+             "is itself a suspect or unreachable; ``gossip`` forces the "
+             "all-pairs O(k^2) peer exchange everywhere.  Both converge "
+             "to the same pure gossip_agreement fixpoint.  Host-side "
+             "only but MUST match across processes "
+             "(docs/resilience.md 'Failure agreement').",
+             choices=ELASTIC_AGREEMENTS),
         Flag("MPI4JAX_TPU_ELASTIC_PORT_SPAN", "int",
              DEFAULT_ELASTIC_PORT_SPAN,
              "Width of the per-epoch elastic port window: epoch e's "
@@ -757,6 +785,20 @@ def elastic_fail_unit() -> str:
     (``MPI4JAX_TPU_ELASTIC_FAIL_UNIT``): ``rank`` (default) / ``row`` /
     ``col`` — see parallel/mesh.shrink_world_mesh."""
     return _parse_env_choice("MPI4JAX_TPU_ELASTIC_FAIL_UNIT")
+
+
+def elastic_placement() -> str:
+    """Shard-replica placement policy
+    (``MPI4JAX_TPU_ELASTIC_PLACEMENT``): ``stripe`` (default) /
+    ``neighbor`` — see resilience/elastic.py stripe_placement."""
+    return _parse_env_choice("MPI4JAX_TPU_ELASTIC_PLACEMENT")
+
+
+def elastic_agreement() -> str:
+    """Failure-agreement transport
+    (``MPI4JAX_TPU_ELASTIC_AGREEMENT``): ``coordinator`` (default) /
+    ``gossip`` — see resilience/elastic.py negotiate_failed."""
+    return _parse_env_choice("MPI4JAX_TPU_ELASTIC_AGREEMENT")
 
 
 def elastic_port_span() -> int:

@@ -1,0 +1,196 @@
+"""Chaos-drill harness suite (resilience/drill.py + the committed
+BENCH_elastic.json).
+
+Runs under the isolated loader (no mpi4jax_tpu import, any JAX): the
+drills are pure simulation by design.  Tier-1 covers the 8/16-rank
+matrix and the two host-row acceptance topologies; the 64-rank matrix
+and the committed-artifact reproducibility diff ride the slow tier
+(the CI ``elastic-drill`` step).
+"""
+
+import importlib
+import json
+import pathlib
+import subprocess
+import sys
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_drill_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "resilience"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in (
+        "utils.config",
+        "resilience.faultinject",
+        "resilience.retry",
+        "resilience.watchdog",
+        "resilience.elastic",
+        "resilience.drill",
+    ):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+drill = ISO.resilience.drill
+el = ISO.resilience.elastic
+
+
+# ---------------------------------------------------------------------------
+# harness plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_default_counts_are_square_uniform_splits():
+    assert drill.default_counts(8) == (4, 4)
+    assert drill.default_counts(16) == (4, 4, 4, 4)
+    assert drill.default_counts(64) == (8,) * 8
+    assert sum(drill.default_counts(12)) == 12
+    with pytest.raises(ValueError):
+        drill.default_counts(0)
+
+
+def test_kill_sets_per_pattern():
+    counts = (4, 4)
+    assert drill.kill_set("single", 8, counts) == (4,)
+    assert drill.kill_set("coordinator", 8, counts) == (0,)
+    assert drill.kill_set("host-row", 8, counts) == (4, 5, 6, 7)
+    assert drill.kill_set("double", 8, counts) == (4,)
+    with pytest.raises(ValueError, match="unknown drill pattern"):
+        drill.kill_set("meteor", 8, counts)
+    with pytest.raises(ValueError, match=">= 2 hosts"):
+        drill.kill_set("host-row", 8, (8,))
+
+
+def test_links_for_cuts_exactly_the_dead():
+    links = drill.links_for(4, {2})
+    for i in range(4):
+        for j in range(4):
+            expect = i != j and 2 not in (i, j)
+            assert links[i][j] is expect
+
+
+def test_agreement_connection_cost_model():
+    # live coordinator: one dial per non-coordinator survivor
+    assert drill.agreement_connections(64, {7}, "coordinator") == 62
+    # dead coordinator: failed probes + the gossip fallback
+    dead0 = drill.agreement_connections(8, {0}, "coordinator")
+    gossip = drill.agreement_connections(8, {0}, "gossip")
+    assert dead0 == 7 + gossip
+    assert gossip == 2 * 7 * 7
+    with pytest.raises(ValueError):
+        drill.agreement_connections(8, (), "quorum")
+
+
+# ---------------------------------------------------------------------------
+# the drill matrix (8/16 in tier-1; 64 on the slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pattern", drill.PATTERNS)
+@pytest.mark.parametrize("k", [8, 16])
+def test_drill_patterns_pass_at_small_scale(pattern, k):
+    m = drill.run_drill(pattern, k)
+    assert m["recovered"] is True
+    assert m["killed"]
+    # O(k) star whenever the coordinator survived the first wave
+    if pattern != "coordinator":
+        assert m["agreement"]["coordinator_connections"] <= k
+    if pattern == "host-row":
+        assert m["neighbor_unrecoverable"] is True
+    if pattern == "double":
+        assert m["epochs"] == 2
+        assert m["wave2"]["coordinator_connections"] <= m["wave2"]["k"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("pattern", drill.PATTERNS)
+def test_drill_matrix_at_64_ranks(pattern):
+    m = drill.run_drill(pattern, 64)
+    assert m["recovered"] is True
+    if pattern != "coordinator":
+        assert m["agreement"]["coordinator_connections"] <= 64
+        # the O(k) vs O(k^2) contrast the PR exists for (a dead
+        # coordinator deliberately pays probes + the gossip fallback)
+        assert m["agreement"]["gossip_connections"] \
+            > 50 * max(1, m["agreement"]["coordinator_connections"])
+
+
+def test_host_row_acceptance_2x4_and_4x2():
+    """The acceptance criterion verbatim: host-row kill at 2x4 and 4x2
+    restores every shard with the stripe and assertedly fails under the
+    old neighbor placement."""
+    for counts in ((4, 4), (2, 2, 2, 2)):
+        m = drill.run_drill("host-row", sum(counts), counts=counts)
+        assert m["recovered"] is True
+        assert m["neighbor_unrecoverable"] is True
+        # and the same kill under neighbor placement cannot even plan
+        host_of = [h for h, c in enumerate(counts) for _ in range(c)]
+        row = {r for r in range(sum(counts)) if host_of[r] == 1}
+        with pytest.raises(el.RankFailure, match="unrecoverable"):
+            el.plan_from_placement(
+                row, el.neighbor_placement(sum(counts), 1))
+
+
+def test_drill_asserts_when_restore_would_be_impossible():
+    # running the host-row drill UNDER neighbor placement must fail
+    # loudly (the harness refuses to report a drill it cannot restore)
+    with pytest.raises(el.RankFailure, match="unrecoverable"):
+        drill.run_drill("host-row", 8, placement="neighbor")
+
+
+def test_drill_matrix_is_deterministic():
+    a = drill.drill_matrix(ks=(8,), patterns=("single", "host-row"))
+    b = drill.drill_matrix(ks=(8,), patterns=("single", "host-row"))
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact
+# ---------------------------------------------------------------------------
+
+
+def test_bench_elastic_committed_payload_invariants():
+    payload = json.loads((REPO / "BENCH_elastic.json").read_text())
+    assert payload["schema"] == "mpx-elastic-drill/1"
+    ks = [row["k"] for row in payload["per_k"]]
+    assert ks == [8, 16, 64]
+    for row in payload["per_k"]:
+        # O(k) connections, against the O(k^2) gossip baseline
+        assert row["coordinator_connections_max"] <= row["k"]
+        assert row["gossip_connections"] >= row["k"] * (row["k"] - 1)
+    proof = {p["topology"]: p for p in payload["host_row_proof"]}
+    assert set(proof) == {"2x4", "4x2"}
+    for p in proof.values():
+        assert p["stripe_recovered"] and p["neighbor_unrecoverable"]
+    # per-survivor repair bytes stay ~flat (here: strictly non-growing)
+    per_rank = [row["repair_bytes_per_survivor_single"]
+                for row in payload["per_k"]]
+    assert per_rank == sorted(per_rank, reverse=True)
+
+
+@pytest.mark.slow
+def test_bench_elastic_reproduces_byte_identically(tmp_path):
+    """CI's committed-artifact gate, as a test: regenerating the drill
+    payload must reproduce the committed BENCH_elastic.json exactly."""
+    out = tmp_path / "BENCH_elastic.json"
+    subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "elastic_drill.py"),
+         "--out", str(out)],
+        check=True, cwd=str(REPO), timeout=300)
+    assert out.read_text() == (REPO / "BENCH_elastic.json").read_text()

@@ -63,6 +63,7 @@ def _load_isolated():
         "resilience.retry",
         "resilience.watchdog",
         "resilience.elastic",
+        "resilience.drill",
         "resilience.runtime",
     ):
         importlib.import_module(f"{_ISO_NAME}.{mod}")
@@ -95,6 +96,9 @@ def _clean_state():
             "MPI4JAX_TPU_DRAIN_GRACE_S",
             "MPI4JAX_TPU_ELASTIC_FAIL_UNIT",
             "MPI4JAX_TPU_ELASTIC_PORT_SPAN",
+            "MPI4JAX_TPU_ELASTIC_PLACEMENT",
+            "MPI4JAX_TPU_ELASTIC_AGREEMENT",
+            "MPI4JAX_TPU_TOPOLOGY",
         )
     }
     yield
@@ -1411,3 +1415,397 @@ def test_suspend_expiries_masks_detection_and_nests():
         assert reg.check_expired() is None     # still inside the outer
     assert reg.check_expired() is not None     # coverage resumes
     assert reg.drain() == 1
+
+
+# ---------------------------------------------------------------------------
+# striped replica placement (PR 16 tentpole a)
+# ---------------------------------------------------------------------------
+
+
+def test_stripe_placement_goldens_2x4_4x2_8x1():
+    # 2 hosts x 4 ranks, redundancy 1: the replica always lands exactly
+    # one host over, same local index — (s, s+4 mod 8)
+    assert el.stripe_placement(8, 1, (4, 4)) == tuple(
+        (s, (s + 4) % 8) for s in range(8))
+    # 4 hosts x 2 ranks, redundancy 1: one host over, same local slot
+    assert el.stripe_placement(8, 1, (2, 2, 2, 2)) == tuple(
+        (s, (s + 2) % 8) for s in range(8))
+    # 8 hosts x 1 rank: every rank is its own host — the stripe IS the
+    # neighbor ring
+    assert el.stripe_placement(8, 1, (1,) * 8) == el.neighbor_placement(8, 1)
+    # redundancy 2 on 4x2: replicas on the next TWO hosts over
+    assert el.stripe_placement(8, 2, (2, 2, 2, 2))[0] == (0, 2, 4)
+
+
+def test_stripe_placement_degrades_to_neighbor_without_topology():
+    for k, r in ((8, 1), (5, 2), (3, 0)):
+        assert el.stripe_placement(k, r, None) == el.neighbor_placement(k, r)
+        # single host: nothing to stripe over
+        assert el.stripe_placement(k, r, (k,)) == el.neighbor_placement(k, r)
+
+
+def test_stripe_placement_accepts_spec_strings_and_topology_objects():
+    assert el.stripe_placement(8, 1, "2x4") == el.stripe_placement(
+        8, 1, (4, 4))
+
+    class _Topo:
+        host_of_rank = (0, 0, 0, 0, 1, 1, 1, 1)
+
+    assert el.stripe_placement(8, 1, _Topo()) == el.stripe_placement(
+        8, 1, (4, 4))
+    # a topology that does not cover k ranks is ignored (neighbor)
+    assert el.stripe_placement(8, 1, (4, 3)) == el.neighbor_placement(8, 1)
+
+
+@pytest.mark.parametrize("counts", [
+    (4, 4), (2, 2, 2, 2), (3, 5), (1, 7), (2, 3, 3), (4, 4, 4, 4),
+    (8, 8, 8, 8, 8, 8, 8, 8),
+])
+@pytest.mark.parametrize("redundancy", [1, 2])
+def test_stripe_placement_survives_any_single_host_loss(counts, redundancy):
+    """The proof-style property: redundancy >= 1 and hosts >= 2 =>
+    every single-host loss leaves every shard a live copy."""
+    k = sum(counts)
+    table = el.stripe_placement(k, redundancy, counts)
+    host_of = [h for h, c in enumerate(counts) for _ in range(c)]
+    # structure: owner first, all holders distinct, owner's replicas
+    # off-host while hosts allow
+    hosts = len(counts)
+    for s, holders in enumerate(table):
+        assert holders[0] == s
+        assert len(set(holders)) == len(holders)
+        if redundancy < hosts:
+            assert len({host_of[r] for r in holders}) == len(holders), (
+                s, holders)
+    for h in range(hosts):
+        dead = {r for r in range(k) if host_of[r] == h}
+        assert el.placement_recoverable(dead, table), (h, dead)
+        plan = el.plan_from_placement(dead, table)
+        assert set(plan) == set(range(k))
+        assert all(p not in dead for p in plan.values())
+
+
+def test_stripe_placement_warns_and_degrades_when_redundancy_ge_hosts():
+    with pytest.warns(RuntimeWarning, match="redundancy 2 >= hosts 2"):
+        table = el.stripe_placement(8, 2, (4, 4))
+    # still recoverable after a single-host loss, and copies stay on
+    # distinct ranks
+    for holders in table:
+        assert len(set(holders)) == 3
+    assert el.placement_recoverable(set(range(4)), table)
+    assert el.placement_recoverable(set(range(4, 8)), table)
+
+
+def test_neighbor_placement_dies_on_host_row_where_stripe_survives():
+    """The PR's headline contrast, at both acceptance topologies."""
+    for counts in ((4, 4), (2, 2, 2, 2)):
+        k = sum(counts)
+        host_of = [h for h, c in enumerate(counts) for _ in range(c)]
+        row = {r for r in range(k) if host_of[r] == 1}
+        stripe = el.stripe_placement(k, 1, counts)
+        assert el.placement_recoverable(row, stripe)
+        neighbor = el.neighbor_placement(k, 1)
+        assert not el.placement_recoverable(row, neighbor)
+        with pytest.raises(el.RankFailure, match="unrecoverable"):
+            el.plan_from_placement(row, neighbor)
+
+
+def test_reconstruction_plan_validates_placement_length():
+    with pytest.raises(ValueError, match="covers 4 shards, expected 8"):
+        el.reconstruction_plan({1}, 8, 1, el.neighbor_placement(4, 1))
+
+
+def test_shardstore_commit_records_stripe_and_restore_follows_it():
+    """Kill a whole host row; per-rank stores committed under the stripe
+    reassemble bit-identically — the end-to-end form of the golden."""
+    state = _state()
+    for counts in ((4, 4), (2, 2, 2, 2)):
+        k = sum(counts)
+
+        class _C:
+            def world_size(self, _k=k):
+                return _k
+
+        stores = {}
+        for r in range(k):
+            stores[r] = el.ShardStore(_C(), redundancy=1, rank=r,
+                                      topology=counts, placement="stripe")
+            stores[r].commit(4, state)
+        table = el.stripe_placement(k, 1, counts)
+        assert stores[0]._committed["placement"] == table
+        host_of = [h for h, c in enumerate(counts) for _ in range(c)]
+        row = {r for r in range(k) if host_of[r] == 1}
+        step, restored = el.reassemble_from_stores(stores, row)
+        assert step == 4
+        _assert_state_equal(state, restored)
+
+
+def test_shardstore_placement_mode_flag_and_override():
+    class _C:
+        def world_size(self):
+            return 8
+
+    # flag default is stripe; without topology the table degrades
+    store = el.ShardStore(_C(), redundancy=1, rank=0)
+    assert store.placement_mode() == "stripe"
+    assert store.placement_table(8) == el.neighbor_placement(8, 1)
+    os.environ["MPI4JAX_TPU_ELASTIC_PLACEMENT"] = "neighbor"
+    assert store.placement_mode() == "neighbor"
+    # constructor override beats the flag
+    store2 = el.ShardStore(_C(), redundancy=1, rank=0, placement="stripe",
+                           topology=(4, 4))
+    assert store2.placement_mode() == "stripe"
+    assert store2.placement_table(8) == el.stripe_placement(8, 1, (4, 4))
+    with pytest.raises(ValueError, match="placement"):
+        el.ShardStore(_C(), placement="diagonal")
+
+
+def test_shardstore_topology_flag_feeds_the_stripe():
+    class _C:
+        def world_size(self):
+            return 8
+
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "2x4"
+    store = el.ShardStore(_C(), redundancy=1, rank=0)
+    assert store.placement_table(8) == el.stripe_placement(8, 1, (4, 4))
+    # spec not covering k: ignored, neighbor fallback (never an error)
+    assert store.placement_table(6) == el.neighbor_placement(6, 1)
+
+
+def test_shardstore_non_divisible_sizes_restore_bit_identical():
+    """Satellite: shard sizes that do not divide the payload (padding
+    path) restore exactly, striped and neighbor alike."""
+    state = {"odd": np.arange(131, dtype=np.float64),   # 1048 bytes
+             "tiny": np.float32(7.0)}                   # + 4 -> 1052
+    for placement, counts in (("stripe", (3, 5)), ("neighbor", None)):
+        k = 8
+
+        class _C:
+            def world_size(self):
+                return 8
+
+        stores = {}
+        for r in range(k):
+            stores[r] = el.ShardStore(_C(), redundancy=2, rank=r,
+                                      topology=counts, placement=placement)
+            stores[r].commit(9, state)
+        rec = stores[0]._committed
+        assert rec["shard"] * k > rec["nbytes"]  # genuinely padded
+        step, restored = el.reassemble_from_stores(stores, {0, 5})
+        assert step == 9
+        np.testing.assert_array_equal(state["odd"], restored["odd"])
+        np.testing.assert_array_equal(state["tiny"], restored["tiny"])
+
+
+def test_describe_adopt_commit_carries_the_placement_table():
+    class _C:
+        def world_size(self):
+            return 8
+
+    os.environ["MPI4JAX_TPU_ELASTIC_GROW"] = "1"
+    store = el.ShardStore(_C(), redundancy=1, rank=0, topology=(4, 4))
+    store.commit(2, {"w": np.arange(8, dtype=np.float32)})
+    desc = store.describe_commit()
+    assert desc["placement"] == [list(h)
+                                 for h in el.stripe_placement(8, 1, (4, 4))]
+    joiner = el.ShardStore(_C(), redundancy=1, rank=7)
+    joiner.adopt_commit(desc)
+    assert joiner._committed["placement"] == el.stripe_placement(8, 1, (4, 4))
+    # restore_plan follows the RECORDED table, not current flags
+    os.environ["MPI4JAX_TPU_ELASTIC_PLACEMENT"] = "neighbor"
+    assert joiner.restore_plan({4}) == el.plan_from_placement(
+        {4}, el.stripe_placement(8, 1, (4, 4)))
+    # a description without a placement (older peer) falls back to the
+    # neighbor table
+    del desc["placement"]
+    joiner.adopt_commit(desc)
+    assert joiner._committed["placement"] == el.neighbor_placement(8, 1)
+
+
+# ---------------------------------------------------------------------------
+# gossip edge cases + coordinator agreement (PR 16 tentpole b)
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_agreement_rejects_out_of_range_suspects():
+    with pytest.raises(ValueError, match="outside the world"):
+        el.gossip_agreement({0: {9}}, _links(4))
+
+
+def test_gossip_agreement_unnamed_death_under_partition_converges():
+    """Satellite fix: rank 0 knows 3 died; rank 0 is ALSO partitioned
+    from 1 (so 1 hearsay-suspects 0 before reading its gossip).  The old
+    'skip suspected peers' rule lost {3} at rank 1 depending on
+    evaluation order; the inbox-union semantics must propagate it
+    through rank 2."""
+    links = _links(4, down=(3,), cut=[(0, 1)])
+    agreed = el.gossip_agreement({0: {3}, 1: set(), 2: set()}, links)
+    # every survivor converges on the SAME set (agreement), which names
+    # the true casualty 3 — the old rule could leave 1 missing {3}
+    # entirely — plus, conservatively, BOTH endpoints of the cut link
+    # (hearsay-transitive suspicion; 0 and 1 see themselves in the
+    # verdict and abort, the runtime's declared-failed-by-peers path)
+    assert agreed[0] == agreed[1] == agreed[2] == frozenset({0, 1, 3})
+
+
+def test_gossip_agreement_late_arriving_suspect_is_idempotent():
+    """A suspect learned only via hearsay must survive re-running the
+    fixpoint on the converged output (idempotence = convergence)."""
+    links = _links(6, down=(5,))
+    first = el.gossip_agreement({2: {4}}, links)
+    for r in range(5):
+        assert first[r] == frozenset({4, 5})
+    again = el.gossip_agreement(
+        {r: first[r] for r in range(5)}, links)
+    for r in range(5):
+        assert again[r] == first[r]
+
+
+def test_gossip_agreement_empty_suspects_everywhere_names_the_dead():
+    # nobody can NAME the casualty ("something died but unnamed"), and
+    # the survivor component is additionally partitioned pairwise — the
+    # link evidence alone must still converge the majority side
+    links = _links(5, down=(4,), cut=[(0, 1)])
+    agreed = el.gossip_agreement({r: set() for r in range(4)}, links)
+    # one identical verdict across the component, naming the true
+    # casualty plus both endpoints of the cut (conservative); the
+    # majority guard still passes for the untainted survivors
+    for r in range(4):
+        assert agreed[r] == frozenset({0, 1, 4}), (r, agreed[r])
+    assert el.majority_survives(agreed[2], 5) is False  # 2 of 5 left
+    # with a larger component the same cut keeps a working majority
+    big = el.gossip_agreement({r: set() for r in range(7)},
+                              _links(8, down=(7,), cut=[(0, 1)]))
+    assert big[2] == frozenset({0, 1, 7})
+    assert el.majority_survives(big[2], 8)
+
+
+@pytest.mark.parametrize("world,down,suspects", [
+    (8, (6, 7), {0: {6}, 1: {7}}),
+    (8, (5,), {}),
+    (4, (3,), {0: {3}, 1: set(), 2: set()}),
+    (16, (2, 9, 10), {4: {2}}),
+    (8, (0,), {3: {0}}),              # the coordinator itself dies
+    (8, (0, 4), {}),                  # coordinator + mid-world, unnamed
+])
+def test_coordinator_agreement_matches_gossip_fixpoint(
+        world, down, suspects):
+    """The arbiter pin: the O(k) star equals the gossip fixpoint for
+    every survivor, on every drill-shaped matrix — including when the
+    coordinator is among the dead (full degradation)."""
+    links = _links(world, down=down)
+    gossip = el.gossip_agreement(suspects, links)
+    coord = el.coordinator_agreement(suspects, links)
+    for r in range(world):
+        if r in down:
+            continue
+        assert coord[r] == gossip[r], (r, coord[r], gossip[r])
+        assert coord[r] == frozenset(down)
+
+
+def test_coordinator_agreement_locally_suspected_coordinator_degrades():
+    # rank 2 names the (live) coordinator a suspect: it must not park at
+    # rank 0; it degrades to gossip and conservatively suspects the star
+    links = _links(4)
+    out = el.coordinator_agreement({2: {0}}, links)
+    # star members converge on a verdict containing the degraded rank
+    assert out[0] == out[1] == out[3]
+    assert 2 in out[0]
+    # the degraded rank, gossiping alone against a masked star, suspects
+    # everyone else — conservative, resolved by the majority guard
+    assert out[2] == frozenset({0, 1, 3})
+    assert not el.majority_survives(out[2], 4)
+
+
+def test_coordinator_exchange_suspects_tcp_star_converges():
+    """The TCP star on localhost: 3 survivors of 4 (rank 3 dead), rank 2
+    with the empty 'unnamed' set, all converge on {3} — and the
+    coordinator answers every reporter with the same verdict."""
+    base = _free_port_base()
+    world = 4
+    suspects = {0: set(), 1: {3}, 2: set()}
+    results = {}
+
+    def worker(rank):
+        results[rank] = el.coordinator_exchange_suspects(
+            rank, world, suspects[rank], "localhost", base, timeout=5.0)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == {r: frozenset({3}) for r in range(3)}, results
+
+
+def test_coordinator_exchange_suspects_reporter_fails_without_listener():
+    base = _free_port_base()
+    with pytest.raises(RuntimeError, match="suspect report"):
+        el.coordinator_exchange_suspects(
+            1, 4, {3}, "localhost", base, timeout=0.6)
+
+
+def test_negotiate_failed_falls_back_to_gossip_when_coordinator_dead():
+    """Ranks 1 and 2 survive a 3-rank world whose coordinator (0) died:
+    the star phase times out and BOTH degrade to the gossip round,
+    agreeing on {0}."""
+    agree_base = _free_port_base()
+    gossip_base = _free_port_base()
+    results = {}
+
+    def worker(rank, suspects):
+        results[rank] = el.negotiate_failed(
+            rank, 3, suspects, "localhost",
+            agree_port_no=agree_base,
+            gossip_port_base=gossip_base,
+            timeout=4.0, mode="coordinator")
+
+    threads = [threading.Thread(target=worker, args=(1, {0})),
+               threading.Thread(target=worker, args=(2, set()))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == {1: frozenset({0}), 2: frozenset({0})}, results
+
+
+def test_negotiate_failed_gossip_mode_skips_the_star():
+    gossip_base = _free_port_base()
+    results = {}
+
+    def worker(rank, suspects):
+        results[rank] = el.negotiate_failed(
+            rank, 3, suspects, "localhost",
+            agree_port_no=1,  # invalid on purpose: must never be dialed
+            gossip_port_base=gossip_base,
+            timeout=5.0, mode="gossip")
+
+    threads = [threading.Thread(target=worker, args=(0, {2})),
+               threading.Thread(target=worker, args=(1, set()))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == {0: frozenset({2}), 1: frozenset({2})}, results
+
+
+def test_agreement_mode_flag_defaults_and_parses():
+    assert config.elastic_agreement() == "coordinator"
+    os.environ["MPI4JAX_TPU_ELASTIC_AGREEMENT"] = "gossip"
+    assert config.elastic_agreement() == "gossip"
+    os.environ["MPI4JAX_TPU_ELASTIC_AGREEMENT"] = "star"
+    with pytest.raises(ValueError):
+        config.elastic_agreement()
+    assert config.elastic_placement() == "stripe"
+
+
+def test_agree_port_gets_its_own_bank():
+    span = 64
+    a = el.agree_port(9000, 3, span)
+    assert a == 9000 + 4 * span + 3
+    # wraps within the span window like every other bank
+    assert el.agree_port(9000, span + 3, span) == a
+    # disjoint from coordinator/join/control banks for every epoch
+    assert a >= 9000 + 4 * span
+    assert el.control_port(9000, span - 1, 1, span) < 9000 + 4 * span

@@ -31,6 +31,7 @@ import sys
 import textwrap
 import time
 import types
+import warnings
 
 import pytest
 
@@ -104,6 +105,7 @@ def _clean_resilience_state():
             "MPI4JAX_TPU_WATCHDOG_TIMEOUT",
             "MPI4JAX_TPU_FAULT_SPEC",
             "MPI4JAX_TPU_CHECK_NUMERICS",
+            "MPI4JAX_TPU_TOPOLOGY",
         )
     }
     yield
@@ -240,6 +242,88 @@ def test_die_exits_process_with_code_13(monkeypatch):
     assert calls == []
     fi.probe_host(((0, c),), "MPI_Barrier", 3)
     assert calls == [13]
+
+
+# ---------------------------------------------------------------------------
+# host-scoped faults (PR 16 satellite: die-host / host=)
+# ---------------------------------------------------------------------------
+
+
+def test_die_host_shorthand_parses_to_the_canonical_long_form():
+    (c,) = fi.parse_fault_spec("die-host:1@3")
+    assert (c.verb, c.host, c.rank, c.after) == ("die", 1, None, 3)
+    assert c.canonical() == "die:host=1:after=3"
+    # round-trips through the long form
+    assert fi.parse_fault_spec(c.canonical()) == (c,)
+    # op# optional (fire immediately)
+    (c0,) = fi.parse_fault_spec("die-host:0")
+    assert (c0.host, c0.after) == (0, 0)
+    # host= composes with other verbs and keys
+    (cd,) = fi.parse_fault_spec("delay:host=1:op=allreduce:secs=0.5")
+    assert (cd.verb, cd.host, cd.op, cd.secs) == (
+        "delay", 1, "allreduce", 0.5)
+    assert cd.canonical() == "delay:host=1:op=allreduce:secs=0.5"
+
+
+@pytest.mark.parametrize("bad", [
+    "die-host:",                 # missing host
+    "die-host:one",              # non-integer host
+    "die-host:1@x",              # non-integer op#
+    "die-host:-1",               # negative host
+    "die-host:1@2:after=3",      # extra fields on the shorthand
+    "die:host=-2",               # negative host in long form
+    "die:rank=1:host=2",         # rank and host are mutually exclusive
+])
+def test_host_fault_rejects_bad_clauses(bad):
+    with pytest.raises(ValueError, match="fault spec clause"):
+        fi.parse_fault_spec(bad)
+
+
+def test_die_host_kills_every_rank_of_the_host(monkeypatch):
+    """With MPI4JAX_TPU_TOPOLOGY=2x4, die-host:1 fires for ranks 4..7
+    and no others — the host-row kill the drills script."""
+    calls = []
+    monkeypatch.setattr(fi.os, "_exit", lambda code: calls.append(code))
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "2x4"
+    (c,) = fi.parse_fault_spec("die-host:1")
+    indexed = ((0, c),)
+    for r in (0, 1, 2, 3):
+        fi.probe_host(indexed, "MPI_Barrier", r)
+    assert calls == []
+    for r in (4, 5, 6, 7):
+        fi.probe_host(indexed, "MPI_Barrier", r)
+    assert calls == [13, 13, 13, 13]
+    # a rank past the spec's coverage matches nothing
+    fi.probe_host(indexed, "MPI_Barrier", 11)
+    assert len(calls) == 4
+
+
+def test_die_host_after_counts_per_rank(monkeypatch):
+    calls = []
+    monkeypatch.setattr(fi.os, "_exit", lambda code: calls.append(code))
+    os.environ["MPI4JAX_TPU_TOPOLOGY"] = "4,4"
+    (c,) = fi.parse_fault_spec("die-host:0@2")
+    indexed = ((0, c),)
+    assert fi.probe_host(indexed, "MPI_Allreduce", 2) == 0  # clean 1
+    assert fi.probe_host(indexed, "MPI_Allreduce", 2) == 0  # clean 2
+    assert calls == []
+    fi.probe_host(indexed, "MPI_Allreduce", 2)              # call 3 fires
+    assert calls == [13]
+
+
+def test_host_fault_without_topology_matches_nothing_and_warns_once():
+    (c,) = fi.parse_fault_spec("corrupt:nan:host=0")
+    indexed = ((0, c),)
+    with pytest.warns(RuntimeWarning, match="MPI4JAX_TPU_TOPOLOGY"):
+        assert fi.probe_host(indexed, "MPI_Allreduce", 0) == 0
+    # warned once; later probes stay silent (and still match nothing)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert fi.probe_host(indexed, "MPI_Allreduce", 1) == 0
+    # reset re-arms the warning (test isolation)
+    fi.reset_fault_state()
+    with pytest.warns(RuntimeWarning):
+        fi.probe_host(indexed, "MPI_Allreduce", 0)
 
 
 # ---------------------------------------------------------------------------
@@ -417,6 +501,107 @@ def test_retry_nonretryable_and_giveup_escape_immediately():
 def test_retry_rejects_nonpositive_deadline():
     with pytest.raises(ValueError, match="deadline"):
         retry_mod.retry_with_backoff(lambda: None, deadline=0)
+
+
+def test_backoff_delay_pure_jitter_ceiling():
+    """The pure envelope (PR 16 satellite): exponential growth, an
+    explicit saturating cap, and overflow safety at absurd attempt
+    counts."""
+    assert retry_mod.backoff_delay(1) == 1.0
+    assert retry_mod.backoff_delay(3) == 4.0
+    assert retry_mod.backoff_delay(10) == 30.0          # capped
+    assert retry_mod.backoff_delay(10_000) == 30.0      # still capped
+    assert retry_mod.backoff_delay(
+        2, base_delay=0.05, factor=3.0, max_delay=1.0) == pytest.approx(0.15)
+    # base 0 = no backoff at all (and no inf * 0 NaN at huge attempts)
+    assert retry_mod.backoff_delay(10_000, base_delay=0.0) == 0.0
+    # factor 1 = constant
+    assert retry_mod.backoff_delay(7, factor=1.0, base_delay=2.0) == 2.0
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"attempt": 0},
+    {"attempt": -3},
+    {"base_delay": -1.0},
+    {"factor": 0.5},
+    {"max_delay": 0.0},
+    {"max_delay": -2.0},
+])
+def test_backoff_delay_validates_parameters(kwargs):
+    args = {"attempt": 1}
+    args.update(kwargs)
+    attempt = args.pop("attempt")
+    with pytest.raises(ValueError):
+        retry_mod.backoff_delay(attempt, **args)
+
+
+def test_retry_validates_backoff_shape_before_first_sleep():
+    calls = []
+
+    def fn():
+        calls.append(1)
+
+    with pytest.raises(ValueError, match="factor"):
+        retry_mod.retry_with_backoff(fn, factor=0.0, sleep=lambda s: None)
+    assert calls == []  # rejected up front, fn never ran
+
+
+def test_retry_jitter_sleeps_never_exceed_the_ceiling():
+    """The jitter-bounds pin: with the real RNG, every sleep drawn over
+    many failures stays within [0, backoff_delay(n)] — the stampede
+    guarantee the elastic agreement reporters rely on."""
+    sleeps = []
+    now = [0.0]
+
+    def sleep(s):
+        sleeps.append(s)
+        now[0] += 0.001   # virtual time: many attempts, tiny elapsed
+
+    with pytest.raises(RuntimeError):
+        retry_mod.retry_with_backoff(
+            _Flaky(10**6), what="stampede", deadline=1.0,
+            max_attempts=200, base_delay=0.01, max_delay=0.05,
+            factor=2.0, sleep=sleep, clock=lambda: now[0],
+        )
+    assert len(sleeps) == 199
+    for n, s in enumerate(sleeps, start=1):
+        assert 0.0 <= s <= retry_mod.backoff_delay(
+            n, base_delay=0.01, max_delay=0.05), (n, s)
+    # the cap binds: late sleeps never exceed max_delay even though
+    # 0.01 * 2^198 is astronomically larger
+    assert max(sleeps) <= 0.05
+
+
+def test_retry_exhaustion_reports_attempts_and_total_wait():
+    """Satellite pin: both exhaustion errors carry the attempt count AND
+    the total time spent sleeping between attempts."""
+    now = [0.0]
+
+    def sleep(s):
+        now[0] += s
+
+    with pytest.raises(RuntimeError) as exc_info:
+        retry_mod.retry_with_backoff(
+            _Flaky(10**6), what="agreement report", deadline=300.0,
+            max_attempts=4, base_delay=1.0, jitter=False,
+            sleep=sleep, clock=lambda: now[0],
+        )
+    msg = str(exc_info.value)
+    # 3 sleeps of 1, 2, 4 seconds before the 4th failure
+    assert "agreement report failed after 4 attempt(s)" in msg
+    assert "7.0s of it waiting between attempts" in msg
+    assert "max_attempts 4" in msg
+
+    now[0] = 0.0
+    with pytest.raises(RuntimeError) as exc_info:
+        retry_mod.retry_with_backoff(
+            _Flaky(10**6), what="agreement report", deadline=5.0,
+            base_delay=2.0, jitter=False, sleep=sleep,
+            clock=lambda: now[0],
+        )
+    msg = str(exc_info.value)
+    assert "deadline 5s" in msg
+    assert "waiting between attempts" in msg
 
 
 # ---------------------------------------------------------------------------
