@@ -457,6 +457,68 @@ def bench_overlap(comm, sizes_mb=(1, 4), iters=10, compute_dim=128):
     return rows
 
 
+def bench_compression(comm, sizes_mb=(0.25, 1, 4), topology="2x4",
+                      iters=3):
+    """The wire-codec sweep (``--compression-sweep``): one row per
+    {off, bf16, fp8} x payload cell, carrying
+
+    - the LOGICAL vs WIRE DCN bytes of the hierarchical allreduce
+      (the pinned PR-6 byte model x the codec byte math — exactly what
+      the telemetry logical/wire split records);
+    - the MODELED DCN-leg time through the alpha-beta cost model with
+      the codec priced in (``collective_cost(codec=...)``);
+    - the MEASURED round-trip max relative error of the codec on
+      synthetic gradient-scale data — the autotuner's
+      codec-vs-error-budget input (docs/compression.md).
+
+    The timing columns are modeled, not wall-clock: a single-host CI
+    mesh has no DCN, and the codec's win is a byte-count fact the cost
+    model prices — the convergence harness (BENCH_compress.json)
+    carries the measured accuracy half."""
+    from mpi4jax_tpu.analysis import costmodel
+    from mpi4jax_tpu.compress import roundtrip, wire_bytes
+    from mpi4jax_tpu.ops import _hierarchy
+    from mpi4jax_tpu.utils.config import parse_topology_spec
+
+    counts = parse_topology_spec(topology)
+    h, r = len(counts), counts[0]
+    k = h * r
+    model = costmodel.load_model()
+    rows = []
+    for mb in sizes_mb:
+        n_elems = max(1, int(mb * 1e6 / 4))
+        nbytes = n_elems * 4
+        logical = _hierarchy.hier_link_bytes("allreduce", nbytes, h, r)[1]
+        for codec in ("off", "bf16", "fp8"):
+            c = None if codec == "off" else codec
+            cost_c = costmodel.collective_cost(
+                "allreduce", "hier", nbytes, k, hosts=h, hier=(h, r),
+                codec=c)
+            if c is None:
+                err = 0.0
+            else:
+                err = 0.0
+                for i in range(iters):
+                    x = jax.random.normal(
+                        jax.random.PRNGKey(i), (n_elems,),
+                        jnp.float32) * 0.02
+                    y = roundtrip(x, c)
+                    denom = max(float(jnp.max(jnp.abs(x))), 1e-30)
+                    err = max(err,
+                              float(jnp.max(jnp.abs(y - x))) / denom)
+            rows.append({
+                "size_mb": round(nbytes / 1e6, 4),
+                "codec": codec,
+                "topology": topology,
+                "logical_dcn_bytes": int(logical),
+                "wire_dcn_bytes": int(wire_bytes(int(logical), c)),
+                "modeled_dcn_us": round(model.link_time_us(
+                    "dcn", cost_c.dcn.rounds, cost_c.dcn.nbytes), 2),
+                "rel_err": round(err, 8),
+            })
+    return rows
+
+
 def bench_dispatch(comm, sizes_kb=(0.004, 4, 64), iters=100):
     """The dispatch sweep (``--dispatch-sweep``): per-CALL overhead of
     the three execution surfaces for the SAME one-allreduce program —
@@ -797,6 +859,17 @@ def main():
     p.add_argument("--alltoall-sizes-mb", type=float, nargs="+",
                    default=[0.25, 1],
                    help="payload sizes for --alltoall-sweep (MB)")
+    p.add_argument("--compression-sweep", action="store_true",
+                   help="also run the wire-codec sweep (logical vs wire "
+                        "DCN bytes, modeled DCN-leg time, and measured "
+                        "round-trip error for {off,bf16,fp8} over a "
+                        "payload grid; docs/compression.md)")
+    p.add_argument("--compression-sizes-mb", type=float, nargs="+",
+                   default=[0.25, 1, 4],
+                   help="payload sizes for --compression-sweep (MB)")
+    p.add_argument("--compression-topology", default="2x4",
+                   help="modeled MPI4JAX_TPU_TOPOLOGY spec for "
+                        "--compression-sweep's DCN-leg byte math")
     p.add_argument("--dispatch-sweep", action="store_true",
                    help="also run the dispatch sweep (per-call overhead "
                         "of eager vs spmd vs mpx.compile-pinned for the "
@@ -879,6 +952,10 @@ def main():
                     tuple(args.alltoall_sizes_mb),
                     tuple(args.alltoall_topologies))
            if args.alltoall_sweep else None)
+    cp = (_section("compression", bench_compression, comm,
+                   tuple(args.compression_sizes_mb),
+                   args.compression_topology)
+          if args.compression_sweep else None)
     ds = (_section("dispatch", bench_dispatch, comm,
                    tuple(args.dispatch_sizes_kb), args.dispatch_iters)
           if args.dispatch_sweep else None)
@@ -919,6 +996,9 @@ def main():
     if a2a is not None:
         payload["alltoall"] = a2a
         payload["alltoall_topologies"] = list(args.alltoall_topologies)
+    if cp is not None:
+        payload["compression"] = cp
+        payload["compression_topology"] = args.compression_topology
     if ds is not None:
         payload["dispatch"] = ds
         # the AOT/persistent-cache counters are the sweep's provenance:
@@ -998,6 +1078,13 @@ def main():
             print(f"  {r['size_mb']:>10.4f} MB   {r['topology']:>8}"
                   f"   {r['flat_us']:>8.1f} us   {r['hier_us']:>8.1f} us"
                   f"   {r['async_us']:>8.1f} us   {sp}")
+    if cp is not None:
+        print("\ncompression sweep (f32)       codec  logical DCN"
+              "   wire DCN     modeled      max rel err")
+        for r in cp:
+            print(f"  {r['size_mb']:>10.4f} MB   {r['codec']:>4}"
+                  f"   {r['logical_dcn_bytes']:>10}   {r['wire_dcn_bytes']:>10}"
+                  f"   {r['modeled_dcn_us']:>8.2f} us   {r['rel_err']:.2e}")
     if ds is not None:
         print("\ndispatch sweep (SUM, f32)     eager        spmd"
               "         pinned       pinned vs spmd")

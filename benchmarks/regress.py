@@ -1,0 +1,130 @@
+"""Perf-regression ratchet: diff a fresh sweep against a committed
+baseline (ROADMAP item 5, first slice).
+
+Compares the MODELED/measured time columns (``*_us`` leaves by default)
+of a ``--current`` JSON payload — a ``benchmarks/micro.py --save``
+capture, a ``BENCH_*`` replay, anything with the same shape — against a
+``--baseline`` at identical paths, and exits nonzero when any column
+regressed by more than ``--threshold`` (default 10%).  Paths present on
+only one side are reported but never fail the run: sweeps grow new
+rows, and a ratchet that blocks additions teaches people to stop
+measuring.
+
+Positions are identity, not order: rows inside a list are keyed by
+their discriminating columns (size/topology/codec/count/...) when
+present, falling back to the list index, so inserting a payload point
+mid-grid does not misalign every later comparison.
+
+Run:  python benchmarks/regress.py --current new.json \
+          --baseline BENCH_alltoall.json [--threshold 0.10]
+
+Exit codes: 0 clean, 1 regression over threshold, 2 usage/IO error —
+the analysis CLI's contract.  Wired into the microbench CI smoke lane
+(.github/workflows/test.yml) over the committed replay artifacts.
+"""
+
+import argparse
+import json
+import sys
+
+# a list row's identity, built from whichever of these it carries (in
+# this order) — the discriminating axes every sweep in this repo uses
+ID_KEYS = ("op", "codec", "topology", "size_mb", "size_kb", "count",
+           "chunks", "unroll", "experts", "step")
+
+
+def _row_key(row, index):
+    if isinstance(row, dict):
+        ident = tuple((k, row[k]) for k in ID_KEYS if k in row)
+        if ident:
+            return ident
+    return index
+
+
+def collect(node, suffix, path=()):
+    """Flatten ``node`` to ``{path: value}`` over numeric leaves whose
+    final key ends with ``suffix``."""
+    out = {}
+    if isinstance(node, dict):
+        for k, v in node.items():
+            if isinstance(v, (dict, list)):
+                out.update(collect(v, suffix, path + (k,)))
+            elif (isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and k.endswith(suffix)):
+                out[path + (k,)] = float(v)
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            out.update(collect(v, suffix, path + (_row_key(v, i),)))
+    return out
+
+
+def compare(current, baseline, suffix="_us", threshold=0.10):
+    """Returns ``(regressions, improvements, only_current,
+    only_baseline)``; a regression is ``current > baseline * (1 +
+    threshold)`` with baseline > 0."""
+    cur = collect(current, suffix)
+    base = collect(baseline, suffix)
+    regressions, improvements = [], []
+    for path in sorted(set(cur) & set(base), key=str):
+        c, b = cur[path], base[path]
+        if b <= 0:
+            continue
+        ratio = c / b
+        if ratio > 1.0 + threshold:
+            regressions.append((path, b, c, ratio))
+        elif ratio < 1.0 - threshold:
+            improvements.append((path, b, c, ratio))
+    return (regressions, improvements,
+            sorted(set(cur) - set(base), key=str),
+            sorted(set(base) - set(cur), key=str))
+
+
+def _fmt(path):
+    return "/".join(str(p) for p in path)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--current", required=True,
+                    help="fresh sweep payload (micro.py --save / replay)")
+    ap.add_argument("--baseline", required=True,
+                    help="committed baseline (BENCH_*.json)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="allowed fractional slowdown (default 0.10)")
+    ap.add_argument("--suffix", default="_us",
+                    help="leaf-key suffix to compare (default _us)")
+    args = ap.parse_args(argv)
+    if args.threshold < 0:
+        print(f"regress: --threshold must be >= 0, got {args.threshold}",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(args.current) as f:
+            current = json.load(f)
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+    reg, imp, only_cur, only_base = compare(
+        current, baseline, suffix=args.suffix, threshold=args.threshold)
+    for path, b, c, ratio in reg:
+        print(f"REGRESSION {_fmt(path)}: {b:g} -> {c:g} "
+              f"({(ratio - 1) * 100:.1f}% slower)")
+    for path, b, c, ratio in imp:
+        print(f"improved   {_fmt(path)}: {b:g} -> {c:g} "
+              f"({(1 - ratio) * 100:.1f}% faster)")
+    if only_cur:
+        print(f"new (unchecked): {len(only_cur)} column(s), e.g. "
+              f"{_fmt(only_cur[0])}")
+    if only_base:
+        print(f"missing from current: {len(only_base)} column(s), e.g. "
+              f"{_fmt(only_base[0])}")
+    checked = len(collect(baseline, args.suffix))
+    print(f"regress: {len(reg)} regression(s) over "
+          f"{args.threshold:.0%} across {checked} baseline column(s)")
+    return 1 if reg else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,9 +8,22 @@ same jitted SPMD program as the backward pass — so XLA overlaps the
 gradient AllReduce with the remaining backward compute (the standard
 TPU DP pattern, here expressed through the MPI-style API).
 
-Run: python examples/data_parallel_training.py
+The gradient exchange goes through ``mpx.compress.ef_allreduce`` — the
+error-feedback form of the tree-mapped allreduce (docs/compression.md).
+With the knob off (the default) it IS the plain exact allreduce and the
+residual stays zero; under ``MPI4JAX_TPU_COMPRESS=bf16`` (or ``fp8``)
+the inter-host leg ships compressed and the residual carries each
+step's quantization error into the next — this file doubles as the
+convergence harness's measured lane (CI's ``compress`` job runs it
+per codec and asserts loss-curve parity against the exact run;
+the committed record is BENCH_compress.json).
+
+Run: python examples/data_parallel_training.py [--steps N] [--seed S]
+         [--out losses.json]
 """
 
+import argparse
+import json
 import os
 import sys
 import time
@@ -49,29 +62,31 @@ def local_loss(params, x, y):
 
 
 def make_train_step(comm: mpx.Comm, lr: float):
-    """One DP-SGD step: local grad -> allreduce(SUM)/size -> SGD update.
+    """One DP-SGD step: local grad -> EF allreduce(SUM)/size -> update.
 
     Weights enter replicated (identical on every rank, like the
     reference's per-process copies); the averaged gradient keeps them in
-    lock-step without any parameter broadcast.
+    lock-step without any parameter broadcast.  The residual is part of
+    the train state: zero (and dead code) with compression off, the
+    carried quantization error under bf16/fp8.
     """
     size = comm.Get_size()
 
     @mpx.spmd(comm=comm)
-    def train_step(params, x, y):
+    def train_step(params, residual, x, y):
         loss, grads = jax.value_and_grad(local_loss)(params, x, y)
-        # the fusion-friendly idiom (docs/overlap.md): issue EVERY
-        # allreduce first, then consume — under MPI4JAX_TPU_FUSION=auto
-        # the whole batch (per-leaf gradients + the scalar loss, all f32)
-        # coalesces into ONE flat-buffer collective; with fusion off the
-        # calls run one by one, same math either way
-        red = jax.tree.map(
-            lambda g: mpx.allreduce(g, op=mpx.SUM, comm=comm)[0], grads
-        )
-        loss = mpx.allreduce(loss, op=mpx.SUM, comm=comm)[0] / size
+        # the fusion-friendly idiom (docs/overlap.md) still holds: the
+        # EF allreduce issues every per-leaf collective before any is
+        # consumed — under MPI4JAX_TPU_FUSION=auto the batch coalesces
+        # into ONE flat-buffer collective; with fusion off the calls
+        # run one by one, same math either way
+        red, residual, token = mpx.compress.ef_allreduce(
+            grads, residual, op=mpx.SUM, comm=comm)
+        loss = mpx.allreduce(loss, op=mpx.SUM, comm=comm,
+                             token=token)[0] / size
         new_params = jax.tree.map(lambda p, g: p - lr * (g / size),
                                   params, red)
-        return mpx.varying((new_params, loss))
+        return mpx.varying((new_params, residual, loss))
 
     return train_step
 
@@ -81,7 +96,7 @@ def replicate(tree, size):
     return jax.tree.map(lambda v: jnp.tile(v[None], (size,) + (1,) * v.ndim), tree)
 
 
-def main(steps: int = 200, seed: int = 0):
+def main(steps: int = 200, seed: int = 0, out: str = ""):
     devices = jax.devices()
     size = len(devices)
     mesh = mpx.make_world_mesh(devices=devices)
@@ -96,7 +111,11 @@ def main(steps: int = 200, seed: int = 0):
     y = jnp.tanh(x @ w_true)
 
     params = replicate(init_mlp(key, (16, 64, 1)), size)
+    # the EF residual rides in the train state, one row per rank;
+    # exactly zero for the whole run when compression is off
+    residual = mpx.compress.ef_zeros_like(params)
     train_step = make_train_step(comm, lr=1e-2)
+    losses = []
 
     # coalesce the per-leaf gradient allreduces into one flat-buffer
     # collective per step (Horovod-style tensor fusion, docs/overlap.md);
@@ -105,9 +124,10 @@ def main(steps: int = 200, seed: int = 0):
     try:
         t0 = time.perf_counter()
         for step in range(steps):
-            params, loss = train_step(params, x, y)
+            params, residual, loss = train_step(params, residual, x, y)
+            losses.append(float(np.asarray(loss)[0]))
             if step % 50 == 0 or step == steps - 1:
-                print(f"step {step:4d}  loss {float(np.asarray(loss)[0]):.5f}")
+                print(f"step {step:4d}  loss {losses[-1]:.5f}")
         wall = time.perf_counter() - t0
     finally:
         mpx.set_fusion_mode(None)
@@ -117,10 +137,22 @@ def main(steps: int = 200, seed: int = 0):
         leaf = np.asarray(leaf)
         np.testing.assert_allclose(leaf, np.broadcast_to(leaf[0], leaf.shape),
                                    rtol=1e-6)
-    print(f"{steps} steps on {size} device(s) in {wall:.2f}s — "
-          f"weights in lock-step on all ranks")
+    mode = mpx.compress.compress_mode()
+    if out:
+        with open(out, "w") as f:
+            json.dump({"compress": mode, "steps": steps, "seed": seed,
+                       "world": size, "losses": losses}, f, indent=2)
+    print(f"{steps} steps on {size} device(s) in {wall:.2f}s "
+          f"(compress={mode}) — weights in lock-step on all ranks")
     return params
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="",
+                    help="write the per-step loss curve as JSON here "
+                         "(the compress lane's parity input)")
+    a = ap.parse_args()
+    main(steps=a.steps, seed=a.seed, out=a.out)

@@ -134,11 +134,20 @@ def _data_for(k, per_rank=32, dim=16, seed=1):
     return x, y
 
 
-def _make_elastic_step(mpx, lr=0.05):
+def _make_elastic_step(mpx, lr=0.05, store=None):
     """``step_fn(state, step, comm)`` for ``mpx.elastic.run``: builds (and
     caches) one SPMD program per comm — after a shrink the new comm gets a
     fresh program traced at the new size (the epoch in the cache key
-    guarantees the old one is unreachable anyway)."""
+    guarantees the old one is unreachable anyway).
+
+    The gradient exchange is ``mpx.compress.ef_allreduce`` with the
+    error-feedback residual COMMITTED as part of the state (one row per
+    rank): with ``MPI4JAX_TPU_COMPRESS=off`` it is the plain allreduce
+    and the residual stays zero; under bf16/fp8 a restore replays the
+    residual from the last commit, a shrink moves surviving rows to
+    their new ranks (``store.last_rank_map`` -> ``ef_reshard``), and a
+    cold joiner's row starts ZERO — never a dead rank's stale error
+    (docs/compression.md "Error feedback under elasticity")."""
     import jax
     import jax.numpy as jnp
 
@@ -150,20 +159,20 @@ def _make_elastic_step(mpx, lr=0.05):
             size = comm.Get_size()
 
             @mpx.spmd(comm=comm)
-            def train_step(params, x, y):
+            def train_step(params, residual, x, y):
                 def loss_fn(p, x, y):
                     h = jax.nn.relu(x @ p["w1"] + p["b1"])
                     pred = h @ p["w2"] + p["b2"]
                     return jnp.mean((pred - y) ** 2)
 
                 loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-                red = jax.tree.map(
-                    lambda g: mpx.allreduce(g, op=mpx.SUM, comm=comm)[0],
-                    grads)
-                loss = mpx.allreduce(loss, op=mpx.SUM, comm=comm)[0] / size
+                red, residual, token = mpx.compress.ef_allreduce(
+                    grads, residual, op=mpx.SUM, comm=comm)
+                loss = mpx.allreduce(loss, op=mpx.SUM, comm=comm,
+                                     token=token)[0] / size
                 new = jax.tree.map(lambda p, g: p - lr * (g / size),
                                    params, red)
-                return mpx.varying((new, loss))
+                return mpx.varying((new, residual, loss))
 
             programs[key] = train_step
         return programs[key]
@@ -173,21 +182,39 @@ def _make_elastic_step(mpx, lr=0.05):
             lambda v: jnp.tile(jnp.asarray(v)[None], (k,) + (1,) * v.ndim),
             tree)
 
+    def residual_for(state, params_g, k):
+        res = state.get("ef_residual")
+        if res is None:
+            return mpx.compress.ef_zeros_like(params_g)
+        old_k = int(np.shape(jax.tree.leaves(res)[0])[0])
+        if old_k == k:
+            return res
+        # a restore across a boundary: the committed residual's rows
+        # belong to the OLD world — move survivors, zero joiners
+        rmap = store.last_rank_map if store is not None else None
+        if rmap is None:
+            rmap = {r: r for r in range(min(old_k, k))}
+        return mpx.compress.ef_reshard(res, rmap, k)
+
     losses = []
 
     def step_fn(state, step, comm):
         k = comm.Get_size()
         x, y = _data_for(k)
         params_g = replicate(state["params"], k)
-        params_g, loss = train_step_for(comm)(params_g, x, y)
+        res = residual_for(state, params_g, k)
+        params_g, res, loss = train_step_for(comm)(params_g, res, x, y)
         loss = float(np.asarray(loss)[0])
         losses.append({"step": step, "world": k, "loss": loss,
                        "epoch": comm.epoch})
         print(f"step {step:3d}  world {k}  epoch {comm.epoch}  "
               f"loss {loss:.6f}", flush=True)
-        # state stays single-copy (replicated invariant: every rank's row
-        # is identical, row 0 is the canonical copy the ShardStore shards)
-        return {"params": jax.tree.map(lambda v: np.asarray(v[0]), params_g)}
+        # params stay single-copy (replicated invariant: every rank's row
+        # is identical, row 0 is the canonical copy the ShardStore
+        # shards); the residual is genuinely per-rank, so its full
+        # (k, ...) stack is the committed artifact
+        return {"params": jax.tree.map(lambda v: np.asarray(v[0]), params_g),
+                "ef_residual": jax.tree.map(np.asarray, res)}
 
     return step_fn, losses
 
@@ -210,7 +237,7 @@ def run_single(args):
         fail_at = None
 
     store = mpx.ShardStore(comm)
-    base_step, losses = _make_elastic_step(mpx)
+    base_step, losses = _make_elastic_step(mpx, store=store)
 
     def step_fn(state, step, comm):
         state = base_step(state, step, comm)
@@ -302,7 +329,7 @@ def run_worker(args):
         "num_processes": args.num_processes,
         "agree_port_base": args.port_base + 100,
     })
-    step_fn, losses = _make_elastic_step(mpx)
+    step_fn, losses = _make_elastic_step(mpx, store=store)
 
     state = {"params": _init_params()}
     state = mpx.elastic.run(step_fn, state, store, steps=args.steps,
@@ -325,7 +352,7 @@ def run_joiner(args):
         "port_base": args.port_base,
         "agree_port_base": args.port_base + 100,
     })
-    step_fn, losses = _make_elastic_step(mpx)
+    step_fn, losses = _make_elastic_step(mpx, store=store)
     mpx.elastic.join_and_run(step_fn, store, steps=args.steps,
                              commit_every=args.commit_every,
                              join_timeout=args.drill_timeout)

@@ -106,6 +106,8 @@ from .telemetry import set_telemetry_mode  # noqa: F401
 # the serving runtime (docs/serving.md): continuous batching under a
 # p99 latency SLO on the pinned megastep decode path
 from . import serving  # noqa: F401
+# wire compression + error feedback for the DCN leg (docs/compression.md)
+from . import compress  # noqa: F401
 # the tuning layer (docs/autotune.md): mpx.autotune() measures, the
 # config layer serves (default < tuning < env).  NOTE this rebinds the
 # package attribute `mpi4jax_tpu.autotune` to the FUNCTION — the
@@ -202,6 +204,8 @@ __all__ = [
     "set_telemetry_mode",
     # serving runtime (docs/serving.md)
     "serving",
+    # wire compression + error feedback (docs/compression.md)
+    "compress",
     # resilience (docs/resilience.md)
     "set_watchdog_timeout",
     "set_fault_spec",

@@ -730,6 +730,69 @@ def check_flat_alltoall_over_dcn(graph: CollectiveGraph) -> List[Finding]:
     return findings
 
 
+@checker("MPX138")
+def check_uncompressed_dcn(graph: CollectiveGraph) -> List[Finding]:
+    """Uncompressed above-crossover DCN traffic: a hierarchical
+    collective on a multi-host comm ships a float32 inter-host leg at
+    or above the DCN crossover while the wire codec layer
+    (``MPI4JAX_TPU_COMPRESS``, docs/compression.md) is off.
+
+    Fires only when the snapshot's compress mode is ``off`` — a trace
+    that already opted in but left THIS event exact (non-float32,
+    callable reduction, payload bucketed to ``off``) made a deliberate
+    choice the advisory must not second-guess.  Gates mirror MPX113:
+    ``hosts`` present (a plan was derivable), ``comm_size > hosts``
+    (a real intra level), and the modeled DCN-leg bytes — payload/r for
+    the reduction family, the full payload for alltoall — at or above
+    the (measured, when calibrated) DCN crossover.
+    """
+    if graph.meta.get("compress", "off") != "off":
+        return []
+    measured = graph.meta.get("measured_dcn_crossover_bytes")
+    crossover = measured or graph.meta.get("dcn_crossover_bytes")
+    if not crossover:
+        return []
+    cite = (
+        f"measured DCN crossover, {_calibration_cite(graph.meta)}"
+        if measured else "DCN crossover"
+    )
+    compressible = ("allreduce", "reduce_scatter", "alltoall",
+                    "allreduce_start", "reduce_scatter_start",
+                    "alltoall_start")
+    findings: List[Finding] = []
+    for e in graph.events:
+        if e.op not in compressible or e.algo != "hier":
+            continue
+        if getattr(e, "codec", None) is not None:
+            continue
+        if not e.hosts or e.hosts <= 1:
+            continue
+        if e.comm_size is None or e.comm_size <= e.hosts:
+            continue
+        if e.dtype not in ("", "float32"):
+            continue  # the codec layer only compresses float32
+        r = e.comm_size // e.hosts
+        leg = (e.payload_bytes if e.op.startswith("alltoall")
+               else -(-e.payload_bytes // max(r, 1)))
+        if leg < crossover:
+            continue
+        findings.append(Finding(
+            code="MPX138", op=e.op, index=e.index,
+            message=(f"{e.op} on comm {e.comm_uid} spans {e.hosts} hosts "
+                     f"({e.comm_size} ranks) and ships a {leg} B "
+                     f"float32 DCN leg uncompressed (>= the {crossover} "
+                     f"B {cite}): MPI4JAX_TPU_COMPRESS=bf16 would halve "
+                     "the wire bytes on that leg (fp8 would quarter "
+                     "them), ICI staying exact"),
+            suggestion=("opt in with MPI4JAX_TPU_COMPRESS=bf16 (not "
+                        "bit-identical — pair gradients with "
+                        "mpx.compress.ef_allreduce), or let "
+                        "mpx.autotune() sweep the codecs against the "
+                        "error budget — see docs/compression.md"),
+        ))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # perf advisory (MPX109)
 # ---------------------------------------------------------------------------

@@ -181,7 +181,8 @@ def sched_op_cost(op: SchedOp, world: int,
     preserve = (op.reduction is not None
                 and op.reduction not in ENUM_REDUCTIONS)
     return collective_cost(base, op.algo, nbytes, k, hosts=hosts,
-                           hier=op.hier, preserve=preserve)
+                           hier=op.hier, preserve=preserve,
+                           codec=getattr(op, "codec", None))
 
 
 # ---------------------------------------------------------------------------

@@ -485,10 +485,22 @@ def _flat_rounds(kind: str, algo: str, k: int) -> int:
     return 1  # native HLO: XLA schedules it; one logical round
 
 
+def _dcn_wire_bytes(nbytes: int, codec: Optional[str]) -> int:
+    """Post-codec bytes of a compressed DCN leg (docs/compression.md):
+    the codec layer's byte math, reused so the priced wire bytes can
+    never drift from what the lowering ships.  Identity for exact legs."""
+    if not codec or codec == "off":
+        return nbytes
+    from ..ops import _codec
+
+    return _codec.wire_bytes(nbytes, codec)
+
+
 def collective_cost(op: str, algo: Optional[str], nbytes: int, k: int,
                     hosts: Optional[int] = None,
                     hier: Optional[Tuple[int, int]] = None,
-                    preserve: bool = False) -> OpCost:
+                    preserve: bool = False,
+                    codec: Optional[str] = None) -> OpCost:
     """Modeled per-rank cost of one collective of ``nbytes`` payload
     over a ``k``-rank group spanning ``hosts`` hosts.
 
@@ -500,6 +512,11 @@ def collective_cost(op: str, algo: Optional[str], nbytes: int, k: int,
     entirely on the DCN class — every round gated on the slowest hop,
     exactly MPX113's serialization — matching ``flat_link_bytes``'s
     attribution.
+
+    ``codec`` prices a wire-compressed DCN leg (docs/compression.md):
+    only the hierarchical lowerings compress, only their inter-host
+    bytes shrink — round counts, ICI bytes, and the gamma fold are the
+    logical payload's.
     """
     if k <= 1 or op in ("send", "recv", "sendrecv"):
         if op in ("send", "recv", "sendrecv"):
@@ -520,7 +537,8 @@ def collective_cost(op: str, algo: Optional[str], nbytes: int, k: int,
             intra_b, inter_b = hier_link_bytes(kind, nbytes, h, r, preserve)
             intra_r, inter_r = _hier_rounds(kind, nbytes, h, r, preserve)
             return OpCost(ici=LinkTerm(intra_r, intra_b),
-                          dcn=LinkTerm(inter_r, inter_b),
+                          dcn=LinkTerm(inter_r,
+                                       _dcn_wire_bytes(inter_b, codec)),
                           gamma_bytes=gamma)
         eff = algo if algo in ("butterfly", "ring") else "native"
         intra_b, inter_b = flat_link_bytes(kind, eff, nbytes, k, hosts,
@@ -546,7 +564,8 @@ def collective_cost(op: str, algo: Optional[str], nbytes: int, k: int,
             h, r = hier
             intra_b, inter_b = hier_link_bytes("alltoall", nbytes, h, r)
             return OpCost(ici=LinkTerm(r - 1 if r > 1 else 0, intra_b),
-                          dcn=LinkTerm(h - 1, inter_b))
+                          dcn=LinkTerm(h - 1,
+                                       _dcn_wire_bytes(inter_b, codec)))
         term = LinkTerm(k - 1, (k - 1) * chunk)  # nbytes = full buffer
     elif op == "gather":
         term = LinkTerm(rounds, (k - 1) * nbytes)  # binomial, per-block

@@ -63,6 +63,10 @@ class CollectiveEvent:
     # (hosts, ranks_per_host) of the two-level plan this op lowered with
     # (ops/_hierarchy.annotate_selection), compared across ranks (MPX125)
     hier: Optional[Tuple[int, int]] = None
+    # DCN-leg wire codec the hierarchy applied ("bf16" | "fp8"), None on
+    # exact lowerings (docs/compression.md) — prices the inter-host leg
+    # at wire bytes in the cost model and gates the MPX138 advisory
+    codec: Optional[str] = None
     # communication epoch the comm was built in (parallel/comm.py stamp;
     # resilience/elastic.py revocation) — compared against the CURRENT
     # epoch in graph.meta by the MPX126 checker

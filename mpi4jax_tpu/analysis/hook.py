@@ -131,6 +131,11 @@ def config_snapshot() -> dict:
         "epoch": current_epoch(),
         "pinned": pinned,
         "megastep": megastep,
+        # the DCN wire codec mode (docs/compression.md) — the MPX138
+        # gate reads it to tell "compression declined" from "never
+        # offered"; payload-bucketed tuned codecs resolve per event, so
+        # the snapshot records the unbucketed mode
+        "compress": config.compress_mode(),
     }
     if serving_buckets is not None:
         snap["serving_buckets"] = serving_buckets

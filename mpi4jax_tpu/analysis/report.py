@@ -307,6 +307,20 @@ CODES = {
             "(docs/moe.md).",
         ),
         CodeInfo(
+            "MPX138", "uncompressed DCN leg above the crossover", ADVISORY,
+            "A hierarchical collective on a multi-host comm ships a "
+            "float32 inter-host (DCN) leg above "
+            "MPI4JAX_TPU_DCN_CROSSOVER_BYTES uncompressed while the "
+            "wire codec layer is off: MPI4JAX_TPU_COMPRESS=bf16 halves "
+            "the DCN wire bytes (fp8 quarters them, with per-chunk "
+            "scales) at the cost of bit-identity — the error-feedback "
+            "accumulator (mpx.compress.ef_allreduce) carries the "
+            "rounding residual across steps, and the convergence "
+            "harness (BENCH_compress.json) is the parity contract.  "
+            "Opt-in and off by default; let mpx.autotune() sweep the "
+            "codecs against the error budget (docs/compression.md).",
+        ),
+        CodeInfo(
             "MPX130", "async span straddles a megastep loop boundary", ERROR,
             "An async *_start/*_wait span crosses a megastep loop "
             "boundary (mpx.compile/mpx.spmd unroll=N, "

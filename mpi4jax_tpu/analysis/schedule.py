@@ -268,6 +268,9 @@ class SchedOp:
     payload_bytes: int = 0
     algo: Optional[str] = None
     hosts: Optional[int] = None
+    # DCN wire codec the hierarchy applied (docs/compression.md) — the
+    # cost pass prices the inter-host leg at wire bytes through it
+    codec: Optional[str] = None
     eager: bool = False
     meta: Dict = field(default_factory=dict)
 
@@ -404,5 +407,6 @@ def build_schedule(events, rank: int, world: Optional[int] = None,
         sched.append(SchedOp(kind=kind, seq=seq, participants=parts,
                              root=e.root, reduction=e.reduction,
                              span=e.span, fused=fused, hier=e.hier,
-                             algo=e.algo, hosts=e.hosts, **base))
+                             algo=e.algo, hosts=e.hosts,
+                             codec=getattr(e, "codec", None), **base))
     return sched

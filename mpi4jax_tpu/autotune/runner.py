@@ -453,6 +453,43 @@ def autotune(comm=None, budget_s: float = 60.0, save: Optional[str] = None,
     else:
         unfitted.append("overlap_chunks")
 
+    # -- phase 6b: DCN wire codec vs the error budget ---------------------
+    # pick the fastest modeled DCN leg whose MEASURED round-trip error
+    # fits MPI4JAX_TPU_COMPRESS_ERROR_BUDGET (docs/compression.md);
+    # "off" always fits, so the knob is always recorded — a budget no
+    # codec meets tunes compression off explicitly.  Payload-bucketed:
+    # legs below the DCN crossover are latency-bound, where shrinking
+    # bytes buys nothing, so they stay exact.
+    bench_comp = getattr(micro, "bench_compression", None)
+    if bench_comp is not None and budget.ok():
+        from ..utils import config as _config
+
+        err_budget = _config.compress_error_budget()
+        comp_rows = bench_comp(comm, sizes_mb=(1.0,), iters=3)
+        best_codec, best_us = "off", None
+        for row in comp_rows:
+            if row["rel_err"] > err_budget:
+                continue
+            if best_us is None or row["modeled_dcn_us"] < best_us:
+                best_codec, best_us = row["codec"], row["modeled_dcn_us"]
+            measured[f"compress_rel_err_{row['codec']}"] = row["rel_err"]
+        if best_codec == "off":
+            tuned["compress"] = "off"
+        else:
+            bound = int(tuned.get("dcn_crossover_bytes",
+                                  _config.dcn_crossover_bytes()))
+            tuned["compress"] = [
+                {"max_bytes": bound, "codec": "off"},
+                {"max_bytes": None, "codec": best_codec},
+            ]
+        fit_sources["compress"] = "sweep vs error budget"
+        fitted.append("compress")
+        _meter("autotune.fits")
+        note(f"compress codec: {best_codec} "
+             f"(error budget {err_budget:g})")
+    else:
+        unfitted.append("compress")
+
     # -- phase 7: commit pack throughput ----------------------------------
     pack = _pack_throughput_gb_s()
     if pack is not None:

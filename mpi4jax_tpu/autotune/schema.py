@@ -54,7 +54,15 @@ KNOB_FLAGS = {
     "alltoall_crossover_bytes": "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES",
     "fusion_bucket_bytes": "MPI4JAX_TPU_FUSION_BUCKET_BYTES",
     "overlap_chunks": "MPI4JAX_TPU_OVERLAP_CHUNKS",
+    # PR 17: the DCN-leg wire codec — the one string-valued knob
+    # ("off"/"bf16"/"fp8", optionally payload-bucketed like
+    # overlap_chunks); same schema-bump-free addition contract
+    "compress": "MPI4JAX_TPU_COMPRESS",
 }
+
+# legal tuned codec values for the "compress" knob ("auto" is an env
+# resolution directive, never a tuned value)
+COMPRESS_CODECS = ("off", "bf16", "fp8")
 
 # commit-interval parameters (tuned.commit — mpx.elastic.run's
 # commit_every='auto' math, autotune/fit.py auto_commit_interval)
@@ -110,6 +118,50 @@ def _validate_chunk_buckets(section: str, buckets) -> list:
     return buckets
 
 
+def _require_codec(section: str, key: str, val) -> str:
+    if not isinstance(val, str) or val.lower() not in COMPRESS_CODECS:
+        raise ValueError(
+            f"tuning file {section}.{key} must be one of "
+            f"{COMPRESS_CODECS} (got {val!r})"
+        )
+    return val.lower()
+
+
+def _validate_codec_buckets(section: str, buckets) -> list:
+    """``compress`` bucket form: the overlap_chunks bucket grammar with
+    a ``codec`` value per span instead of a chunk count."""
+    if not isinstance(buckets, list) or not buckets:
+        raise ValueError(
+            f"tuning file {section}.compress must be a codec string or "
+            f"a non-empty bucket list (got {buckets!r})"
+        )
+    prev = 0
+    for i, b in enumerate(buckets):
+        if not isinstance(b, dict) or set(b) != {"max_bytes", "codec"}:
+            raise ValueError(
+                f"tuning file {section}.compress[{i}] must be an "
+                "object with exactly 'max_bytes' and 'codec' keys"
+            )
+        _require_codec(section, f"compress[{i}].codec", b["codec"])
+        mb = b["max_bytes"]
+        last = i == len(buckets) - 1
+        if mb is None:
+            if not last:
+                raise ValueError(
+                    f"tuning file {section}.compress[{i}]: only the "
+                    "last bucket may be open-ended (max_bytes null)"
+                )
+            continue
+        _require_pos_int(section, f"compress[{i}].max_bytes", mb)
+        if mb <= prev:
+            raise ValueError(
+                f"tuning file {section}.compress bucket bounds must "
+                f"be strictly ascending (bucket {i}: {mb} <= {prev})"
+            )
+        prev = int(mb)
+    return buckets
+
+
 def _validate_knobs(section: str, knobs: dict,
                     allow_commit: bool = False) -> None:
     if not isinstance(knobs, dict):
@@ -146,6 +198,11 @@ def _validate_knobs(section: str, knobs: dict,
             )
         if key == "overlap_chunks" and isinstance(val, list):
             _validate_chunk_buckets(section, val)
+        elif key == "compress":
+            if isinstance(val, list):
+                _validate_codec_buckets(section, val)
+            else:
+                _require_codec(section, key, val)
         else:
             _require_pos_int(section, key, val)
 
@@ -243,6 +300,16 @@ class TuningFile:
                 if b["max_bytes"] is None or payload_bytes <= b["max_bytes"]:
                     return int(b["chunks"])
             return int(val[-1]["chunks"])
+        if name == "compress":
+            if isinstance(val, list):
+                if payload_bytes is None:
+                    return str(val[-1]["codec"]).lower()
+                for b in val:
+                    if b["max_bytes"] is None or \
+                            payload_bytes <= b["max_bytes"]:
+                        return str(b["codec"]).lower()
+                return str(val[-1]["codec"]).lower()
+            return str(val).lower()
         return int(val)
 
     def commit_param(self, name: str) -> Optional[float]:

@@ -1,0 +1,56 @@
+"""``mpx.compress`` — the public wire-compression + error-feedback API.
+
+Thin re-export surface over the codec layer (docs/compression.md):
+
+- byte math + resolution (stdlib, ``ops/_codec.py``): ``wire_bytes``,
+  ``codec_for``, ``compression_ratio``, ``ef_reshard_rows``;
+- traced appliers + EF (``ops/_compress.py``): ``ef_allreduce``,
+  ``ef_zeros_like``, ``ef_reshard``, ``roundtrip``, the fp8
+  encode/decode pair;
+- the effective mode (``utils/config.compress_mode`` — default <
+  tuning < env, payload-bucketed).
+
+The whole layer is opt-in and OFF by default: with
+``MPI4JAX_TPU_COMPRESS=off`` cache tokens and lowered HLO are
+byte-identical to a build without it, ``ef_allreduce`` degenerates to
+the plain tree-mapped allreduce, and the residual stays exactly zero.
+Compressed results are NOT bit-identical to the exact run — the
+convergence harness (benchmarks/compress_replay.py, BENCH_compress.json)
+is the parity contract.
+"""
+
+from .ops._codec import (  # noqa: F401
+    CODECS,
+    FP8_CHUNK,
+    codec_for,
+    compression_ratio,
+    ef_reshard_rows,
+    wire_bytes,
+)
+from .ops._compress import (  # noqa: F401
+    decode_fp8,
+    ef_allreduce,
+    ef_reshard,
+    ef_zeros_like,
+    encode_fp8,
+    fp8_wire_dtype,
+    roundtrip,
+)
+from .utils.config import compress_mode  # noqa: F401
+
+__all__ = [
+    "CODECS",
+    "FP8_CHUNK",
+    "codec_for",
+    "compression_ratio",
+    "compress_mode",
+    "decode_fp8",
+    "ef_allreduce",
+    "ef_reshard",
+    "ef_reshard_rows",
+    "ef_zeros_like",
+    "encode_fp8",
+    "fp8_wire_dtype",
+    "roundtrip",
+    "wire_bytes",
+]

@@ -77,10 +77,21 @@ def algo_cache_token() -> tuple:
     ``load_tuning(path)`` refresh); with no layer the token is exactly
     the flat 5-tuple below (the alltoall crossover joined the base in
     PR 15, deliberately moving every cache key once) with no trailing
-    stamp entry (pinned by tests/test_autotune_pure.py)."""
+    stamp entry (pinned by tests/test_autotune_pure.py).
+
+    The DCN wire codec (``MPI4JAX_TPU_COMPRESS``, docs/compression.md)
+    folds the same conditional way: only when a codec is active — so
+    ``off`` (the default) keeps the token EXACTLY the pre-compression
+    value (byte-identical HLO and cache keys, pinned by
+    tests/test_compress_pure.py), while flipping to bf16/fp8 (or
+    loading a tuning file that tunes the knob — already covered by the
+    stamp) retraces every program."""
     base = (config.collective_algo(), config.ring_crossover_bytes(),
             config.dcn_crossover_bytes(), config.topology_spec(),
             config.alltoall_crossover_bytes())
+    compress = config.compress_mode()
+    if compress != "off":
+        base = base + (("compress", compress),)
     stamp = config.tuning_stamp()
     return base if stamp is None else base + (("tuning", stamp),)
 
@@ -562,7 +573,8 @@ def apply_reduce_scatter(xl, op, comm):
     algo = resolve_algo(algo, nbytes, k, ring_ok=True,
                         hier_ok=plan is not None)
     _hierarchy.annotate_selection("reduce_scatter", algo, nbytes, k, plan,
-                                  comm, preserve=not isinstance(op, Op))
+                                  comm, preserve=not isinstance(op, Op),
+                                  op=op, dtype=xl.dtype.name)
     if algo == "hier":
         return _hierarchy.apply_hier_reduce_scatter(xl, op, comm, plan)
     if algo == "ring":

@@ -429,7 +429,7 @@ def alltoall_start(x, *, comm: Optional[Comm] = None,
                                     config.overlap_chunks(nbytes))
         handle.sizes = sizes
         _hierarchy.annotate_selection("alltoall", algo, nbytes, size,
-                                      plan, comm)
+                                      plan, comm, dtype=xl.dtype.name)
         _meter_chunks("alltoall", comm, blocks.dtype, len(sizes))
         pieces = []
         off = 0

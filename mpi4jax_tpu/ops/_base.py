@@ -252,7 +252,8 @@ def apply_allreduce(x, op: OpLike, comm: Comm):
     # advise a choice that does not exist for this call
     _hierarchy.annotate_selection("allreduce", algo, nbytes, k or 1,
                                   plan if chunk_ok else None,
-                                  comm, preserve=not isinstance(op, Op))
+                                  comm, preserve=not isinstance(op, Op),
+                                  op=op, dtype=x.dtype.name)
     if algo == "hier":
         return _hierarchy.apply_hier_allreduce(x, op, comm, plan)
     if algo == "ring":

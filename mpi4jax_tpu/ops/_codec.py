@@ -1,0 +1,104 @@
+"""Wire-compression codec bookkeeping: resolution, byte math, EF plans.
+
+Stdlib-only on purpose (the isolated-loader pure tests import this next
+to ``utils/config.py`` and ``autotune/schema.py`` without JAX): the
+traced encode/decode appliers live in ``ops/_compress.py``; everything a
+cost model, telemetry counter, benchmark sweep, or analyzer checker
+needs to reason about compression — which codec applies, how many bytes
+actually cross the DCN wire, how an error-feedback residual re-shards
+across an elastic reconfiguration — lives here.
+
+The codec model (docs/compression.md):
+
+- ``bf16`` — float32 DCN payloads are cast to bfloat16 on the wire and
+  back on arrival: 2 bytes/element, exactly half the wire bytes, a
+  relative error of ~2^-8 per element (bf16 keeps fp32's exponent).
+- ``fp8`` — per-chunk max-abs-scaled quantization to float8_e4m3fn
+  (``FP8_CHUNK`` = 256 elements per scale): 1 byte/element + one fp32
+  scale per chunk, ~0.27x the fp32 wire bytes (~3.7x reduction).
+- ``off`` — no codec; wire bytes == logical bytes, HLO byte-identical
+  to a build without the compression layer.
+
+Compression applies to the INTER-HOST (DCN) leg of the hierarchical
+lowerings only, and only to float32 payloads — ICI phases and every
+non-f32 dtype stay exact in every mode.
+"""
+
+from typing import Dict, List, Optional
+
+from ..utils import config
+
+# elements per fp8 scale chunk: one fp32 max-abs scale amortized over
+# this many quantized elements.  256 keeps the scale overhead at 1.6%
+# of the quantized bytes while bounding each chunk's dynamic range
+# tightly enough that e4m3's ~2 decimal digits hold per-element relative
+# error near the format's 2^-3 mantissa step for gradient-shaped data.
+FP8_CHUNK = 256
+
+# wire bytes per element, by codec, for a float32 element (the only
+# compressible dtype); fp8 adds the per-chunk scale separately
+_F32_ITEMSIZE = 4
+
+CODECS = ("off", "bf16", "fp8")
+
+
+def wire_bytes(nbytes: int, codec: Optional[str]) -> int:
+    """Bytes actually crossing the wire for a logical float32 payload of
+    ``nbytes`` under ``codec`` (None/"off" = exact).  The single source
+    of byte truth shared by the cost model, telemetry's wire counters,
+    and the compression sweep."""
+    if not codec or codec == "off":
+        return nbytes
+    if codec == "bf16":
+        return nbytes // 2
+    if codec == "fp8":
+        elems = nbytes // _F32_ITEMSIZE
+        nchunks = -(-elems // FP8_CHUNK) if elems else 0
+        return elems + _F32_ITEMSIZE * nchunks
+    raise ValueError(f"unknown wire codec {codec!r} "
+                     f"(expected one of {CODECS})")
+
+
+def codec_for(nbytes: int, dtype: str = "float32") -> Optional[str]:
+    """The codec the DCN leg of a hierarchical lowering applies to a
+    payload of ``nbytes`` logical bytes and ``dtype``, or ``None`` when
+    the leg stays exact.  Resolution is ``config.compress_mode`` —
+    default < tuning(payload-bucketed) < env — restricted to float32
+    (the training-gradient dtype; everything else ships exact)."""
+    if dtype != "float32":
+        return None
+    mode = config.compress_mode(payload_bytes=nbytes)
+    return None if mode == "off" else mode
+
+
+def compression_ratio(nbytes: int, codec: Optional[str]) -> float:
+    """logical/wire — e.g. 2.0 for bf16; 1.0 when exact or empty."""
+    wire = wire_bytes(nbytes, codec)
+    return (nbytes / wire) if wire else 1.0
+
+
+def ef_reshard_rows(old_k: int, rank_map: Dict[int, int],
+                    new_world: int) -> List[Optional[int]]:
+    """Row plan for re-sharding a per-rank error-feedback residual of
+    leading dimension ``old_k`` across an elastic reconfiguration.
+
+    ``rank_map`` is the shrink's ``{old_rank: new_rank}`` compaction
+    (resilience/elastic.compact_rank_map, recorded on the ShardStore
+    commit); ``new_world`` is the post-reconfig world size (> number of
+    survivors when joiners grew the world back).  Returns one entry per
+    NEW rank: the old residual row that rank carries forward, or
+    ``None`` for a cold joiner — whose residual MUST be zeroed, not
+    silently dropped or left holding a dead rank's stale error
+    (docs/compression.md 'Error feedback under elasticity')."""
+    if new_world < 1:
+        raise ValueError(f"new_world must be >= 1 (got {new_world})")
+    rows: List[Optional[int]] = [None] * new_world
+    for old, new in rank_map.items():
+        if not 0 <= old < old_k:
+            raise ValueError(
+                f"rank_map old rank {old} out of range for a residual "
+                f"of leading dimension {old_k}"
+            )
+        if 0 <= new < new_world:
+            rows[new] = old
+    return rows

@@ -1,0 +1,333 @@
+"""Traced wire codecs + error feedback for the DCN leg (opt-in).
+
+The appliers here are the compressed twins of the inter-host phases in
+``ops/_hierarchy.py`` — they run ONLY when ``MPI4JAX_TPU_COMPRESS``
+(resolved per payload bucket by ``ops/_codec.codec_for``) selects a
+codec for a float32 payload, and only on the DCN (inter) leg; every ICI
+phase and every non-f32 dtype stays exact.  With the knob off this
+module is never imported by a trace: HLO and cache tokens are
+byte-identical to a build without it (pinned by tests/test_compress.py).
+
+Two codecs (byte math in ``ops/_codec.py``, table in
+docs/compression.md):
+
+- ``bf16`` — cast-through: the inter-phase value is cast to bfloat16,
+  the EXACT exchange algorithms run on the bf16 array (ring or
+  butterfly, unchanged), and the result is cast back.  2x fewer wire
+  bytes; reduction arithmetic happens in bf16 (Horovod's fp16
+  compression semantics).  Valid for every enum ``Op`` — bf16 keeps
+  fp32's exponent, so MIN/MAX/PROD survive the cast.
+- ``fp8`` — per-chunk max-abs-scaled quantization to float8_e4m3fn
+  (int8 symmetric fallback when the installed jax lacks the dtype):
+  1 byte/element + one fp32 scale per ``FP8_CHUNK`` elements, ~3.7x
+  fewer wire bytes.  fp8 has no usable reduction arithmetic, so the
+  allreduce/reduce_scatter form is a butterfly whose every stage
+  encodes -> ppermutes the (q, scale) pair -> decodes -> accumulates in
+  float32; it is therefore SUM-only — any other enum op silently
+  degrades to the bf16 cast-through (the annotation layer mirrors this
+  downgrade).  Pure-routing legs (alltoall, bcast) quantize once and
+  ship the (q, scale) pair.
+
+**Error feedback** (1-bit-Adam-style EF, docs/compression.md): the
+compressed allreduce is biased per step; ``ef_allreduce`` carries the
+quantization residual in program state and re-adds it before the next
+quantize, making the bias telescope away across steps.  With the codec
+off the roundtrip is the identity and the residual stays exactly zero —
+the examples call it unconditionally.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import _algos, _codec
+
+__all__ = [
+    "fp8_wire_dtype",
+    "encode_fp8",
+    "decode_fp8",
+    "roundtrip",
+    "inter_allreduce",
+    "inter_reduce_scatter",
+    "inter_alltoall",
+    "inter_bcast",
+    "ef_zeros_like",
+    "ef_allreduce",
+    "ef_reshard",
+]
+
+FP8_CHUNK = _codec.FP8_CHUNK
+
+# the wire dtype of the fp8 codec: float8_e4m3fn where the installed
+# jax has it (max normal 448), else symmetric int8 (no pip installs —
+# the fallback keeps the same 1 byte/element wire width and the same
+# per-chunk-scale math, with round-to-nearest instead of e4m3 rounding)
+_F8 = getattr(jnp, "float8_e4m3fn", None)
+_QMAX = 448.0 if _F8 is not None else 127.0
+
+
+def fp8_wire_dtype():
+    """The dtype fp8-quantized elements ship as (float8_e4m3fn, or int8
+    on a jax without it) — 1 byte/element either way."""
+    return _F8 if _F8 is not None else jnp.int8
+
+
+def _encode_rows(x2d):
+    """Quantize a (rows, cols) float32 array per FP8_CHUNK-element chunk:
+    returns ``(q, scale)`` with ``q`` shape (rows, nchunks, FP8_CHUNK)
+    in the wire dtype and ``scale`` shape (rows, nchunks, 1) float32."""
+    rows, cols = x2d.shape
+    padded = -(-max(cols, 1) // FP8_CHUNK) * FP8_CHUNK
+    xp = jnp.pad(x2d, ((0, 0), (0, padded - cols)))
+    ch = xp.reshape(rows, padded // FP8_CHUNK, FP8_CHUNK)
+    maxabs = jnp.max(jnp.abs(ch), axis=-1, keepdims=True)
+    scale = jnp.where(maxabs > 0, maxabs / _QMAX, 1.0)
+    scaled = ch / scale
+    if _F8 is not None:
+        q = scaled.astype(_F8)
+    else:
+        q = jnp.round(scaled).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _decode_rows(q, scale, cols):
+    """Inverse of :func:`_encode_rows`: (rows, cols) float32."""
+    ch = q.astype(jnp.float32) * scale
+    return ch.reshape(ch.shape[0], -1)[:, :cols]
+
+
+def encode_fp8(x):
+    """Whole-array fp8 encode: ``(q, scale)`` for any-shape float32
+    ``x`` (treated as one row of elements)."""
+    return _encode_rows(x.reshape(1, -1))
+
+
+def decode_fp8(q, scale, shape, n):
+    """Whole-array fp8 decode back to ``shape`` (``n`` = element
+    count of the original array)."""
+    return _decode_rows(q, scale, n).reshape(shape)
+
+
+def roundtrip(x, codec):
+    """Quantize-dequantize one array through ``codec`` (None/"off" =
+    identity) — the error the wire introduces, used by the EF update
+    and the autotune/benchmark relative-error measurement."""
+    if not codec or codec == "off":
+        return x
+    if codec == "bf16":
+        return x.astype(jnp.bfloat16).astype(x.dtype)
+    if codec == "fp8":
+        q, s = encode_fp8(x)
+        return decode_fp8(q, s, x.shape, x.size).astype(x.dtype)
+    raise ValueError(f"unknown wire codec {codec!r}")
+
+
+# ---------------------------------------------------------------------------
+# compressed DCN-phase appliers (the _hierarchy inter-phase twins)
+# ---------------------------------------------------------------------------
+
+
+def _fp8_butterfly_allreduce(x, comm):
+    """SUM allreduce over ``comm`` shipping (q, scale) pairs: the
+    recursive-fold butterfly of ``_base.apply_butterfly_allreduce``
+    with every stage's wire traffic quantized — accumulation stays in
+    float32 on the receiving side."""
+    from ._base import (_comm_groups, _comm_pos_size, _permute_axis,
+                        apply_doubling_bcast, as_varying)
+
+    x = as_varying(x, comm.axes)
+    groups = _comm_groups(comm)
+    kmax = max(len(g) for g in groups)
+    pos, k = _comm_pos_size(comm)
+    axis = _permute_axis(comm)
+    acc = x
+    w = 1
+    while w < kmax:
+        perm = [(members[p + w], members[p])
+                for members in groups for p in range(len(members) - w)]
+        q, s = encode_fp8(acc)
+        rq = lax.ppermute(q, axis, perm)
+        rs = lax.ppermute(s, axis, perm)
+        recvd = decode_fp8(rq, rs, acc.shape, acc.size)
+        combine = pos + w < k
+        acc = jnp.where(combine, acc + recvd, acc)
+        w *= 2
+    # rank 0 of each group holds the full fold; broadcast it back out,
+    # quantized once (every receiver decodes the same root value)
+    q, s = encode_fp8(acc)
+    q = apply_doubling_bcast(q, comm, 0)
+    s = apply_doubling_bcast(s, comm, 0)
+    return decode_fp8(q, s, acc.shape, acc.size)
+
+
+def _effective(codec, op):
+    """fp8 reduction arithmetic exists only for SUM: every other enum
+    op degrades to the bf16 cast-through (annotate_selection mirrors
+    this so the recorded codec is the one that actually ran)."""
+    from ._base import SUM
+
+    if codec == "fp8" and op is not None and op != SUM:
+        return "bf16"
+    return codec
+
+
+def inter_allreduce(v, op, plan, shard_bytes, codec):
+    """Compressed DCN allreduce phase (``_hierarchy._inter_allreduce``
+    twin): ring/butterfly on a bf16 cast, or the fp8 per-stage
+    butterfly for SUM."""
+    from ._base import Op, apply_butterfly_allreduce
+
+    codec = _effective(codec, op)
+    if codec == "fp8":
+        return _fp8_butterfly_allreduce(v, plan.inter).astype(v.dtype)
+    v16 = v.astype(jnp.bfloat16)
+    ring_ok = isinstance(op, Op)
+    if _algos.resolve_dcn_algo(shard_bytes, plan.h, ring_ok) == "ring":
+        out = _algos.apply_ring_allreduce(v16, op, plan.inter, plan.h)
+    else:
+        out = apply_butterfly_allreduce(v16, op, plan.inter)
+    return out.astype(v.dtype)
+
+
+def inter_reduce_scatter(blocks, op, plan, codec):
+    """Compressed DCN reduce-scatter phase
+    (``_hierarchy._inter_reduce_scatter`` twin)."""
+    from ._base import apply_butterfly_allreduce
+
+    codec = _effective(codec, op)
+    h = plan.h
+    if codec == "fp8":
+        full = _fp8_butterfly_allreduce(blocks, plan.inter)
+        return jnp.take(full, plan.inter.Get_rank(),
+                        axis=0).astype(blocks.dtype)
+    b16 = blocks.astype(jnp.bfloat16)
+    nbytes = int(blocks.size) * blocks.dtype.itemsize
+    if _algos.resolve_dcn_algo(nbytes, h) == "ring":
+        out = _algos.apply_ring_reduce_scatter(b16, op, plan.inter, h)
+    else:
+        full = apply_butterfly_allreduce(b16, op, plan.inter)
+        out = jnp.take(full, plan.inter.Get_rank(), axis=0)
+    return out.astype(blocks.dtype)
+
+
+def inter_alltoall(z, plan, h, codec):
+    """Compressed DCN alltoall exchange (the ``apply_pairwise_alltoall``
+    calls over ``plan.inter`` in ``apply_hier_alltoall``): pure routing,
+    so both codecs quantize once and ship — per destination block, so
+    each receiver decodes exactly the blocks addressed to it."""
+    if codec == "bf16":
+        w = _algos.apply_pairwise_alltoall(z.astype(jnp.bfloat16),
+                                           plan.inter, h)
+        return w.astype(z.dtype)
+    s = z.shape[1:]
+    q, scale = _encode_rows(z.reshape(h, -1))
+    wq = _algos.apply_pairwise_alltoall(q, plan.inter, h)
+    ws = _algos.apply_pairwise_alltoall(scale, plan.inter, h)
+    cols = int(z.size) // h
+    return _decode_rows(wq, ws, cols).reshape((h,) + s).astype(z.dtype)
+
+
+def inter_bcast(v, plan, b0, codec):
+    """Compressed DCN broadcast phase (``_hierarchy._inter_bcast``
+    twin): pure routing — quantize once at the root, ship (q, scale),
+    decode on arrival.  fp8 always uses the doubling tree (the van de
+    Geijn split would re-chunk the scale blocks)."""
+    from ._base import apply_doubling_bcast
+
+    if codec == "bf16":
+        if _algos.resolve_dcn_algo(int(v.size) * v.dtype.itemsize,
+                                   plan.h) == "ring":
+            out = _algos.apply_vdg_bcast(v.astype(jnp.bfloat16),
+                                         plan.inter, b0, plan.h)
+        else:
+            out = apply_doubling_bcast(v.astype(jnp.bfloat16),
+                                       plan.inter, b0)
+        return out.astype(v.dtype)
+    q, s = encode_fp8(v)
+    q = apply_doubling_bcast(q, plan.inter, b0)
+    s = apply_doubling_bcast(s, plan.inter, b0)
+    return decode_fp8(q, s, v.shape, v.size).astype(v.dtype)
+
+
+def dcn_codec(v, nbytes, op=None):
+    """The codec the DCN leg applies to traced value ``v`` (None =
+    exact): float32 only, enum ``Op``s only where a reduction is
+    involved (callables must see exact operands), resolved per payload
+    bucket by ``_codec.codec_for``."""
+    from ._base import Op
+
+    if v.dtype != jnp.float32:
+        return None
+    if op is not None and not isinstance(op, Op):
+        return None
+    return _codec.codec_for(int(nbytes), "float32")
+
+
+# ---------------------------------------------------------------------------
+# error feedback (EF-SGD / 1-bit-Adam residual accumulation)
+# ---------------------------------------------------------------------------
+
+
+def ef_zeros_like(tree):
+    """A zero residual matching ``tree`` — the EF state's initial value
+    (and a cold joiner's mandatory reset, docs/compression.md)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def ef_allreduce(grads, residual, op=None, *, comm=None):
+    """Error-feedback allreduce of a gradient pytree.
+
+    Per leaf: ``comp = g + residual``; ``q = roundtrip(comp, codec)``
+    (the codec resolved for this leaf's payload bucket, identity when
+    the knob is off); the new residual is ``comp - q``; ``q`` is
+    allreduced exactly as any other payload (its DCN leg compresses
+    again under the same knob — the residual already carries the
+    quantization error, so training sees an unbiased telescoped sum).
+    Returns ``(reduced_tree, new_residual_tree, token)``.
+
+    With ``MPI4JAX_TPU_COMPRESS=off`` every roundtrip is the identity,
+    the residual stays exactly zero, and the traced program is the
+    plain tree-mapped allreduce — examples call this unconditionally.
+    """
+    from ._base import SUM
+    from .allreduce import allreduce
+
+    if op is None:
+        op = SUM
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    res_leaves = jax.tree_util.tree_flatten(residual)[0]
+    if len(res_leaves) != len(leaves):
+        raise ValueError(
+            "ef_allreduce: residual tree does not match the gradient "
+            f"tree ({len(res_leaves)} vs {len(leaves)} leaves) — "
+            "initialize it with ef_zeros_like(grads)"
+        )
+    outs, new_res, token = [], [], None
+    for g, r in zip(leaves, res_leaves):
+        codec = dcn_codec(g, int(g.size) * g.dtype.itemsize, op)
+        comp = g + r
+        q = roundtrip(comp, codec)
+        new_res.append((comp - q).astype(g.dtype))
+        out, token = allreduce(q, op=op, comm=comm, token=token)
+        outs.append(out)
+    return (jax.tree_util.tree_unflatten(treedef, outs),
+            jax.tree_util.tree_unflatten(treedef, new_res), token)
+
+
+def ef_reshard(residual, rank_map, new_world):
+    """Re-shard a replicated per-rank EF residual (leaves of leading
+    dimension ``old_world``) across an elastic reconfiguration:
+    survivors keep their row under the shrink's ``rank_map`` compaction
+    and cold joiners get ZEROS — never a dead rank's stale error
+    (plan math in ``_codec.ef_reshard_rows``; pinned by
+    tests/test_compress*.py across shrink, grow, and commit/restore)."""
+    def reshard_leaf(leaf):
+        rows = _codec.ef_reshard_rows(int(leaf.shape[0]), rank_map,
+                                      new_world)
+        zero = jnp.zeros_like(leaf[0])
+        return jnp.stack([leaf[o] if o is not None else zero
+                          for o in rows])
+
+    return jax.tree_util.tree_map(reshard_leaf, residual)

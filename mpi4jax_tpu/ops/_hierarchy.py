@@ -68,6 +68,8 @@ __all__ = [
     "hier_link_bytes",
     "flat_link_bytes",
     "alltoall_dcn_messages",
+    "dcn_leg_bytes",
+    "selected_codec",
     "annotate_selection",
     "apply_hier_allreduce",
     "apply_hier_reduce_scatter",
@@ -378,14 +380,48 @@ def comm_hosts(comm) -> Optional[int]:
     return hosts
 
 
+def dcn_leg_bytes(kind: str, nbytes: int, r: int) -> int:
+    """The payload the DCN phase of a hierarchical ``kind`` sees — the
+    bucket the wire codec resolves against (``_codec.codec_for``): the
+    full payload for the alltoall's host-aggregated exchange, one
+    ``1/r`` position chunk for the reduction family's leader shards."""
+    return nbytes if kind == "alltoall" else -(-nbytes // r)
+
+
+def selected_codec(kind: str, nbytes: int, plan: Optional[HierPlan],
+                   preserve: bool = False, op=None,
+                   dtype: Optional[str] = None) -> Optional[str]:
+    """The wire codec the hierarchical lowering's DCN leg applies for
+    this call, or ``None`` when the leg ships exact: hier-only, float32
+    only, never for order-preserving callables, and fp8 degrades to
+    bf16 for non-SUM reductions (mirroring ``_compress._effective`` so
+    the annotation records the codec that actually runs)."""
+    if plan is None or preserve or dtype != "float32":
+        return None
+    from . import _codec
+
+    codec = _codec.codec_for(dcn_leg_bytes(kind, nbytes, plan.r),
+                             "float32")
+    if codec == "fp8" and op is not None and \
+            kind in ("allreduce", "reduce_scatter"):
+        from ._base import SUM
+
+        if op != SUM:
+            codec = "bf16"
+    return codec
+
+
 def annotate_selection(kind: str, algo: str, nbytes: int, k: int,
                        plan: Optional[HierPlan], comm,
-                       preserve: bool = False) -> None:
+                       preserve: bool = False, op=None,
+                       dtype: Optional[str] = None) -> None:
     """One-stop dispatch-point annotation for the reduction family: the
     selected algorithm (analysis + telemetry), the host span (MPX113),
-    and the modeled per-link-class wire bytes (telemetry's
-    ``intra_host``/``inter_host`` counters).  Pure host-side bookkeeping:
-    never adds an equation to the trace."""
+    the modeled per-link-class wire bytes (telemetry's
+    ``intra_host``/``inter_host`` counters), and — when the DCN-leg
+    codec is active — the codec plus the COMPRESSED inter-host bytes
+    (telemetry's wire-vs-logical split, MPX138).  Pure host-side
+    bookkeeping: never adds an equation to the trace."""
     from ..analysis.hook import annotate as a_annotate
     from ..telemetry.core import annotate as t_annotate
 
@@ -394,6 +430,14 @@ def annotate_selection(kind: str, algo: str, nbytes: int, k: int,
         link = hier_link_bytes(kind, nbytes, plan.h, plan.r, preserve)
     else:
         link = flat_link_bytes(kind, algo, nbytes, k, hosts, preserve)
+    codec = None
+    wire = link
+    if algo == "hier":
+        codec = selected_codec(kind, nbytes, plan, preserve, op, dtype)
+        if codec is not None:
+            from . import _codec
+
+            wire = (link[0], _codec.wire_bytes(link[1], codec))
     # the analysis event carries ``hosts`` only when the hierarchy was
     # actually expressible (a plan existed): MPX113 advises on a CHOICE,
     # and where flat is the only option there is nothing to advise.  The
@@ -404,13 +448,33 @@ def annotate_selection(kind: str, algo: str, nbytes: int, k: int,
     # ranks (MPX125, analysis/matcher.py).
     a_annotate(algo=algo, hosts=plan.h if plan is not None else None,
                hier=(plan.h, plan.r) if (plan is not None
-                                         and algo == "hier") else None)
-    t_annotate(algo=algo, link_bytes=link)
+                                         and algo == "hier") else None,
+               codec=codec)
+    t_annotate(algo=algo, link_bytes=link, wire_bytes=wire)
 
 
 # ---------------------------------------------------------------------------
 # traced appliers
 # ---------------------------------------------------------------------------
+
+
+def _dcn_codec(v, nbytes: int, op=None):
+    """The wire codec the DCN phase applies to traced value ``v``
+    (``None`` = ship exact): float32 only, enum ``Op``s only when a
+    reduction is involved, resolved per payload bucket
+    (``_codec.codec_for`` — off by default, so this is a pure config
+    read that changes nothing unless MPI4JAX_TPU_COMPRESS or a tuned
+    codec is active; the mode folds into ``algo_cache_token`` so
+    flipping it retraces)."""
+    from ._base import Op
+
+    if v.dtype != jnp.float32:
+        return None
+    if op is not None and not isinstance(op, Op):
+        return None
+    from . import _codec
+
+    return _codec.codec_for(int(nbytes), "float32")
 
 
 def apply_hier_allreduce(x, op, comm, plan: HierPlan):
@@ -451,6 +515,11 @@ def _inter_allreduce(v, op, plan: HierPlan, shard_bytes: int):
 
     if plan.h == 1:
         return v
+    codec = _dcn_codec(v, shard_bytes, op)
+    if codec is not None:
+        from . import _compress
+
+        return _compress.inter_allreduce(v, op, plan, shard_bytes, codec)
     ring_ok = isinstance(op, Op)
     if _algos.resolve_dcn_algo(shard_bytes, plan.h, ring_ok) == "ring":
         return _algos.apply_ring_allreduce(v, op, plan.inter, plan.h)
@@ -491,6 +560,11 @@ def _inter_reduce_scatter(blocks, op, plan: HierPlan):
     if h == 1:
         return blocks[0]
     nbytes = int(blocks.size) * blocks.dtype.itemsize
+    codec = _dcn_codec(blocks, nbytes, op)
+    if codec is not None:
+        from . import _compress
+
+        return _compress.inter_reduce_scatter(blocks, op, plan, codec)
     if _algos.resolve_dcn_algo(nbytes, h) == "ring":
         return _algos.apply_ring_reduce_scatter(blocks, op, plan.inter, h)
     full = apply_butterfly_allreduce(blocks, op, plan.inter)
@@ -569,14 +643,25 @@ def apply_hier_alltoall(xl, comm, plan: HierPlan):
     xl = as_varying(xl, comm.axes)
     h, r = plan.h, plan.r
     s = xl.shape[1:]
+    nbytes = int(xl.size) * xl.dtype.itemsize
+    codec = _dcn_codec(xl, nbytes)
     if r == 1:
         # one rank per host: the inter exchange IS the whole alltoall
+        if codec is not None:
+            from . import _compress
+
+            return _compress.inter_alltoall(xl, plan, h, codec)
         return _algos.apply_pairwise_alltoall(xl, plan.inter, h)
     y = jnp.moveaxis(xl.reshape((h, r) + s), 1, 0)  # y[j, b'] → (b'·r + j)
     a = _algos.apply_pairwise_alltoall(y, plan.intra, r)
     # a[i, b'] = host-mate i's block addressed to (b', my intra pos)
     z = jnp.moveaxis(a, 1, 0)  # z[b', i]: the host-aggregated block for b'
-    w = _algos.apply_pairwise_alltoall(z, plan.inter, h)
+    if codec is not None:
+        from . import _compress
+
+        w = _compress.inter_alltoall(z, plan, h, codec)
+    else:
+        w = _algos.apply_pairwise_alltoall(z, plan.inter, h)
     # w[b'', i] = the block rank b''·r + i addressed to me
     return w.reshape((h * r,) + s)
 
@@ -589,6 +674,11 @@ def _inter_bcast(v, plan: HierPlan, b0: int, nbytes: int):
 
     if plan.h == 1:
         return v
+    codec = _dcn_codec(v, nbytes)
+    if codec is not None:
+        from . import _compress
+
+        return _compress.inter_bcast(v, plan, b0, codec)
     if _algos.resolve_dcn_algo(nbytes, plan.h) == "ring":
         return _algos.apply_vdg_bcast(v, plan.inter, b0, plan.h)
     return apply_doubling_bcast(v, plan.inter, b0)

@@ -67,7 +67,7 @@ def alltoall(x, *, comm: Optional[Comm] = None, token: Optional[Token] = None):
             config.collective_algo(), nbytes, hier_ok=plan is not None
         )
         _hierarchy.annotate_selection("alltoall", algo, nbytes, size, plan,
-                                      comm)
+                                      comm, dtype=xl.dtype.name)
         if algo == "hier":
             res = _hierarchy.apply_hier_alltoall(xl, comm, plan)
         elif comm.groups is not None:

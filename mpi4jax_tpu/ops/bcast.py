@@ -100,7 +100,7 @@ def bcast(x, root: int, *, comm: Optional[Comm] = None,
                 hier_ok=plan is not None,
             )
             _hierarchy.annotate_selection("bcast", picked, nbytes, k or 1,
-                                          plan, comm)
+                                          plan, comm, dtype=xl.dtype.name)
             if picked == "hier":
                 res = _hierarchy.apply_hier_bcast(xl, comm, root, plan)
             elif picked == "ring":

@@ -1866,6 +1866,19 @@ class ShardStore:
         with self._lock:
             return self._committed["step"] if self._committed else None
 
+    @property
+    def last_rank_map(self) -> Optional[Dict[int, int]]:
+        """The ``{old_rank: new_rank}`` compaction the last boundary
+        stamped on the committed record, or ``None`` when the commit
+        pre-dates any reconfiguration (identity).  What a restored
+        per-rank artifact — e.g. the compression layer's error-feedback
+        residual (``mpx.compress.ef_reshard``) — needs to move its rows
+        to their post-shrink owners and zero cold joiners."""
+        with self._lock:
+            rec = self._committed
+            rmap = rec.get("rank_map") if rec else None
+            return dict(rmap) if rmap is not None else None
+
     # -- restore -----------------------------------------------------------
 
     def _require_commit(self) -> dict:

@@ -110,11 +110,13 @@ class _Counters:
 
     def count_op(self, key: str, nbytes: int,
                  intra: Optional[int] = None,
-                 inter: Optional[int] = None) -> None:
+                 inter: Optional[int] = None,
+                 wire_inter: Optional[int] = None) -> None:
         with self.lock:
             row = self.ops.setdefault(
                 key, {"calls": 0, "bytes": 0,
-                      "intra_bytes": 0, "inter_bytes": 0}
+                      "intra_bytes": 0, "inter_bytes": 0,
+                      "wire_inter_bytes": 0}
             )
             row["calls"] += 1
             row["bytes"] += int(nbytes)
@@ -123,7 +125,15 @@ class _Counters:
             # by the algorithm layer; ops without a model (p2p, gather
             # family, native HLO) default to payload-on-intra
             row["intra_bytes"] += int(nbytes if intra is None else intra)
-            row["inter_bytes"] += int(0 if inter is None else inter)
+            inter_logical = int(0 if inter is None else inter)
+            row["inter_bytes"] += inter_logical
+            # DCN wire bytes after the codec (docs/compression.md): equal
+            # to the logical inter bytes unless the hierarchy compressed
+            # the inter-host leg — the logical/wire split is how the
+            # snapshot shows what the codec actually saved
+            row["wire_inter_bytes"] += (
+                inter_logical if wire_inter is None else int(wire_inter)
+            )
 
     def bump(self, name: str, n: int) -> None:
         with self.lock:
@@ -183,7 +193,7 @@ class OpRecord:
     """One in-flight dispatch's telemetry view (host-side, trace-time)."""
 
     __slots__ = ("op", "comm_uid", "comm_axes", "bytes", "dtype", "algo",
-                 "counted", "intra_bytes", "inter_bytes")
+                 "counted", "intra_bytes", "inter_bytes", "wire_inter_bytes")
 
     def __init__(self, op, comm_uid, comm_axes, nbytes, dtype, counted):
         self.op = op
@@ -197,6 +207,9 @@ class OpRecord:
         # layer annotates them; count_op defaults payload-on-intra)
         self.intra_bytes = None
         self.inter_bytes = None
+        # post-codec DCN bytes (None -> same as inter_bytes; only the
+        # compressed hierarchy leg sets this, docs/compression.md)
+        self.wire_inter_bytes = None
 
     def key(self) -> str:
         return op_key(self.op, self.comm_uid, self.algo, self.dtype)
@@ -300,6 +313,11 @@ def annotate(**fields) -> None:
     link = fields.get("link_bytes")
     if link is not None:
         rec.intra_bytes, rec.inter_bytes = link
+    wire = fields.get("wire_bytes")
+    if wire is not None:
+        # (intra, inter) after the DCN codec — the intra leg is never
+        # compressed, so only the inter component is recorded
+        rec.wire_inter_bytes = wire[1]
 
 
 def close_op(rec: Optional[OpRecord]) -> None:
@@ -314,7 +332,8 @@ def close_op(rec: Optional[OpRecord]) -> None:
         return
     if rec.counted:
         _counters.count_op(rec.key(), rec.bytes,
-                           rec.intra_bytes, rec.inter_bytes)
+                           rec.intra_bytes, rec.inter_bytes,
+                           rec.wire_inter_bytes)
 
 
 def abort_op(rec: Optional[OpRecord]) -> None:
@@ -331,7 +350,8 @@ def count_eager_call(cell: EagerCell, sig: tuple) -> None:
         return
     for rec in cell.records_for(sig):
         _counters.count_op(rec.key(), rec.bytes,
-                           rec.intra_bytes, rec.inter_bytes)
+                           rec.intra_bytes, rec.inter_bytes,
+                           rec.wire_inter_bytes)
 
 
 def current_open() -> Optional[OpRecord]:
@@ -362,6 +382,8 @@ def snapshot(include_events: bool = False) -> dict:
                 "bytes": row["bytes"],
                 "intra_bytes": row.get("intra_bytes", 0),
                 "inter_bytes": row.get("inter_bytes", 0),
+                "wire_inter_bytes": row.get(
+                    "wire_inter_bytes", row.get("inter_bytes", 0)),
             }
             for key, row in _counters.ops.items()
         }
@@ -375,6 +397,7 @@ def snapshot(include_events: bool = False) -> dict:
                 "bytes": 0,
                 "intra_bytes": 0,
                 "inter_bytes": 0,
+                "wire_inter_bytes": 0,
             })["latency"] = h.to_dict()
         meters = dict(_counters.meters)
     snap = {

@@ -32,6 +32,7 @@ class Flag(NamedTuple):
 
 ANALYZE_MODES = ("off", "warn", "error")
 COLLECTIVE_ALGOS = ("auto", "butterfly", "ring", "hier")
+COMPRESS_MODES = ("off", "bf16", "fp8", "auto")
 TELEMETRY_MODES = ("off", "counters", "events")
 FUSION_MODES = ("off", "auto", "force")
 ELASTIC_FAIL_UNITS = ("rank", "row", "col")
@@ -120,6 +121,15 @@ DEFAULT_DCN_CROSSOVER_BYTES = 4 << 20
 # messages instead of r²·h·(h−1) per-rank ones — docs/moe.md).
 # Measured per pod by ``benchmarks/micro.py --alltoall-sweep``.
 DEFAULT_ALLTOALL_CROSSOVER_BYTES = 1 << 20
+
+# default relative-error budget for the autotune codec sweep
+# (autotune/runner.py compression phase): the cheapest codec whose
+# measured round-trip relative error stays under this bound is the one
+# recorded in the tuning file.  1e-2 admits fp8's per-chunk-scaled
+# quantization on typical gradient distributions while rejecting it for
+# payloads whose dynamic range blows the 4-bit exponent; bf16 (rel err
+# ~2^-8) always clears it.
+DEFAULT_COMPRESS_ERROR_BUDGET = 1e-2
 
 # default capacity-chunk count of the expert-parallel MoE helper
 # (parallel/moe.py): the per-expert compute and the combine-alltoall
@@ -271,6 +281,27 @@ FLAGS = {
              "contiguous blocks over DCN — 1/r the DCN message count of "
              "flat).  Default 1 MiB; bit-identical results either way "
              "(docs/moe.md)."),
+        Flag("MPI4JAX_TPU_COMPRESS", "choice", "off",
+             "Wire compression for the inter-host (DCN) leg of the "
+             "hierarchical lowerings (ops/_compress.py): ``bf16`` casts "
+             "float32 DCN payloads to bfloat16 on the wire (2x fewer "
+             "bytes), ``fp8`` quantizes to float8 with a per-chunk "
+             "scale (~3.7x fewer), ``auto`` takes the tuning layer's "
+             "measured pick (bf16 without one).  ICI stays exact in "
+             "every mode; compressed results are NOT bit-identical to "
+             "the exact run — pair with the error-feedback API "
+             "(mpx.compress.ef_allreduce) for unbiased training "
+             "(docs/compression.md).  ``off`` (default) keeps cache "
+             "tokens and HLO byte-identical to a build without the "
+             "codec layer.",
+             choices=COMPRESS_MODES),
+        Flag("MPI4JAX_TPU_COMPRESS_ERROR_BUDGET", "float",
+             DEFAULT_COMPRESS_ERROR_BUDGET,
+             "Relative-error budget of the autotune codec sweep "
+             "(``mpx.autotune()`` compression phase): the cheapest codec "
+             "whose measured round-trip relative error stays under this "
+             "bound becomes the tuned ``compress`` knob.  Default 1e-2 "
+             "(docs/compression.md)."),
         Flag("MPI4JAX_TPU_MOE_CAPACITY_CHUNKS", "int",
              DEFAULT_MOE_CAPACITY_CHUNKS,
              "Capacity-chunk count of the expert-parallel MoE helper "
@@ -598,6 +629,7 @@ def tuning_snapshot() -> Optional[dict]:
         "alltoall_crossover_bytes": DEFAULT_ALLTOALL_CROSSOVER_BYTES,
         "fusion_bucket_bytes": DEFAULT_FUSION_BUCKET_BYTES,
         "overlap_chunks": DEFAULT_OVERLAP_CHUNKS,
+        "compress": "off",
     }
     getters = {
         "ring_crossover_bytes": ring_crossover_bytes,
@@ -605,6 +637,7 @@ def tuning_snapshot() -> Optional[dict]:
         "alltoall_crossover_bytes": alltoall_crossover_bytes,
         "fusion_bucket_bytes": fusion_bucket_bytes,
         "overlap_chunks": overlap_chunks,
+        "compress": compress_mode,
     }
     knobs = {}
     for name, flag in KNOB_FLAGS.items():
@@ -888,6 +921,40 @@ def alltoall_crossover_bytes() -> int:
         "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "alltoall_crossover_bytes",
         DEFAULT_ALLTOALL_CROSSOVER_BYTES,
     )
+
+
+def compress_mode(payload_bytes: Optional[int] = None) -> str:
+    """Effective DCN-leg compression codec (``MPI4JAX_TPU_COMPRESS``):
+    ``off`` (default) / ``bf16`` / ``fp8`` — the usual default < tuning
+    < env precedence, payload-bucketed like :func:`overlap_chunks`.
+    ``auto`` (env or tuned) resolves to the tuning layer's measured
+    codec for this payload bucket, or ``bf16`` without one — callers
+    always see a concrete codec, never ``auto``."""
+    mode = _parse_env_choice("MPI4JAX_TPU_COMPRESS")
+    raw = _getenv("MPI4JAX_TPU_COMPRESS")
+    explicit = raw is not None and bool(raw.strip())
+    if not explicit or mode == "auto":
+        tuned = _tuned_knob("compress", payload_bytes=payload_bytes)
+        if tuned is not None:
+            tuned = str(tuned).lower()
+            if tuned != "auto":
+                return tuned
+        if mode == "auto":
+            return "bf16"
+    return mode
+
+
+def compress_error_budget() -> float:
+    """Relative-error budget of the autotune codec sweep
+    (``MPI4JAX_TPU_COMPRESS_ERROR_BUDGET``; default 1e-2)."""
+    val = parse_env_float("MPI4JAX_TPU_COMPRESS_ERROR_BUDGET",
+                          DEFAULT_COMPRESS_ERROR_BUDGET)
+    if val is None or val <= 0:
+        raise ValueError(
+            "MPI4JAX_TPU_COMPRESS_ERROR_BUDGET must be a positive "
+            f"relative error bound, got {val!r}"
+        )
+    return val
 
 
 def moe_capacity_chunks() -> int:

@@ -749,6 +749,22 @@ def _scripted_micro():
                  "overlap_us": 5.0 + abs(chunks - want),
                  "overlap_speedup": 1.0}]
 
+    def bench_compression(comm, sizes_mb, iters):
+        # bf16 fits the default 1e-2 error budget, fp8 does not; bf16's
+        # modeled leg beats off -> the tuned knob buckets to bf16 above
+        # the dcn crossover
+        return [
+            {"size_mb": mb, "codec": codec, "topology": "2x4",
+             "logical_dcn_bytes": int(mb * 5e5),
+             "wire_dcn_bytes": int(mb * 5e5) // div,
+             "modeled_dcn_us": 100.0 * mb / div,
+             "rel_err": err}
+            for mb in sizes_mb
+            for codec, div, err in (("off", 1, 0.0),
+                                    ("bf16", 2, 4e-3),
+                                    ("fp8", 4, 7e-2))
+        ]
+
     def fit_alpha_beta(points):
         return 2.0, 1.0
 
@@ -764,7 +780,8 @@ def _scripted_micro():
 
     for fn in (bench_sendrecv_ring, bench_allreduce_algos,
                bench_hierarchy, bench_alltoall, bench_fusion,
-               bench_overlap, fit_alpha_beta, measured_ring_crossover):
+               bench_overlap, bench_compression, fit_alpha_beta,
+               measured_ring_crossover):
         setattr(mod, fn.__name__, fn)
     return mod
 
@@ -814,6 +831,23 @@ def test_autotune_pipeline_on_scripted_sweeps(tmp_path, monkeypatch):
     assert config.active_tuning().stamp == result.stamp
     assert config.ring_crossover_bytes() == \
         payload["tuned"]["ring_crossover_bytes"]
+    # the PR-17 codec knob: bf16 fits the scripted error budget and
+    # beats off on the modeled DCN leg; fp8 is over budget and loses.
+    # Bucketed: legs below the fitted dcn crossover stay exact ("off")
+    comp = payload["tuned"]["compress"]
+    assert comp == [
+        {"max_bytes": payload["tuned"]["dcn_crossover_bytes"],
+         "codec": "off"},
+        {"max_bytes": None, "codec": "bf16"},
+    ]
+    assert payload["measured"]["compress_rel_err_bf16"] == 4e-3
+    assert payload["provenance"]["fit_sources"]["compress"] == \
+        "sweep vs error budget"
+    # the layer serves it through the payload-bucketed getter: a leg
+    # below the crossover stays exact, one above compresses
+    small = payload["tuned"]["dcn_crossover_bytes"]
+    assert config.compress_mode(payload_bytes=small) == "off"
+    assert config.compress_mode(payload_bytes=small + 1) == "bf16"
     assert result.unfitted == ()
     assert "links" in result.fitted and "commit" in result.fitted
 

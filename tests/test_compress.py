@@ -1,0 +1,468 @@
+"""Wire compression: the traced integration half (docs/compression.md).
+
+The byte math, config resolution, schema grammar, and checker matrix
+run under any JAX in tests/test_compress_pure.py via the isolated
+loader; here the codec layer runs for real on the 8-device CPU mesh
+under a faked multi-host topology:
+
+- per-codec parity for the hierarchical reduction family and alltoall
+  (off is bit-identical to flat; bf16/fp8 land within the documented
+  tolerances — compression is opt-in and NOT bit-exact);
+- the zero-cost contract: with the knob off (or on a single-host comm
+  where no DCN leg exists) the lowered HLO is byte-identical, and
+  explicit ``off`` replays the unset program from cache (the token
+  pin);
+- toggle-retrace: flipping the knob misses the program caches exactly
+  once per mode;
+- error-feedback: ``ef_allreduce`` degenerates to the plain allreduce
+  with the layer off, enforces tree compatibility, and under fp8 the
+  telescoping invariant holds (the sum of quantized updates tracks the
+  sum of true gradients minus the final residual);
+- EF residuals across elastic reconfigs: bit-identical through
+  ``ShardStore`` commit/restore, re-sharded through the committed
+  ``last_rank_map`` on shrink AND grow, and a cold joiner's row is
+  zeroed, never stale;
+- telemetry's logical-vs-wire DCN split on a live compressed program;
+- MPX138 positive/negative through ``mpx.analyze`` and the ambient
+  ``MPI4JAX_TPU_ANALYZE=error`` mode.
+"""
+
+import numpy as np
+import pytest
+
+mpx = pytest.importorskip("mpi4jax_tpu",
+                          exc_type=(ImportError, RuntimeError))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from helpers import per_rank, world  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_compress_env(monkeypatch):
+    for flag in ("MPI4JAX_TPU_COMPRESS",
+                 "MPI4JAX_TPU_COMPRESS_ERROR_BUDGET",
+                 "MPI4JAX_TPU_TOPOLOGY",
+                 "MPI4JAX_TPU_COLLECTIVE_ALGO",
+                 "MPI4JAX_TPU_DCN_CROSSOVER_BYTES",
+                 "MPI4JAX_TPU_RING_CROSSOVER_BYTES",
+                 "MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES"):
+        monkeypatch.delenv(flag, raising=False)
+    mpx.clear_caches()
+    yield
+    mpx.clear_caches()
+
+
+def _two_hosts(monkeypatch):
+    _, size = world()
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    return 2, size // 2
+
+
+def _forced_hier(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "hier")
+
+
+def _rand_global(size, nelem, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((size, nelem)).astype(np.float32) * scale
+
+
+# ---------------------------------------------------------------------------
+# per-codec parity on the hierarchical lowerings
+# ---------------------------------------------------------------------------
+
+
+# documented parity envelopes (docs/compression.md): bf16 keeps ~8
+# mantissa bits; fp8's per-chunk scale bounds the step at maxabs/8
+_TOL = {"bf16": 5e-3, "fp8": 5e-2}
+
+
+def test_hier_allreduce_off_is_bit_identical_to_flat(monkeypatch):
+    _, size = world()
+    vals = _rand_global(size, 512)
+    x = jnp.asarray(vals)
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "butterfly")
+    flat, _ = mpx.allreduce(x, op=mpx.SUM)
+    _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "off")
+    hier, _ = mpx.allreduce(x, op=mpx.SUM)
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "fp8"])
+def test_hier_allreduce_parity_per_codec(mode, monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    vals = _rand_global(size, 512)
+    want = np.add.reduce(vals.astype(np.float64))
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", mode)
+    got, _ = mpx.allreduce(jnp.asarray(vals), op=mpx.SUM)
+    got = np.asarray(got)
+    assert got.shape == vals.shape
+    scale = np.maximum(np.abs(want), 1.0)
+    rel = np.max(np.abs(got[0] - want) / scale)
+    assert rel <= _TOL[mode], (mode, rel)
+    # every rank sees the same reduced values
+    np.testing.assert_array_equal(got, np.broadcast_to(got[0], got.shape))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "fp8"])
+def test_hier_reduce_scatter_parity_per_codec(mode, monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    vals = _rand_global(size, size * 64, seed=1)
+    want = np.add.reduce(vals.astype(np.float64)).reshape(size, 64)
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", mode)
+    got, _ = mpx.reduce_scatter(jnp.asarray(vals), op=mpx.SUM)
+    got = np.asarray(got)
+    scale = np.maximum(np.abs(want), 1.0)
+    rel = np.max(np.abs(got - want) / scale)
+    assert rel <= _TOL[mode], (mode, rel)
+
+
+def test_hier_alltoall_parity_bf16(monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    monkeypatch.setenv("MPI4JAX_TPU_ALLTOALL_CROSSOVER_BYTES", "1")
+    vals = _rand_global(size, size * 32, seed=2)
+    want = (vals.reshape(size, size, 32)
+            .transpose(1, 0, 2).reshape(size, size * 32))
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    got, _ = mpx.alltoall(jnp.asarray(vals))
+    got = np.asarray(got)
+    # a pure cast-through: elementwise bf16 rounding, no accumulation
+    assert np.max(np.abs(got - want)) <= 2.0 ** -8 * np.max(
+        np.abs(want)) + 1e-6
+
+
+def test_fp8_degrades_to_bf16_for_non_sum(monkeypatch):
+    # fp8's per-chunk scales only commute with SUM; a MAX reduction
+    # under fp8 ships the bf16 wire instead (pure pin:
+    # _hierarchy.selected_codec) — so parity lands in the bf16 envelope
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    vals = _rand_global(size, 512, seed=3)
+    want = np.maximum.reduce(vals.astype(np.float64))
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "fp8")
+    got, _ = mpx.allreduce(jnp.asarray(vals), op=mpx.MAX)
+    rel = np.max(np.abs(np.asarray(got)[0] - want)
+                 / np.maximum(np.abs(want), 1.0))
+    assert rel <= _TOL["bf16"], rel
+
+
+# ---------------------------------------------------------------------------
+# the zero-cost contract: HLO byte-identity + cache-token pin
+# ---------------------------------------------------------------------------
+
+
+def _lowered_sum(x):
+    @mpx.spmd
+    def f(xl):
+        res, _ = mpx.allreduce(xl, op=mpx.SUM)
+        return res
+
+    return jax.jit(f).lower(x).as_text()
+
+
+def test_hlo_byte_identical_with_knob_off(monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    x = jnp.ones((size, 1024), jnp.float32)
+    base = _lowered_sum(x)
+    # explicit off IS the default: byte-identical program
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "off")
+    assert _lowered_sum(x) == base
+    # a live codec rewrites the DCN leg: the program must differ
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    assert _lowered_sum(x) != base
+
+
+def test_hlo_unchanged_by_codec_without_a_dcn_leg(monkeypatch):
+    # single-host comm: no DCN leg exists, so even a live codec changes
+    # nothing about the lowered program — compression is a property of
+    # the inter-host phase, not of the collective
+    _, size = world()
+    x = jnp.ones((size, 1024), jnp.float32)
+    base = _lowered_sum(x)
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    assert _lowered_sum(x) == base
+
+
+def test_compress_toggle_retraces_eager_program(monkeypatch):
+    _, size = world()
+    _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    mpx.clear_caches()
+    x = per_rank(lambda r: np.full((64,), float(r)))
+    mpx.allreduce(x, op=mpx.SUM)
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    mpx.allreduce(x, op=mpx.SUM)           # new codec: must retrace
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "fp8")
+    mpx.allreduce(x, op=mpx.SUM)           # and again per codec
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "off")
+    mpx.allreduce(x, op=mpx.SUM)           # off == unset: the FIRST program
+    s = mpx.cache_stats()
+    assert s["misses"] == 3 and s["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# error feedback
+# ---------------------------------------------------------------------------
+
+
+def test_ef_allreduce_off_is_plain_and_residual_stays_zero():
+    _, size = world()
+    grads = {"w": per_rank(lambda r: np.full((16,), float(r + 1))),
+             "b": per_rank(lambda r: np.full((4,), -float(r)))}
+    residual = mpx.compress.ef_zeros_like(grads)
+    red, new_res, token = mpx.compress.ef_allreduce(
+        grads, residual, op=mpx.SUM)
+    want_w = sum(range(1, size + 1))
+    np.testing.assert_array_equal(
+        np.asarray(red["w"]),
+        np.full((size, 16), float(want_w), np.float32))
+    for leaf in (new_res["w"], new_res["b"]):
+        assert float(np.max(np.abs(np.asarray(leaf)))) == 0.0
+    assert token is not None
+
+
+def test_ef_allreduce_rejects_mismatched_trees():
+    grads = {"w": per_rank(lambda r: np.zeros((4,)))}
+    bad = {"w": per_rank(lambda r: np.zeros((4,))),
+           "extra": per_rank(lambda r: np.zeros((4,)))}
+    with pytest.raises(ValueError):
+        mpx.compress.ef_allreduce(grads, bad, op=mpx.SUM)
+
+
+def test_ef_telescoping_under_fp8(monkeypatch):
+    """The EF guarantee: after T steps, the sum of what was actually
+    applied (the quantized, reduced updates) equals the sum of the true
+    reduced gradients minus what the final residual still carries."""
+    _, size = world()
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "fp8")
+    rng = np.random.default_rng(7)
+    residual = mpx.compress.ef_zeros_like(
+        {"w": per_rank(lambda r: np.zeros((512,)))})
+    applied = np.zeros((512,), np.float64)
+    true_sum = np.zeros((512,), np.float64)
+    for _ in range(5):
+        vals = rng.standard_normal((size, 512)).astype(np.float32)
+        grads = {"w": jnp.asarray(vals)}
+        red, residual, _ = mpx.compress.ef_allreduce(
+            grads, residual, op=mpx.SUM)
+        applied += np.asarray(red["w"])[0].astype(np.float64)
+        true_sum += np.add.reduce(vals.astype(np.float64))
+    res_sum = np.add.reduce(
+        np.asarray(residual["w"]).astype(np.float64))
+    np.testing.assert_allclose(applied + res_sum, true_sum,
+                               rtol=0, atol=1e-2)
+    # and the residual is genuinely nonzero — fp8 quantized something
+    assert float(np.max(np.abs(res_sum))) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# EF residuals across elastic reconfigs (docs/compression.md,
+# docs/resilience.md)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_fixture():
+    from mpi4jax_tpu.resilience import elastic as el
+
+    el._reset_epoch_for_tests()
+    el.take_pending_failure()
+    mpx.set_default_mesh(None)
+    mpx.clear_caches()
+    return el
+
+
+def _world_store():
+    mesh = mpx.make_world_mesh()
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    return mpx.ShardStore(comm)
+
+
+@pytest.mark.faults
+def test_ef_residual_commit_restore_bit_identity():
+    el = _elastic_fixture()
+    try:
+        store = _world_store()
+        _, size = world()
+        res = {"w": per_rank(lambda r: np.full((8,), r / 7.0))}
+        state = {"params": per_rank(lambda r: np.ones((4,))),
+                 "ef_residual": res}
+        store.commit(3, state)
+        assert store.last_rank_map is None  # no reconfig yet
+        step, restored = store.restore()
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(res["w"]),
+                                      np.asarray(restored["ef_residual"]["w"]))
+    finally:
+        el._reset_epoch_for_tests()
+        mpx.set_default_mesh(None)
+        mpx.clear_caches()
+
+
+@pytest.mark.faults
+def test_ef_residual_reshards_through_shrink_rank_map():
+    el = _elastic_fixture()
+    try:
+        store = _world_store()
+        _, size = world()
+        res = {"w": per_rank(lambda r: np.full((8,), float(r)))}
+        store.commit(5, {"ef_residual": res})
+        el.advance_epoch()
+        rank_map = store.apply_shrink({3})
+        assert store.last_rank_map == rank_map
+        assert 3 not in rank_map
+        new_k = size - 1
+        moved = mpx.compress.ef_reshard(res, store.last_rank_map, new_k)
+        got = np.asarray(moved["w"])
+        assert got.shape == (new_k, 8)
+        # each surviving rank carries ITS old row — rank 3's is gone
+        keep = [r for r in range(size) if r != 3]
+        np.testing.assert_array_equal(got, np.asarray(res["w"])[keep])
+    finally:
+        el._reset_epoch_for_tests()
+        mpx.set_default_mesh(None)
+        mpx.clear_caches()
+
+
+@pytest.mark.faults
+def test_ef_residual_zeroed_for_cold_joiner_on_grow():
+    el = _elastic_fixture()
+    try:
+        store = _world_store()
+        _, size = world()
+        store.commit(2, {"x": per_rank(lambda r: np.ones((2,)))})
+        el.advance_epoch()
+        store.apply_shrink({size - 1})
+        # re-commit at the shrunken world: k = size-1 rows
+        small = {"w": jnp.stack(
+            [jnp.full((8,), float(r)) for r in range(size - 1)])}
+        store.commit(6, {"ef_residual": small})
+        el.advance_epoch(world=size, cause="join")
+        store.apply_grow(1)
+        rmap = store.last_rank_map
+        # grow stamps identity over the committed world: survivors keep
+        # their rows, the joiner maps to nothing
+        assert rmap == {r: r for r in range(size - 1)}
+        grown = mpx.compress.ef_reshard(small, rmap, size)
+        got = np.asarray(grown["w"])
+        assert got.shape == (size, 8)
+        np.testing.assert_array_equal(got[:-1], np.asarray(small["w"]))
+        # the cold joiner starts from ZERO error — never a stale row
+        np.testing.assert_array_equal(got[-1], np.zeros(8, np.float32))
+    finally:
+        el._reset_epoch_for_tests()
+        mpx.set_default_mesh(None)
+        mpx.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the logical-vs-wire DCN split on a live program
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_wire_split_on_compressed_program(monkeypatch):
+    from mpi4jax_tpu.ops._hierarchy import hier_link_bytes
+
+    _, size = world()
+    h, r = _two_hosts(monkeypatch)
+    _forced_hier(monkeypatch)
+    nelem = 256
+    nbytes = nelem * 4
+    x = jnp.ones((size, nelem), jnp.float32)
+
+    def run():
+        mpx.telemetry.reset()
+
+        @mpx.spmd
+        def f(xl):
+            res, _ = mpx.allreduce(xl, op=mpx.SUM)
+            return res
+
+        f(x)
+        (row,) = [row for row in mpx.telemetry.snapshot()["ops"].values()
+                  if row["algo"] == "hier"]
+        return row
+
+    mpx.set_telemetry_mode("counters")
+    try:
+        intra, inter = hier_link_bytes("allreduce", nbytes, h, r)
+        monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+        row = run()
+        assert row["inter_bytes"] == inter          # logical: unchanged
+        assert row["wire_inter_bytes"] == mpx.compress.wire_bytes(
+            inter, "bf16")                          # wire: halved
+        assert row["intra_bytes"] == intra          # ICI stays exact
+        monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "off")
+        row = run()
+        assert row["wire_inter_bytes"] == row["inter_bytes"] == inter
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# MPX138 — traced positive/negative through analyze and env=error
+# ---------------------------------------------------------------------------
+
+
+def _sum(x):
+    res, _ = mpx.allreduce(x, op=mpx.SUM)
+    return res
+
+
+def _mpx138_env(monkeypatch, size):
+    monkeypatch.setenv("MPI4JAX_TPU_TOPOLOGY", f"2x{size // 2}")
+    monkeypatch.setenv("MPI4JAX_TPU_COLLECTIVE_ALGO", "hier")
+    monkeypatch.setenv("MPI4JAX_TPU_DCN_CROSSOVER_BYTES", "1024")
+
+
+def test_mpx138_traced_positive_and_negative(monkeypatch):
+    comm, size = world()
+    _mpx138_env(monkeypatch, size)
+    x = jnp.ones((size, 4096), jnp.float32)  # 16 KiB: leg 4 KiB > 1 KiB
+    # positive: hier above the crossover with the codec layer off
+    report = mpx.analyze(_sum, x, comm=comm)
+    found = [f for f in report.findings if f.code == "MPX138"]
+    assert len(found) == 1
+    assert found[0].severity == "advisory"
+    assert "MPI4JAX_TPU_COMPRESS=bf16" in found[0].message
+    # negative: the layer is on — the user already made the trade
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    report = mpx.analyze(_sum, x, comm=comm)
+    assert not [f for f in report.findings if f.code == "MPX138"]
+    monkeypatch.delenv("MPI4JAX_TPU_COMPRESS")
+    # negative: below the crossover compression cannot pay
+    report = mpx.analyze(_sum, jnp.ones((size, 64), jnp.float32),
+                         comm=comm)
+    assert not [f for f in report.findings if f.code == "MPX138"]
+    # negative: non-float32 payloads ship exact in every mode
+    report = mpx.analyze(
+        lambda v: mpx.allreduce(v, op=mpx.SUM)[0],
+        jnp.ones((size, 4096), jnp.int32), comm=comm)
+    assert not [f for f in report.findings if f.code == "MPX138"]
+
+
+def test_mpx138_fires_through_env_error_mode(monkeypatch):
+    comm, size = world()
+    _mpx138_env(monkeypatch, size)
+    x = jnp.ones((size, 4096), jnp.float32)
+    mpx.set_analyze_mode("error")
+    try:
+        with pytest.raises(mpx.AnalysisError) as exc:
+            mpx.run(_sum, x, comm=comm)
+        assert any(f.code == "MPX138" for f in exc.value.findings)
+    finally:
+        mpx.set_analyze_mode(None)
+        mpx.clear_caches()

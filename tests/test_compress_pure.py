@@ -1,0 +1,612 @@
+"""Wire compression: the pure half (docs/compression.md).
+
+The codec byte math (``ops/_codec.py``), the config-layer resolution
+(default < tuning < env, payload-bucketed), the ``mpx-tuning/1``
+codec-bucket grammar, the cache-token byte-identity pin (off
+contributes NOTHING; bf16/fp8 fold and retrace), the cost model's
+wire-byte pricing, telemetry's logical/wire DCN split, the EF residual
+re-shard plans across elastic reconfigurations, the MPX138 advisory's
+positive/negative matrix, and the ``benchmarks/regress.py`` ratchet —
+all loaded under a private package name (the tests/test_analysis_pure
+isolated loader) so everything here runs even where the installed JAX
+is below the package's floor.  The traced integration half — hier
+parity per codec, EF convergence, retrace-on-flip, the live telemetry
+counters — lives in tests/test_compress.py.
+"""
+
+import importlib
+import json
+import pathlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_compress_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "ops", "analysis", "autotune", "parallel",
+                "telemetry"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "autotune.schema", "ops._fusion",
+                "ops._codec", "ops._algos", "ops._hierarchy",
+                "ops._compress", "telemetry.core", "analysis.report",
+                "analysis.graph", "analysis.checkers",
+                "analysis.schedule", "analysis.matcher",
+                "analysis.progress", "analysis.costmodel",
+                "analysis.cost", "parallel.rankspec",
+                "parallel.topology"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+config = sys.modules[f"{_ISO_NAME}.utils.config"]
+schema = sys.modules[f"{_ISO_NAME}.autotune.schema"]
+codec = sys.modules[f"{_ISO_NAME}.ops._codec"]
+algos = sys.modules[f"{_ISO_NAME}.ops._algos"]
+hierarchy = sys.modules[f"{_ISO_NAME}.ops._hierarchy"]
+compress = sys.modules[f"{_ISO_NAME}.ops._compress"]
+telemetry = sys.modules[f"{_ISO_NAME}.telemetry.core"]
+cm = sys.modules[f"{_ISO_NAME}.analysis.costmodel"]
+graph = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+checkers = sys.modules[f"{_ISO_NAME}.analysis.checkers"]
+
+E = graph.CollectiveEvent
+G = graph.CollectiveGraph
+
+sys.path.insert(0, str(REPO / "benchmarks"))
+import regress  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_layer(monkeypatch):
+    """Every test starts with no env override and no tuning layer."""
+    monkeypatch.delenv("MPI4JAX_TPU_COMPRESS", raising=False)
+    monkeypatch.delenv("MPI4JAX_TPU_COMPRESS_ERROR_BUDGET", raising=False)
+    monkeypatch.delenv("MPI4JAX_TPU_TUNING", raising=False)
+    yield
+    config.load_tuning(None)
+
+
+def codes_of(g):
+    return [f.code for f in checkers.run_checkers(g)]
+
+
+# ---------------------------------------------------------------------------
+# the byte math (ops/_codec.py) — one truth source for every layer
+# ---------------------------------------------------------------------------
+
+
+def test_wire_bytes_table():
+    n = 1 << 20
+    assert codec.wire_bytes(n, None) == n
+    assert codec.wire_bytes(n, "off") == n
+    assert codec.wire_bytes(n, "bf16") == n // 2
+    # fp8: 1 byte/element + one f32 scale per 256-element chunk
+    elems = n // 4
+    assert codec.wire_bytes(n, "fp8") == elems + 4 * (elems // 256)
+    # a partial chunk still pays a whole scale
+    assert codec.wire_bytes(4 * 257, "fp8") == 257 + 4 * 2
+    assert codec.wire_bytes(0, "fp8") == 0
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        codec.wire_bytes(n, "gzip")
+
+
+def test_compression_ratio_acceptance_floor():
+    # the PR's acceptance ratio: both codecs cut DCN wire bytes >= 2x
+    n = 4 << 20
+    assert codec.compression_ratio(n, "bf16") == 2.0
+    assert codec.compression_ratio(n, "fp8") >= 2.0
+    assert codec.compression_ratio(n, "fp8") == pytest.approx(3.94, abs=0.01)
+    assert codec.compression_ratio(n, None) == 1.0
+    assert codec.compression_ratio(0, "fp8") == 1.0
+
+
+def test_codec_for_gates(monkeypatch):
+    # default: off -> no codec for anything
+    assert codec.codec_for(1 << 20, "float32") is None
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    assert codec.codec_for(1 << 20, "float32") == "bf16"
+    # float32 only — every other dtype ships exact in every mode
+    for dt in ("float64", "int32", "bfloat16", "float16", ""):
+        assert codec.codec_for(1 << 20, dt) is None
+
+
+# ---------------------------------------------------------------------------
+# config resolution: default < tuning < env, payload-bucketed
+# ---------------------------------------------------------------------------
+
+
+def test_compress_mode_default_off():
+    assert config.compress_mode() == "off"
+    assert config.compress_mode(payload_bytes=1 << 30) == "off"
+
+
+def test_compress_mode_env_wins(monkeypatch):
+    config.load_tuning({"schema": "mpx-tuning/1",
+                        "tuned": {"compress": "fp8"}})
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    # explicit non-auto env beats the tuned value
+    assert config.compress_mode() == "bf16"
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "off")
+    assert config.compress_mode() == "off"
+
+
+def test_compress_mode_auto_resolves(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "auto")
+    # auto with no tuning layer: bf16 (the conservative codec)
+    assert config.compress_mode() == "bf16"
+    # auto with a tuned codec: the measured pick
+    config.load_tuning({"schema": "mpx-tuning/1",
+                        "tuned": {"compress": "fp8"}})
+    assert config.compress_mode() == "fp8"
+
+
+def test_compress_mode_payload_bucketed():
+    config.load_tuning({
+        "schema": "mpx-tuning/1",
+        "tuned": {"compress": [
+            {"max_bytes": 1 << 20, "codec": "off"},
+            {"max_bytes": None, "codec": "fp8"},
+        ]},
+    })
+    assert config.compress_mode(payload_bytes=1 << 20) == "off"
+    assert config.compress_mode(payload_bytes=(1 << 20) + 1) == "fp8"
+    # no payload context: the open-ended bucket answers
+    assert config.compress_mode() == "fp8"
+
+
+def test_compress_error_budget(monkeypatch):
+    assert config.compress_error_budget() == 1e-2
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS_ERROR_BUDGET", "0.05")
+    assert config.compress_error_budget() == 0.05
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS_ERROR_BUDGET", "-1")
+    with pytest.raises(ValueError):
+        config.compress_error_budget()
+
+
+def test_flags_registered():
+    assert "MPI4JAX_TPU_COMPRESS" in config.FLAGS
+    assert "MPI4JAX_TPU_COMPRESS_ERROR_BUDGET" in config.FLAGS
+    assert schema.KNOB_FLAGS["compress"] == "MPI4JAX_TPU_COMPRESS"
+
+
+def test_tuning_snapshot_carries_compress(monkeypatch):
+    config.load_tuning({"schema": "mpx-tuning/1",
+                        "tuned": {"compress": "bf16"}})
+    snap = config.tuning_snapshot()
+    knob = snap["knobs"]["compress"]
+    assert knob["tuned"] == "bf16"
+    assert knob["default"] == "off"
+    assert knob["effective"] == "bf16"
+    assert knob["env_wins"] is False
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "off")
+    knob = config.tuning_snapshot()["knobs"]["compress"]
+    assert knob["env_wins"] is True and knob["effective"] == "off"
+
+
+# ---------------------------------------------------------------------------
+# the mpx-tuning/1 codec-bucket grammar
+# ---------------------------------------------------------------------------
+
+
+def test_schema_accepts_codec_values():
+    for val in ("off", "bf16", "fp8",
+                [{"max_bytes": 1024, "codec": "off"},
+                 {"max_bytes": None, "codec": "bf16"}]):
+        schema.validate_tuning_dict(
+            {"schema": "mpx-tuning/1", "tuned": {"compress": val}})
+
+
+def test_schema_rejects_bad_codecs():
+    for val in ("gzip", "auto2", 7,
+                [{"max_bytes": 1024, "codec": "zstd"}],
+                [{"max_bytes": 1024}],
+                [{"max_bytes": 2048, "codec": "off"},
+                 {"max_bytes": 1024, "codec": "bf16"}]):
+        with pytest.raises(ValueError):
+            schema.validate_tuning_dict(
+                {"schema": "mpx-tuning/1", "tuned": {"compress": val}})
+
+
+def test_tuning_knob_bucket_lookup():
+    tf = schema.as_tuning({
+        "schema": "mpx-tuning/1",
+        "tuned": {"compress": [
+            {"max_bytes": 4096, "codec": "off"},
+            {"max_bytes": None, "codec": "fp8"},
+        ]},
+    })
+    assert tf.knob("compress", payload_bytes=4096) == "off"
+    assert tf.knob("compress", payload_bytes=4097) == "fp8"
+    assert tf.knob("compress") == "fp8"  # open-ended bucket
+
+
+# ---------------------------------------------------------------------------
+# cache token: off is byte-identical, a codec folds and retraces
+# ---------------------------------------------------------------------------
+
+
+def test_cache_token_off_is_the_pre_compression_tuple():
+    # the byte-identity pin: with the knob off (the default) the token
+    # is EXACTLY the flat pre-compression 5-tuple — no trailing entry,
+    # so cache keys (and the HLO they key) never move on upgrade
+    tok = algos.algo_cache_token()
+    assert len(tok) == 5
+    assert "compress" not in str(tok)
+
+
+def test_cache_token_folds_active_codec(monkeypatch):
+    base = algos.algo_cache_token()
+    for mode in ("bf16", "fp8"):
+        monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", mode)
+        tok = algos.algo_cache_token()
+        assert tok != base  # flipping the knob retraces
+        assert tok[:5] == base
+        assert ("compress", mode) in tok
+    # auto resolves before folding: the token carries the CONCRETE codec
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "auto")
+    assert ("compress", "bf16") in algos.algo_cache_token()
+
+
+# ---------------------------------------------------------------------------
+# DCN-leg selection math (ops/_hierarchy.py)
+# ---------------------------------------------------------------------------
+
+
+def test_dcn_leg_bytes():
+    # reduction family: the inter phase moves payload/r per host pair
+    assert hierarchy.dcn_leg_bytes("allreduce", 4096, 4) == 1024
+    assert hierarchy.dcn_leg_bytes("reduce_scatter", 4097, 4) == 1025
+    # alltoall: the host-aggregated exchange ships the full payload
+    assert hierarchy.dcn_leg_bytes("alltoall", 4096, 4) == 4096
+
+
+def test_selected_codec_respects_payload_bucket(monkeypatch):
+    config.load_tuning({
+        "schema": "mpx-tuning/1",
+        "tuned": {"compress": [
+            {"max_bytes": 1024, "codec": "off"},
+            {"max_bytes": None, "codec": "bf16"},
+        ]},
+    })
+    plan = hierarchy.HierPlan(None, None, 2, 4)
+    # the codec resolves on the DCN-LEG bytes, not the logical payload:
+    # 4096 logical / r=4 = 1024 per-leg -> below the bucket, exact
+    assert hierarchy.selected_codec("allreduce", 4096, plan,
+                                    dtype="float32") is None
+    assert hierarchy.selected_codec("allreduce", 8192, plan,
+                                    dtype="float32") == "bf16"
+    # alltoall's leg is the whole payload
+    assert hierarchy.selected_codec("alltoall", 4096, plan,
+                                    dtype="float32") == "bf16"
+    assert hierarchy.selected_codec("allreduce", 8192, plan,
+                                    dtype="int32") is None
+    # flat lowering / order-preserving callables always ship exact
+    assert hierarchy.selected_codec("allreduce", 8192, None,
+                                    dtype="float32") is None
+    assert hierarchy.selected_codec("allreduce", 8192, plan,
+                                    preserve=True,
+                                    dtype="float32") is None
+
+
+# ---------------------------------------------------------------------------
+# cost model prices the WIRE bytes of a compressed DCN leg
+# ---------------------------------------------------------------------------
+
+
+def test_collective_cost_codec_prices_wire_bytes():
+    n, k, h = 1 << 20, 8, 2
+    exact = cm.collective_cost("allreduce", "hier", n, k, hosts=h,
+                               hier=(h, 4))
+    for c in ("bf16", "fp8"):
+        priced = cm.collective_cost("allreduce", "hier", n, k, hosts=h,
+                                    hier=(h, 4), codec=c)
+        assert priced.dcn.nbytes == codec.wire_bytes(exact.dcn.nbytes, c)
+        assert priced.dcn.rounds == exact.dcn.rounds
+        # ICI phases stay exact in every mode
+        assert priced.ici.nbytes == exact.ici.nbytes
+        assert priced.ici.rounds == exact.ici.rounds
+    # codec=None / "off" is the identity
+    off = cm.collective_cost("allreduce", "hier", n, k, hosts=h,
+                             hier=(h, 4), codec=None)
+    assert off.dcn.nbytes == exact.dcn.nbytes
+
+
+def test_collective_cost_codec_alltoall():
+    n, k, h = 1 << 20, 8, 2
+    exact = cm.collective_cost("alltoall", "hier", n, k, hosts=h,
+                               hier=(h, 4))
+    priced = cm.collective_cost("alltoall", "hier", n, k, hosts=h,
+                                hier=(h, 4), codec="bf16")
+    assert priced.dcn.nbytes == exact.dcn.nbytes // 2
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the logical/wire DCN byte split
+# ---------------------------------------------------------------------------
+
+
+def test_count_op_wire_split():
+    t = telemetry._Counters()
+    t.count_op("allreduce|1|hier|float32", 4096, intra=3072, inter=1024,
+               wire_inter=512)
+    t.count_op("allreduce|1|hier|float32", 4096, intra=3072, inter=1024,
+               wire_inter=512)
+    row = t.ops["allreduce|1|hier|float32"]
+    assert row["inter_bytes"] == 2048
+    assert row["wire_inter_bytes"] == 1024
+
+
+def test_count_op_wire_defaults_to_logical():
+    # un-annotated ops report wire == logical (exact transport)
+    t = telemetry._Counters()
+    t.count_op("bcast|1|native|int32", 4096, intra=4096, inter=128)
+    row = t.ops["bcast|1|native|int32"]
+    assert row["wire_inter_bytes"] == row["inter_bytes"] == 128
+
+
+# ---------------------------------------------------------------------------
+# EF residual re-shard plans across elastic reconfigurations
+# ---------------------------------------------------------------------------
+
+
+def test_ef_reshard_rows_shrink():
+    # 4-rank world loses rank 1: compaction {0:0, 2:1, 3:2}
+    rows = codec.ef_reshard_rows(4, {0: 0, 2: 1, 3: 2}, 3)
+    assert rows == [0, 2, 3]  # each NEW rank carries its OLD row
+
+
+def test_ef_reshard_rows_grow_zeroes_joiners():
+    # 3-rank world grows back to 4: identity map, joiner row is None
+    rows = codec.ef_reshard_rows(3, {0: 0, 1: 1, 2: 2}, 4)
+    assert rows == [0, 1, 2, None]  # None = MUST be zeroed, never stale
+
+
+def test_ef_reshard_rows_validates():
+    with pytest.raises(ValueError, match="new_world"):
+        codec.ef_reshard_rows(2, {0: 0}, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        codec.ef_reshard_rows(2, {5: 0}, 2)
+    # a mapping landing outside the new world is simply dropped
+    assert codec.ef_reshard_rows(3, {0: 0, 2: 7}, 2) == [0, None]
+
+
+def test_ef_reshard_moves_rows_and_zeroes():
+    res = {"w": np.arange(12, dtype=np.float32).reshape(4, 3)}
+    out = compress.ef_reshard(res, {0: 0, 2: 1, 3: 2}, 3)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  res["w"][[0, 2, 3]])
+    grown = compress.ef_reshard(out, {0: 0, 1: 1, 2: 2}, 4)
+    np.testing.assert_array_equal(np.asarray(grown["w"][3]),
+                                  np.zeros(3, np.float32))
+
+
+def test_ef_zeros_like_and_roundtrip_identity():
+    tree = {"a": np.ones((2, 3), np.float32)}
+    z = compress.ef_zeros_like(tree)
+    assert float(np.sum(np.abs(np.asarray(z["a"])))) == 0.0
+    x = np.linspace(-1, 1, 64, dtype=np.float32)
+    import jax.numpy as jnp
+
+    xv = jnp.asarray(x)
+    np.testing.assert_array_equal(np.asarray(compress.roundtrip(xv, None)),
+                                  x)
+    np.testing.assert_array_equal(np.asarray(compress.roundtrip(xv, "off")),
+                                  x)
+    # bf16 roundtrip error is bounded by the 2^-8 mantissa step
+    y = np.asarray(compress.roundtrip(xv, "bf16"))
+    assert float(np.max(np.abs(y - x))) <= 2.0 ** -8
+    # fp8 roundtrip error bounded by the per-chunk scale * e4m3 step
+    y8 = np.asarray(compress.roundtrip(xv, "fp8"))
+    assert float(np.max(np.abs(y8 - x))) <= 1.0 / 8
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        compress.roundtrip(xv, "gzip")
+
+
+# ---------------------------------------------------------------------------
+# MPX138 — uncompressed DCN leg above the crossover
+# ---------------------------------------------------------------------------
+
+
+_C_META = {"compress": "off", "dcn_crossover_bytes": 1024}
+
+
+def _hier_ev(op="allreduce", payload=8192, comm_size=8, hosts=2, **kw):
+    return E(0, op, comm_uid=1, comm_size=comm_size, hosts=hosts,
+             payload_bytes=payload, algo="hier",
+             hier=(hosts, comm_size // hosts) if hosts else None, **kw)
+
+
+def test_mpx138_fires_on_uncompressed_hier_leg():
+    g = G(events=[_hier_ev()], meta=dict(_C_META))
+    found = [f for f in checkers.run_checkers(g) if f.code == "MPX138"]
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "advisory"
+    # leg = ceil(8192 / r=4) = 2048 — the per-leg bytes, not the payload
+    assert "2048 B" in f.message and "1024 B" in f.message
+    assert "MPI4JAX_TPU_COMPRESS=bf16" in f.message
+    assert "docs/compression.md" in f.suggestion
+    assert "ef_allreduce" in f.suggestion
+
+
+def test_mpx138_alltoall_leg_is_the_full_payload():
+    # alltoall ships the whole payload over DCN: payload 2048 fires at
+    # crossover 1024 even though 2048/r would not
+    g = G(events=[_hier_ev(op="alltoall", payload=2048)],
+          meta={"compress": "off", "dcn_crossover_bytes": 1025})
+    assert "MPX138" in codes_of(g)
+    g = G(events=[_hier_ev(op="allreduce", payload=2048)],
+          meta={"compress": "off", "dcn_crossover_bytes": 1025})
+    assert "MPX138" not in codes_of(g)  # leg = 512 < 1025
+
+
+def test_mpx138_async_start_counts():
+    g = G(events=[_hier_ev(op="allreduce_start", span=3)],
+          meta=dict(_C_META))
+    assert "MPX138" in codes_of(g)
+
+
+def test_mpx138_cites_measured_crossover():
+    meta = {"compress": "off", "dcn_crossover_bytes": 1 << 30,
+            "measured_dcn_crossover_bytes": 1024,
+            "tuned_stamp": "abc123def456"}
+    g = G(events=[_hier_ev()], meta=meta)
+    (f,) = [x for x in checkers.run_checkers(g) if x.code == "MPX138"]
+    assert "measured DCN crossover" in f.message
+    assert "tuned@abc123def456" in f.message
+
+
+def test_mpx138_negatives():
+    # the layer is already on: the user made the choice
+    g = G(events=[_hier_ev()],
+          meta={"compress": "bf16", "dcn_crossover_bytes": 1024})
+    assert "MPX138" not in codes_of(g)
+    # THIS event already compressed
+    g = G(events=[_hier_ev(codec="bf16")], meta=dict(_C_META))
+    assert "MPX138" not in codes_of(g)
+    # flat algorithm: MPX113's territory, not a codec question
+    g = G(events=[E(0, "allreduce", comm_uid=1, comm_size=8, hosts=2,
+                    payload_bytes=8192, algo="ring")],
+          meta=dict(_C_META))
+    assert "MPX138" not in codes_of(g)
+    # non-float32 payloads ship exact in every mode
+    g = G(events=[_hier_ev(dtype="int32")], meta=dict(_C_META))
+    assert "MPX138" not in codes_of(g)
+    # below the crossover: compression cannot pay
+    g = G(events=[_hier_ev(payload=256)], meta=dict(_C_META))
+    assert "MPX138" not in codes_of(g)
+    # single-host comm: no DCN leg exists
+    g = G(events=[_hier_ev(hosts=1)], meta=dict(_C_META))
+    assert "MPX138" not in codes_of(g)
+    # one rank per host: the hierarchy degenerates
+    g = G(events=[_hier_ev(comm_size=2, hosts=2)], meta=dict(_C_META))
+    assert "MPX138" not in codes_of(g)
+    # hand-built graph without the crossover meta: other rules' tests
+    g = G(events=[_hier_ev()])
+    assert "MPX138" not in codes_of(g)
+
+
+def test_mpx138_in_catalog():
+    report = sys.modules[f"{_ISO_NAME}.analysis.report"]
+    assert any("MPX138" in codes for codes, _fn in checkers.CHECKERS)
+    info = report.CODES["MPX138"]
+    assert info.severity == report.ADVISORY
+    assert "MPI4JAX_TPU_COMPRESS" in info.doc
+
+
+# ---------------------------------------------------------------------------
+# benchmarks/regress.py — the perf ratchet
+# ---------------------------------------------------------------------------
+
+
+def test_regress_collect_keys_rows_by_identity():
+    payload = {"sweep": [
+        {"size_mb": 1.0, "codec": "off", "modeled_dcn_us": 10.0},
+        {"size_mb": 1.0, "codec": "bf16", "modeled_dcn_us": 5.0},
+    ]}
+    cols = regress.collect(payload, "_us")
+    assert len(cols) == 2
+    # keyed by discriminating columns, not list position
+    reordered = {"sweep": list(reversed(payload["sweep"]))}
+    assert regress.collect(reordered, "_us") == cols
+
+
+def test_regress_compare_thresholds():
+    base = {"a": [{"op": "x", "t_us": 100.0}, {"op": "y", "t_us": 100.0}]}
+    cur = {"a": [{"op": "x", "t_us": 109.0}, {"op": "y", "t_us": 112.0}]}
+    reg, imp, only_c, only_b = regress.compare(cur, base, threshold=0.10)
+    assert len(reg) == 1  # only the 12% column trips the 10% ratchet
+    assert not imp and not only_c and not only_b
+    # improvements and one-sided columns never fail the run
+    cur2 = {"a": [{"op": "x", "t_us": 50.0}, {"op": "z", "t_us": 1.0}]}
+    reg, imp, only_c, only_b = regress.compare(cur2, base, threshold=0.10)
+    assert not reg and len(imp) == 1
+    assert len(only_c) == 1 and len(only_b) == 1
+
+
+def test_regress_ignores_non_suffix_and_bools():
+    base = {"r": [{"op": "x", "t_us": 10.0, "bytes": 100, "ok": True}]}
+    cols = regress.collect(base, "_us")
+    assert list(cols.values()) == [10.0]
+
+
+def test_regress_main_exit_codes(tmp_path):
+    base = tmp_path / "base.json"
+    cur = tmp_path / "cur.json"
+    base.write_text(json.dumps(
+        {"s": [{"op": "x", "t_us": 100.0}]}))
+    cur.write_text(json.dumps(
+        {"s": [{"op": "x", "t_us": 105.0}]}))
+    assert regress.main(["--current", str(cur),
+                         "--baseline", str(base)]) == 0
+    cur.write_text(json.dumps(
+        {"s": [{"op": "x", "t_us": 150.0}]}))
+    assert regress.main(["--current", str(cur),
+                         "--baseline", str(base)]) == 1
+    # tighter threshold flips a pass into a regression
+    cur.write_text(json.dumps(
+        {"s": [{"op": "x", "t_us": 105.0}]}))
+    assert regress.main(["--current", str(cur), "--baseline", str(base),
+                         "--threshold", "0.01"]) == 1
+    # IO / usage errors are exit 2, the analysis CLI's contract
+    assert regress.main(["--current", str(tmp_path / "missing.json"),
+                         "--baseline", str(base)]) == 2
+    assert regress.main(["--current", str(cur), "--baseline", str(base),
+                         "--threshold", "-1"]) == 2
+
+
+def test_regress_ratchets_the_committed_artifacts():
+    # the committed BENCH_* replays regress-check against themselves
+    # cleanly — the CI smoke lane's invocation shape
+    for name in ("BENCH_compress.json", "BENCH_alltoall.json"):
+        path = str(REPO / name)
+        assert regress.main(["--current", path,
+                             "--baseline", path]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the committed convergence artifact (capture-time claims re-checked)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_compress_artifact_claims():
+    payload = json.loads((REPO / "BENCH_compress.json").read_text())
+    assert payload["schema"] == "mpx-compress-replay/1"
+    off_rows = {(r["size_mb"], r["topology"]): r
+                for r in payload["wire_sweep"] if r["codec"] == "off"}
+    for row in payload["wire_sweep"]:
+        if row["codec"] == "off":
+            assert row["wire_dcn_bytes"] == row["logical_dcn_bytes"]
+            continue
+        # the acceptance floor: >= 2x modeled DCN wire-byte reduction
+        assert row["wire_reduction"] >= 2.0, row
+        assert row["wire_dcn_bytes"] == codec.wire_bytes(
+            row["logical_dcn_bytes"], row["codec"])
+        off = off_rows[(row["size_mb"], row["topology"])]
+        assert row["modeled_dcn_us"] < off["modeled_dcn_us"]
+    conv = payload["convergence"]
+    exact = conv["curves"]["off"]
+    for name, p in conv["parity"].items():
+        assert p["max_rel_gap"] <= p["tolerance"], (name, p)
+        curve = conv["curves"][name]
+        assert len(curve) == len(exact)
+    # every codec's replay converged by orders of magnitude
+    for name in ("off", "bf16", "fp8"):
+        c = conv["curves"][name]
+        assert c[-1] < c[0] * 1e-2, name

@@ -243,14 +243,15 @@ def test_docs_list_every_registered_flag():
     """Docs-sync: each declared flag must appear in the docs flag tables
     (docs/usage.md, docs/resilience.md, docs/observability.md,
     docs/overlap.md, docs/topology.md, docs/aot.md, docs/autotune.md,
-    docs/serving.md, or docs/moe.md) — a flag without documentation is
-    indistinguishable from an undocumented sharp bit."""
+    docs/serving.md, docs/moe.md, or docs/compression.md) — a flag
+    without documentation is indistinguishable from an undocumented
+    sharp bit."""
     config = _load_config()
     docs = "\n".join(
         (REPO / "docs" / f).read_text()
         for f in ("usage.md", "resilience.md", "observability.md",
                   "overlap.md", "topology.md", "aot.md", "autotune.md",
-                  "serving.md", "moe.md")
+                  "serving.md", "moe.md", "compression.md")
     )
     missing = [name for name in config.FLAGS if name not in docs]
     assert not missing, (
@@ -258,5 +259,5 @@ def test_docs_list_every_registered_flag():
         "tables (docs/usage.md / docs/resilience.md / "
         "docs/observability.md / docs/overlap.md / docs/topology.md / "
         "docs/aot.md / docs/autotune.md / docs/serving.md / "
-        "docs/moe.md): " + ", ".join(missing)
+        "docs/moe.md / docs/compression.md): " + ", ".join(missing)
     )

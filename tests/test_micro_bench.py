@@ -163,6 +163,57 @@ def test_alltoall_replay_artifact_current(tmp_path):
     assert json.loads(out.read_text()) == committed
 
 
+def test_bench_compression_schema():
+    # runs the codec sweep at a tiny size under a faked 2x4 topology:
+    # every {off, bf16, fp8} row carries the logical/wire byte split
+    # (from ops/_codec.wire_bytes — the shared byte truth), a modeled
+    # DCN time, and a measured roundtrip error that is exactly zero
+    # only for the exact codec (docs/compression.md)
+    from mpi4jax_tpu.ops import _codec
+
+    comm = _world_comm()
+    rows = micro.bench_compression(comm, sizes_mb=[0.01], iters=2)
+    assert [r["codec"] for r in rows] == ["off", "bf16", "fp8"]
+    for r in rows:
+        assert r["size_mb"] == 0.01 and r["topology"] == "2x4"
+        assert r["wire_dcn_bytes"] == _codec.wire_bytes(
+            r["logical_dcn_bytes"], None if r["codec"] == "off"
+            else r["codec"])
+        assert r["modeled_dcn_us"] > 0
+        if r["codec"] == "off":
+            assert r["rel_err"] == 0.0
+            assert r["wire_dcn_bytes"] == r["logical_dcn_bytes"]
+        else:
+            assert 0 < r["rel_err"] < 1.0
+            assert r["wire_dcn_bytes"] * 2 <= r["logical_dcn_bytes"]
+
+
+def test_compress_replay_artifact_current(tmp_path):
+    # the committed compression replay (BENCH_compress.json) must be
+    # reproducible from its embedded recipe and carry the acceptance
+    # invariants: >= 2x DCN wire reduction for both codecs, compressed
+    # loss curves within the stated parity tolerance of the exact one
+    import json
+    import pathlib
+    import subprocess
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    committed = json.loads((repo / "BENCH_compress.json").read_text())
+    assert committed["schema"] == "mpx-compress-replay/1"
+    for row in committed["wire_sweep"]:
+        if row["codec"] != "off":
+            assert row["wire_reduction"] >= 2.0, row
+    for codec, p in committed["convergence"]["parity"].items():
+        assert p["max_rel_gap"] <= p["tolerance"], (codec, p)
+    out = tmp_path / "replay.json"
+    subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "compress_replay.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert json.loads(out.read_text()) == committed
+
+
 def test_bench_dispatch_schema():
     # compiles all three execution surfaces — eager one-op, spmd, and
     # the mpx.compile-pinned artifact — for the same allreduce at a tiny
