@@ -1,0 +1,116 @@
+"""Seeded EF-residual schedule gate — INTENTIONALLY BROKEN (MPX141).
+
+The error-feedback residual (``mpx.compress.ef_allreduce``) is the one
+value in a compressed training step that is rank-local *by design*: each
+rank accumulates its own quantization error.  Gating control flow on it
+is therefore gating on a value that differs across ranks — and when the
+gated branches issue *different* collective schedules, the program
+deadlocks the first step the residuals disagree: some ranks take the
+two-collective resync path while the rest take the one-collective path,
+and the second reduce waits forever.
+
+MPX108 (branches disagree about communicating at all) stays silent here
+— BOTH branches communicate.  The per-rank cross-rank re-trace cannot
+concretize the predicate either (it is traced data, not a rank id).
+Only the dataflow taint pass sees it, by following the rank-local
+lineage from the residual into the predicate and comparing the branch
+schedules (docs/analysis.md "Dataflow hazards"):
+
+    python examples/broken/ef_divergent_gate.py
+
+runs both front-ends — ``mpx.analyze`` and the ambient
+``MPI4JAX_TPU_ANALYZE=error`` path — and asserts both flag MPX141 (the
+MPX142 approximate-lineage advisory rides along: the same predicate also
+carries wire-codec error).  This file lives under ``examples/broken/``
+so the CI sweep over ``examples/*.py`` (which must come back clean) does
+not pick it up; the CI analyze lane instead asserts that analyzing THIS
+file fails with MPX141 (.github/workflows/test.yml).
+"""
+
+import os
+import sys
+
+# a lossy wire codec makes the residual real (and arms the verifier's
+# approximate-lineage seeds); the rank-local hazard is structural either
+# way
+os.environ.setdefault("MPI4JAX_TPU_COMPRESS", "bf16")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+
+def build_step(comm):
+    def step(g, res):
+        total, new_res, _ = mpx.compress.ef_allreduce(g, res, comm=comm)
+        # BUG: drift is derived from the rank-LOCAL residual — every rank
+        # computes a different value.  Replicate it first
+        # (allreduce/pmax) if it must steer the schedule.
+        drift = jnp.max(jnp.abs(new_res))
+
+        def resync(v):
+            # two collectives: re-reduce, then re-center
+            s, _ = mpx.allreduce(v, mpx.SUM, comm=comm)
+            m, _ = mpx.allreduce(jnp.mean(s) * jnp.ones_like(s),
+                                 mpx.SUM, comm=comm)
+            return s - m / jnp.float32(comm.Get_size())
+
+        def keep(v):
+            # one collective: both branches communicate, so MPX108 stays
+            # silent — but the SCHEDULES differ, which is the hang
+            s, _ = mpx.allreduce(v, mpx.SUM, comm=comm)
+            return s
+
+        return lax.cond(drift > jnp.float32(0.05), resync, keep, total), \
+            new_res
+
+    return step
+
+
+def main():
+    mesh = mpx.make_world_mesh(devices=jax.devices())
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+    if n < 2:
+        print("needs >= 2 devices (e.g. XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8); nothing "
+              "diverges on 1 rank")
+        return
+    g = jnp.stack([jnp.full((64,), 1.0 + r) for r in range(n)])
+    res = jnp.zeros_like(g)
+
+    # --- front-end 1: explicit analysis (single trace: the taint pass
+    # reads the rank-varying type the shard_map region gives the
+    # residual)
+    step = build_step(comm)
+    report = mpx.analyze(step, g, res, comm=comm)
+    print(report.render(), file=sys.stderr)
+    codes = {f.code for f in report.findings}
+    assert "MPX141" in codes, f"expected MPX141, got {sorted(codes)}"
+    print("mpx.analyze: rank-local schedule gate caught (MPX141)",
+          file=sys.stderr)
+
+    # --- front-end 2: the ambient env=error path (the cross-rank region
+    # pass runs the same taint pass per rank at trace time)
+    mpx.set_analyze_mode("error")
+    try:
+        try:
+            mpx.run(step, g, res, comm=comm)
+        except mpx.AnalysisError as e:
+            assert any(f.code == "MPX141" for f in e.findings), e.findings
+            print("MPI4JAX_TPU_ANALYZE=error: rank-local schedule gate "
+                  "caught (MPX141) at trace time", file=sys.stderr)
+        else:
+            raise AssertionError("ambient pass missed the divergent gate")
+    finally:
+        mpx.set_analyze_mode(None)
+        mpx.clear_caches()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Seeded async-span donation race — INTENTIONALLY BROKEN (MPX139).
+
+An ``allreduce_start`` puts its input buffer on the wire: the chunked
+ring phases keep reading it until the matching ``allreduce_wait``.
+Handing that buffer's storage to a pinned executable in the gap —
+``mpx.compile(..., donate_argnums=(0,))`` donates the argument so XLA
+may overwrite it in place — is a write-after-start race: the wire can
+ship the scaled bytes instead of the originals, silently corrupting the
+reduction on every rank.
+
+Nothing structural is wrong with the schedule (start and wait pair up,
+tokens chain, the cross-rank matcher is happy), so only the dataflow
+hazard verifier catches it, by joining the recorded span intervals with
+the pinner's donation records (docs/analysis.md "Dataflow hazards"):
+
+    python examples/broken/overlap_donation_race.py
+
+runs both front-ends — ``mpx.analyze`` and the ambient
+``MPI4JAX_TPU_ANALYZE=error`` path — and asserts both flag MPX139.  This
+file lives under ``examples/broken/`` so the CI sweep over
+``examples/*.py`` (which must come back clean) does not pick it up; the
+CI analyze lane instead asserts that analyzing THIS file fails with
+MPX139 (.github/workflows/test.yml).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+
+def build_step(comm):
+    """One training-ish step: overlap a gradient allreduce with a pinned
+    parameter rescale... that donates the gradient buffer mid-span."""
+    local = jax.ShapeDtypeStruct((16,), jnp.float32)
+    # the donating pinned helper (eager convention: no region of its own)
+    scale = mpx.compile(lambda v: v * 2.0, local, wrap=False,
+                        donate_argnums=(0,))
+
+    def step(x):
+        handle, t = mpx.allreduce_start(x, mpx.SUM, comm=comm)
+        # BUG: x is still held by the open span — donating its storage
+        # here lets the executable overwrite bytes the ring phases are
+        # about to ship.  The fix is to call scale() after the wait (or
+        # on a copy).
+        y = scale(x)
+        total, t = mpx.allreduce_wait(handle, token=t)
+        return total + y
+
+    return step
+
+
+def main():
+    mesh = mpx.make_world_mesh(devices=jax.devices())
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+    if n < 2:
+        print("needs >= 2 devices (e.g. XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8); nothing races "
+              "on 1 rank")
+        return
+    x = jnp.stack([jnp.full((16,), float(r)) for r in range(n)])
+
+    # --- front-end 1: explicit analysis
+    step = build_step(comm)
+    report = mpx.analyze(step, x, comm=comm)
+    print(report.render(), file=sys.stderr)
+    codes = {f.code for f in report.findings}
+    assert "MPX139" in codes, f"expected MPX139, got {sorted(codes)}"
+    print("mpx.analyze: donation race caught (MPX139)", file=sys.stderr)
+
+    # --- front-end 2: the ambient env=error path (the armed region
+    # recorder sees the same span + donation records at trace time)
+    mpx.set_analyze_mode("error")
+    try:
+        # re-pin under the new mode epoch: flipping the analyze mode
+        # (correctly) stales programs pinned before it
+        step2 = build_step(comm)
+        try:
+            mpx.run(step2, x, comm=comm)
+        except mpx.AnalysisError as e:
+            assert any(f.code == "MPX139" for f in e.findings), e.findings
+            print("MPI4JAX_TPU_ANALYZE=error: donation race caught "
+                  "(MPX139) at trace time", file=sys.stderr)
+        else:
+            raise AssertionError("ambient pass missed the donation race")
+    finally:
+        mpx.set_analyze_mode(None)
+        mpx.clear_caches()
+
+
+if __name__ == "__main__":
+    main()

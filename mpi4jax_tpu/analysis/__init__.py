@@ -44,6 +44,7 @@ from .report import (  # noqa: F401
     Report,
     finding_from_exception,
 )
+from .dataflow import graph_arms_approx, hazard_jaxpr_findings  # noqa: F401
 from .walker import check_cond_divergence  # noqa: F401
 
 
@@ -207,6 +208,11 @@ def analyze(fn, *args, comm=None, wrap: Optional[bool] = None,
         findings.insert(0, fatal)
     if closed is not None:
         findings.extend(check_cond_divergence(closed))
+        # the dataflow taint pass (MPX141/MPX142): value-level lineage
+        # over the same closed jaxpr, approx seeds armed by the recorded
+        # graph's codec/EF activity
+        findings.extend(hazard_jaxpr_findings(
+            closed, approx_armed=graph_arms_approx(graph)))
     report = Report(findings=tuple(findings), events=tuple(rec.events),
                     meta=dict(graph.meta))
     if key is not None:
@@ -239,6 +245,9 @@ def _analyze_cross_rank(jax, target, args, statics, c, axis_sizes, world,
                 continue
             seen_cond.add(f.message)
             findings.append(f)
+    # the dataflow taint pass over each rank's re-trace, deduplicated by
+    # message; MPX141 findings cite the would-diverge rank pair
+    findings.extend(crossrank.per_rank_hazard_findings(closed, per_rank))
     cost_report = None
     if not fatal:
         matched = crossrank.match_rank_schedules(per_rank, world, watermark)

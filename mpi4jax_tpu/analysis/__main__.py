@@ -29,7 +29,11 @@ Options:
 - ``--json`` — print the aggregated machine-readable payload (one
   ``Report.to_json()`` object per dirty — or, under ``--cost``,
   costed — region, plus per-script status) to stdout; the scripts' own
-  stdout is redirected to stderr so the payload stays parseable.
+  stdout is redirected to stderr so the payload stays parseable;
+- ``--strict-advisories`` — exit 1 on advisory-severity findings too
+  (MPX1xx ADVISORY rows, e.g. the MPX142 approximate-lineage taint):
+  for lanes that gate on a fully silent analysis rather than the
+  default errors-only contract.
 
 The CI ``lint/analyze`` lane runs this over everything in ``examples/``
 with ``--ranks 8 --cost --json``, uploads the payloads as artifacts,
@@ -46,7 +50,8 @@ import sys
 import traceback
 
 USAGE = ("usage: python -m mpi4jax_tpu.analysis [--ranks N] [--cost] "
-         "[--cost-model PATH] [--json] script.py [...]")
+         "[--cost-model PATH] [--json] [--strict-advisories] "
+         "script.py [...]")
 
 
 def _parse_args(argv):
@@ -54,6 +59,7 @@ def _parse_args(argv):
     as_json = False
     cost = False
     cost_model = None
+    strict_advisories = False
     scripts = []
     i = 0
     while i < len(argv):
@@ -76,6 +82,8 @@ def _parse_args(argv):
             cost_model = a.split("=", 1)[1]
         elif a == "--json":
             as_json = True
+        elif a == "--strict-advisories":
+            strict_advisories = True
         elif a.startswith("-"):
             return None
         else:
@@ -83,7 +91,7 @@ def _parse_args(argv):
         i += 1
     if not scripts:
         return None
-    return ranks, as_json, cost, cost_model, scripts
+    return ranks, as_json, cost, cost_model, strict_advisories, scripts
 
 
 def main(argv) -> int:
@@ -91,7 +99,7 @@ def main(argv) -> int:
     if parsed is None:
         print(USAGE, file=sys.stderr)
         return 2
-    ranks, as_json, cost, cost_model, scripts = parsed
+    ranks, as_json, cost, cost_model, strict_advisories, scripts = parsed
     if ranks is not None:
         os.environ["MPI4JAX_TPU_ANALYZE_RANKS"] = ranks
     if cost:
@@ -188,8 +196,14 @@ def main(argv) -> int:
         print(f"[mpx.analyze] {n_errors} error-severity finding(s) over "
               f"{len(scripts)} script(s)", file=sys.stderr)
         return 1
+    n_advisories = len(findings) - n_errors
+    if strict_advisories and n_advisories:
+        print(f"[mpx.analyze] --strict-advisories: {n_advisories} "
+              f"advisory finding(s) over {len(scripts)} script(s)",
+              file=sys.stderr)
+        return 1
     print(f"[mpx.analyze] {len(scripts)} script(s) analyzed, no errors "
-          f"({len(findings)} advisory finding(s))", file=sys.stderr)
+          f"({n_advisories} advisory finding(s))", file=sys.stderr)
     return 0
 
 

@@ -850,3 +850,9 @@ def check_crossover_proximity(graph: CollectiveGraph) -> List[Finding]:
                             "the working payload size"),
             ))
     return findings
+
+
+# the dataflow hazard checkers (MPX139/MPX140, analysis/hazards.py)
+# register themselves on import; imported at the BOTTOM so hazards can
+# import ``checker`` from this module without a cycle
+from . import hazards  # noqa: E402,F401

@@ -89,6 +89,15 @@ def _concrete_control_flow():
         jax.lax.switch = orig_switch
 
 
+class _EventStream(list):
+    """A rank's recorded events plus the recording's donation records
+    (``hook.Recorder.donations``) riding along as an attribute —
+    list-shaped so every existing consumer (schedule builder, matcher,
+    report events) is untouched."""
+
+    donations: tuple = ()
+
+
 def trace_rank_schedules(target, args, kwargs, static_argnums,
                          axis_names: Sequence[str],
                          axis_sizes: Sequence[int],
@@ -119,7 +128,9 @@ def trace_rank_schedules(target, args, kwargs, static_argnums,
             fatal.append(replace(f, rank=r))
         finally:
             _hook.pop_recorder()
-        per_rank_events[r] = rec.events
+        events = _EventStream(rec.events)
+        events.donations = tuple(rec.donations)
+        per_rank_events[r] = events
     return per_rank_events, fatal, closed
 
 
@@ -164,14 +175,59 @@ def per_rank_graph_findings(per_rank_events: Dict[int, list]) -> List[Finding]:
     findings: List[Finding] = []
     seen = set()
     for r in sorted(per_rank_events):
-        graph = _hook.CollectiveGraph(events=per_rank_events[r],
-                                      meta=_hook.config_snapshot())
+        meta = _hook.config_snapshot()
+        donations = getattr(per_rank_events[r], "donations", ())
+        if donations:
+            # pinned-call donations recorded during this rank's re-trace
+            # (hook.record_donation) — the MPX139/MPX140 join input
+            meta["donations"] = donations
+        graph = _hook.CollectiveGraph(events=per_rank_events[r], meta=meta)
         for f in run_checkers(graph, skip=_PER_RANK_SKIP):
             key = (f.code, f.op, f.index, f.message)
             if key in seen:
                 continue
             seen.add(key)
             findings.append(f)
+    return findings
+
+
+def per_rank_hazard_findings(closed: Dict[int, object],
+                             per_rank_events: Dict[int, list],
+                             ) -> List[Finding]:
+    """The dataflow taint pass (analysis/dataflow.py, MPX141/MPX142) over
+    each rank's re-trace, deduplicated by message like the per-rank
+    cond-divergence walk.  A deduplicated MPX141 names the would-diverge
+    rank pair: the first two analyzed ranks that surfaced it (or the sole
+    surfacing rank and its successor, when concretization hid the hazard
+    from every other re-trace)."""
+    from dataclasses import replace
+
+    from .dataflow import graph_arms_approx, hazard_jaxpr_findings
+
+    order: List[tuple] = []
+    hit_ranks: Dict[tuple, List[int]] = {}
+    base: Dict[tuple, Finding] = {}
+    for r in sorted(closed):
+        graph = _hook.CollectiveGraph(events=per_rank_events.get(r, []),
+                                      meta=_hook.config_snapshot())
+        for f in hazard_jaxpr_findings(
+                closed[r], approx_armed=graph_arms_approx(graph), rank=r):
+            key = (f.code, f.op, f.message)
+            if key not in base:
+                base[key] = f
+                order.append(key)
+                hit_ranks[key] = []
+            hit_ranks[key].append(r)
+    findings: List[Finding] = []
+    for key in order:
+        f = base[key]
+        ranks_hit = hit_ranks[key]
+        a = ranks_hit[0]
+        b = ranks_hit[1] if len(ranks_hit) > 1 else a + 1
+        if f.code == "MPX141":
+            f = replace(f, message=(
+                f"{f.message} (ranks {a} and {b} would diverge here)"))
+        findings.append(f)
     return findings
 
 
@@ -335,6 +391,10 @@ def _run_region_pass(fn, comm, in_specs, out_specs, static_argnums,
         return None
     matched = match_rank_schedules(per_rank, world, watermark)
     findings = cross_rank_findings(per_rank, world, matched=matched)
+    # value-level lineage over the same per-rank re-traces: this is how
+    # the env mode surfaces MPX141/MPX142 (the single-trace region
+    # recorder only runs the graph-side checkers)
+    findings.extend(per_rank_hazard_findings(closed, per_rank))
     cost_report = None
     if cost_model is not None:
         from . import cost as _cost

@@ -1,0 +1,404 @@
+"""Flow-sensitive dataflow taint pass: rank-local and approximate lineage.
+
+The walker (analysis/walker.py) proves *structural* properties of the
+closed jaxpr; the checkers (analysis/checkers.py + hazards.py) prove
+*schedule* properties of the recorded event stream.  This pass follows
+the *values*: a forward def-use taint propagation over the closed jaxpr,
+tracking two lineages that the schedule passes cannot see —
+
+``rank``-local lineage (MPX141, ERROR)
+    Values that differ across ranks: outputs of ``axis_index`` (the
+    ``Get_rank`` lowering), and any value whose aval carries a nonempty
+    collective-varying type (the duck-typed ``vma`` set that shard_map's
+    type system threads through the jaxpr — error-feedback residuals,
+    per-shard gradients, anything not yet replicated).  Replicating
+    collectives (``psum``/``pmin``/``pmax``/``all_gather``) launder the
+    taint — their result is rank-invariant by construction; permuting
+    and scattering collectives (``ppermute``, ``all_to_all``,
+    ``psum_scatter``, ``reduce_scatter``) do not.  The sink is a
+    ``lax.cond``/``switch`` predicate whose branches issue *different*
+    collective schedules: if the predicate ever differs across ranks the
+    schedule itself diverges — the hang class the cross-rank re-trace
+    (analysis/crossrank.py) only catches after producing the divergent
+    schedules, caught here statically from one trace.
+
+``approx``imate lineage (MPX142, ADVISORY)
+    Values that passed through a lossy wire-codec roundtrip — a
+    float-to-smaller-float ``convert_element_type`` (the bf16/fp8
+    quantize half of ops/_compress.py's ``roundtrip``).  Seeding is
+    armed only when the recorded dispatch graph shows codec or
+    error-feedback activity (:func:`graph_arms_approx`), so plain mixed
+    precision never taints.  Approximate taint survives every op —
+    including reductions — and the sinks are positions that assume
+    exact arithmetic: indices of ``gather``/``dynamic_slice``/
+    ``dynamic_update_slice``/``scatter*`` (routing tables, MoE capacity
+    bookkeeping, shard-store commit offsets) and branch predicates that
+    gate communication.  Quantization error can flip those decisions
+    differently per rank.
+
+Every finding carries the taint frontier — the op-by-op path from the
+lineage seed to the sink — in ``Finding.frontier``, rendered as
+``taint:`` lines by the report.
+
+Duck typing keeps this module importable (and unit-testable with fake
+jaxpr objects, tests/test_hazards_pure.py) under any JAX version, like
+the walker it extends.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .report import Finding
+from .walker import _iter_jaxprs, _sub_jaxprs, is_collective
+
+# taint kinds
+RANK = "rank"      # differs across ranks until a replicating collective
+APPROX = "approx"  # passed through a lossy wire-codec downcast
+
+# collectives whose RESULT is replicated across the reducing axis — they
+# launder rank-local taint.  psum_scatter starts with "psum" but leaves a
+# different shard on every rank, so it must NOT launder (checked
+# explicitly before the prefix match).
+REPLICATING_PREFIXES = ("psum", "pmin", "pmax", "all_gather")
+_NON_REPLICATING = ("psum_scatter",)
+
+# primitives whose index operands are exactness-required sinks (MPX142):
+# name -> slice of eqn.invars holding the indices
+_INDEX_SINKS = {
+    "gather": slice(1, 2),
+    "dynamic_slice": slice(1, None),
+    "dynamic_update_slice": slice(2, None),
+}
+
+# frontier trails are capped: long programs keep the seed end and the
+# live end, with one elision marker in the middle
+_TRAIL_CAP = 24
+_ELLIPSIS = "... (taint path elided) ..."
+
+Taint = Dict[str, Tuple[str, ...]]  # kind -> frontier trail
+
+
+def replicates(primitive_name: str) -> bool:
+    """True for collectives whose output is rank-invariant (they clear
+    rank-local taint)."""
+    if primitive_name.startswith(_NON_REPLICATING):
+        return False
+    return primitive_name.startswith(REPLICATING_PREFIXES)
+
+
+def collective_signature(jaxpr) -> Tuple[Tuple[str, int], ...]:
+    """The multiset of collective primitive names in ``jaxpr`` (all
+    nesting levels), as a sorted (name, count) tuple — two branches with
+    equal signatures issue the same schedule shape even when a
+    rank-varying predicate picks between them."""
+    counts: Dict[str, int] = {}
+
+    def _walk(j):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if is_collective(name):
+                counts[name] = counts.get(name, 0) + 1
+            for sub in _sub_jaxprs(eqn):
+                _walk(sub)
+
+    _walk(jaxpr)
+    return tuple(sorted(counts.items()))
+
+
+def _fmt_sig(sig) -> str:
+    if not sig:
+        return "{no collectives}"
+    return "{" + ", ".join(f"{n}x{c}" for n, c in sig) + "}"
+
+
+def graph_arms_approx(graph) -> bool:
+    """True when the recorded dispatch graph shows lossy-codec activity —
+    a DCN wire codec on any event (ops/_hierarchy.annotate_selection),
+    an error-feedback step (ops/_compress.ef_allreduce stamps the ``ef``
+    extra), or a non-``off`` wire-codec knob in the config snapshot —
+    which arms the approximate-lineage seeds.  Without it, a float
+    downcast is ordinary mixed precision and must not taint."""
+    if graph is None:
+        return False
+    meta = getattr(graph, "meta", None) or {}
+    if meta.get("compress") not in (None, "off"):
+        return True
+    for e in getattr(graph, "events", ()):
+        if getattr(e, "codec", None):
+            return True
+        extra = getattr(e, "extra", None)
+        if extra and extra.get("ef"):
+            return True
+    return False
+
+
+def _is_lit(atom) -> bool:
+    return hasattr(atom, "val")
+
+
+_FLOAT_NAME = re.compile(r"(?:bfloat|float)(\d+)")
+
+
+def _float_bytes(d) -> Optional[int]:
+    """Itemsize when ``d`` is a float dtype, else None.  The narrow
+    float families (bfloat16, float8_*) are matched by NAME: ml_dtypes
+    registers them with numpy under kind 'V', and without ml_dtypes the
+    name may not parse as a dtype at all."""
+    if d is None:
+        return None  # np.dtype(None) would silently mean float64
+    try:
+        dt = np.dtype(d)
+    except TypeError:
+        m = _FLOAT_NAME.match(str(d))
+        return int(m.group(1)) // 8 if m else None
+    if dt.kind == "f" or _FLOAT_NAME.match(dt.name):
+        return dt.itemsize
+    return None
+
+
+def _is_lossy_downcast(eqn) -> bool:
+    """float -> smaller-float convert_element_type: the quantize half of
+    a codec roundtrip (ops/_compress.roundtrip)."""
+    if not eqn.invars:
+        return False
+    old = _float_bytes(
+        getattr(getattr(eqn.invars[0], "aval", None), "dtype", None))
+    new = _float_bytes(eqn.params.get("new_dtype"))
+    return old is not None and new is not None and new < old
+
+
+def _merge(taints) -> Taint:
+    """Union taint dicts; on collision the shorter (closer-to-seed)
+    frontier wins."""
+    out: Taint = {}
+    for t in taints:
+        for kind, trail in t.items():
+            if kind not in out or len(trail) < len(out[kind]):
+                out[kind] = trail
+    return out
+
+
+def _extend(trail: Tuple[str, ...], step: str) -> Tuple[str, ...]:
+    if len(trail) >= _TRAIL_CAP:
+        keep = _TRAIL_CAP // 3
+        if _ELLIPSIS not in trail:
+            trail = trail[:keep] + (_ELLIPSIS,) + trail[-keep:]
+        else:
+            trail = trail[:keep + 1] + trail[-(keep - 1):]
+    return trail + (step,)
+
+
+class _Pass:
+    """One forward propagation over one (closed) jaxpr tree."""
+
+    def __init__(self, approx_armed: bool, rank: Optional[int] = None):
+        self.approx_armed = approx_armed
+        self.rank = rank
+        self.findings: List[Finding] = []
+        self._seen = set()
+
+    # -- taint environment ------------------------------------------------
+
+    def _taint_of(self, atom, env) -> Taint:
+        if _is_lit(atom):
+            return {}
+        t = dict(env.get(atom, ()))
+        if RANK not in t:
+            # shard_map's collective-varying type system already proved
+            # this value differs across ranks — adopt its verdict as an
+            # implicit seed (duck-typed: absent on older JAX and fakes)
+            vma = getattr(getattr(atom, "aval", None), "vma", None)
+            if vma:
+                axes = ",".join(sorted(str(a) for a in vma))
+                t[RANK] = (f"rank-varying typed value (vma={{{axes}}})",)
+        return t
+
+    # -- findings ---------------------------------------------------------
+
+    def _emit(self, code, op, message, suggestion, frontier):
+        key = (code, op, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(
+            code=code, op=op, message=message, suggestion=suggestion,
+            rank=self.rank, frontier=tuple(frontier),
+        ))
+
+    def _check_sinks(self, eqn, name, env):
+        if name == "cond":
+            pred = self._taint_of(eqn.invars[0], env)
+            branch_jaxprs = [next(_iter_jaxprs(b), None)
+                             for b in eqn.params.get("branches", ())]
+            sigs = [collective_signature(bj) if bj is not None else ()
+                    for bj in branch_jaxprs]
+            if RANK in pred and len(set(sigs)) > 1:
+                rendered = ", ".join(
+                    f"branch {i}: {_fmt_sig(s)}" for i, s in enumerate(sigs))
+                self._emit(
+                    "MPX141", "cond",
+                    "rank-local lineage reaches a branch predicate whose "
+                    f"branches issue different collective schedules "
+                    f"({rendered}) — if the predicate differs across "
+                    "ranks the schedule itself diverges and the "
+                    "communicating side hangs",
+                    "replicate the gating value first (allreduce it), or "
+                    "make every branch issue the same collectives "
+                    "(docs/sharp_bits.md)",
+                    _extend(pred[RANK], "cond predicate (schedule gate)"),
+                )
+            if APPROX in pred and any(sigs):
+                self._emit(
+                    "MPX142", "cond",
+                    "approximate (wire-codec) lineage reaches a branch "
+                    "predicate that gates communication — quantization "
+                    "error can flip the decision differently per rank",
+                    "derive the gating value from exact (pre-codec) "
+                    "data, or carry the error through error feedback "
+                    "(docs/compression.md)",
+                    _extend(pred[APPROX], "cond predicate (schedule gate)"),
+                )
+            return
+        sink = _INDEX_SINKS.get(name)
+        if sink is None and name.startswith("scatter"):
+            sink = slice(1, 2)
+        if sink is not None:
+            for atom in eqn.invars[sink]:
+                t = self._taint_of(atom, env)
+                if APPROX in t:
+                    self._emit(
+                        "MPX142", name,
+                        "approximate (wire-codec) lineage reaches an "
+                        f"index operand of `{name}` — a routing/offset "
+                        "decision that assumes exact arithmetic; "
+                        "quantization error can route or commit "
+                        "differently per rank",
+                        "compute routing indices and commit offsets from "
+                        "exact values (docs/compression.md)",
+                        _extend(t[APPROX], f"{name} index operand"),
+                    )
+                    break
+
+    # -- propagation ------------------------------------------------------
+
+    def run(self, jaxpr, env) -> dict:
+        """Propagate taint through ``jaxpr`` starting from ``env``
+        (var -> Taint); returns the final environment so callers can read
+        outvar taint."""
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            self._check_sinks(eqn, name, env)
+            in_taints = [self._taint_of(a, env) for a in eqn.invars]
+            out = _merge(in_taints)
+            if out:
+                out = {k: _extend(tr, name) for k, tr in out.items()}
+            # seeds
+            if name.startswith("axis_index"):
+                out[RANK] = ("axis_index (rank-local seed)",)
+            if (self.approx_armed and name == "convert_element_type"
+                    and _is_lossy_downcast(eqn)):
+                out.setdefault(
+                    APPROX,
+                    (f"convert_element_type -> "
+                     f"{eqn.params.get('new_dtype')} (lossy codec "
+                     "downcast, approx seed)",))
+            # replicating collectives launder rank-locality (their result
+            # is the same on every rank); approximate error survives the
+            # reduction, so APPROX stays
+            if replicates(name):
+                out.pop(RANK, None)
+            # descend into sub-jaxprs, mapping taint through binders
+            if name == "cond":
+                out = _merge([out, self._run_cond(eqn, in_taints)])
+            else:
+                subs = list(_sub_jaxprs(eqn))
+                if subs:
+                    out = _merge(
+                        [out, self._run_subs(eqn, subs, in_taints)])
+            if out:
+                for ov in eqn.outvars:
+                    if not _is_lit(ov):
+                        env[ov] = out
+        return env
+
+    def _run_cond(self, eqn, in_taints) -> Taint:
+        """Branch operands are eqn.invars[1:], positional against each
+        branch's invars; outvar taint merges across branches."""
+        ops = in_taints[1:]
+        union = _merge(ops)
+        out: Taint = {}
+        for b in eqn.params.get("branches", ()):
+            for bj in _iter_jaxprs(b):
+                child = {}
+                if len(bj.invars) == len(ops):
+                    child = {v: t for v, t in zip(bj.invars, ops) if t}
+                elif union:
+                    child = {v: dict(union) for v in bj.invars}
+                sub_env = self.run(bj, child)
+                outs = [({} if _is_lit(ov) else sub_env.get(ov, {}))
+                        for ov in bj.outvars]
+                out = _merge([out] + outs)
+        return out
+
+    def _run_subs(self, eqn, subs, in_taints) -> Taint:
+        """Generic descent (pjit, shard_map, scan, while, custom_*):
+        positional binder mapping when arities line up, conservative
+        union-taint otherwise.  A loop-carried jaxpr (scan: num_carry /
+        num_consts params) runs a second round with carry-output taint
+        fed back into the carry binders, so lineage that only becomes
+        tainted on iteration N+1 is still seen."""
+        union = _merge(in_taints)
+        n_carry = eqn.params.get("num_carry")
+        n_consts = eqn.params.get("num_consts")
+        loop_carried = (isinstance(n_carry, int) and n_carry > 0
+                        and isinstance(n_consts, int))
+        out: Taint = {}
+        fed_back: Dict[int, Taint] = {}  # invar position -> carry taint
+        for _ in range(2 if loop_carried else 1):
+            out = {}
+            new_feedback: Dict[int, Taint] = {}
+            for sj in subs:
+                child = {}
+                if len(sj.invars) == len(in_taints):
+                    child = {v: t
+                             for v, t in zip(sj.invars, in_taints) if t}
+                elif union:
+                    child = {v: dict(union) for v in sj.invars}
+                for pos, t in fed_back.items():
+                    if pos < len(sj.invars) and t:
+                        v = sj.invars[pos]
+                        child[v] = _merge([child.get(v, {}), t])
+                sub_env = self.run(sj, child)
+                sub_outs = [({} if _is_lit(ov) else sub_env.get(ov, {}))
+                            for ov in sj.outvars]
+                out = _merge([out] + sub_outs)
+                if loop_carried and len(sj.outvars) >= n_carry:
+                    # scan body outvars = carry + ys; carry i re-enters
+                    # at invar position num_consts + i next iteration
+                    for i in range(n_carry):
+                        if sub_outs[i]:
+                            pos = n_consts + i
+                            new_feedback[pos] = _merge(
+                                [new_feedback.get(pos, {}), sub_outs[i]])
+            if not new_feedback:
+                break
+            fed_back = new_feedback
+        return out
+
+
+def hazard_jaxpr_findings(closed_jaxpr, *, approx_armed: bool = False,
+                          rank: Optional[int] = None) -> List[Finding]:
+    """MPX141/MPX142 findings for a traced program's closed jaxpr.
+
+    ``approx_armed`` gates the lossy-downcast seeds — pass
+    ``graph_arms_approx(graph)`` for the recording that accompanied the
+    trace.  ``rank`` stamps findings produced from a per-rank re-trace.
+    """
+    p = _Pass(approx_armed, rank=rank)
+    j = next(_iter_jaxprs(closed_jaxpr), closed_jaxpr)
+    p.run(j, {})
+    return p.findings

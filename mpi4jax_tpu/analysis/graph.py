@@ -77,6 +77,15 @@ class CollectiveEvent:
     # *scheduled* to drain (boundary not yet reached) records False:
     # collectives remain legal through the boundary.
     drained: bool = False
+    # buffer identities (``id()`` of the traced array carriers, pinned by
+    # the recorder like the token carriers) of this op's array inputs —
+    # fusion flushes overwrite them with the MEMBER buffers of the packed
+    # flat buffer, so a LazyResult aliasing a bucket member stays
+    # traceable.  The dataflow hazard checkers (analysis/hazards.py)
+    # intersect these with the donation records in
+    # ``CollectiveGraph.meta["donations"]`` (MPX139/MPX140); they are
+    # equality handles only and never rendered.
+    buffers: Tuple[int, ...] = ()
     # static member groups (global ranks, group order) of this op's comm
     # when derivable — comm.groups on a split, or the rank-concretization
     # scope's sub-axes partition during a per-rank schedule trace.  The
@@ -93,7 +102,11 @@ class CollectiveGraph:
     """Ordered event stream of one trace + the config snapshot."""
 
     events: List[CollectiveEvent] = field(default_factory=list)
-    # {"collective_algo": ..., "ring_crossover_bytes": ...}
+    # {"collective_algo": ..., "ring_crossover_bytes": ...}; when the
+    # recording saw pinned calls that donate buffers (aot/pinning.py),
+    # also "donations": tuple of (event-stream position, frozenset of
+    # donated buffer ids, human-readable call site) — present only when
+    # nonempty so pre-hazard snapshots stay byte-identical
     meta: Dict = field(default_factory=dict)
 
     def by_channel(self) -> Dict[Tuple[int, Optional[int]], List[CollectiveEvent]]:

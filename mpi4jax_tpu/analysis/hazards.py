@@ -1,0 +1,129 @@
+"""Graph-side dataflow hazard checkers: donation races, use-after-donate.
+
+The second half of the dataflow hazard verifier (the jaxpr half lives in
+analysis/dataflow.py).  These checkers join the recorded event stream
+with the *donation records* the AOT pinner leaves in
+``graph.meta["donations"]`` (aot/pinning.py: one ``(pos, buffer_ids,
+where)`` entry per recorded pinned call with ``donate_argnums``, where
+``pos`` is the event-stream position the donation happened at) and the
+per-event ``buffers`` identity tuples the dispatch hook records
+(analysis/hook.py) —
+
+MPX139 (ERROR)
+    A donation lands while an async ``*_start``/``*_wait`` span still
+    holds one of the donated buffers: the span's exchange phases read
+    the buffer *after* the start, so handing its storage to an
+    executable between start and wait is a write-after-start race — the
+    wire may ship the overwritten bytes.  Spans are tracked by stream
+    position, so spans crossing ``mpx.overlap()`` region boundaries and
+    fusion flushes (whose events carry the *member* buffer ids, so a
+    ``LazyResult`` aliasing a bucket member is covered) are all seen.
+
+MPX140 (ERROR)
+    A collective consumes a buffer whose storage an earlier pinned call
+    in the same trace already donated: the read sees freed or aliased
+    memory.
+
+Buffer identities are ``id()``s of the traced carriers, pinned alive by
+the recorder for the recording's lifetime (the token-edge discipline,
+graph.py) — checkers use them purely as equality handles and never print
+them, so per-rank re-traces dedupe cleanly.
+
+Dependency-free (no jax): hand-built graphs drive both checkers in
+tests/test_hazards_pure.py under any JAX version.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .checkers import checker
+from .dataflow import graph_arms_approx, hazard_jaxpr_findings
+from .graph import CollectiveGraph
+from .report import Finding
+
+
+def hazard_findings(closed_jaxpr, graph=None,
+                    rank: Optional[int] = None) -> List[Finding]:
+    """The jaxpr half in one call: MPX141/MPX142 over ``closed_jaxpr``,
+    with the approximate-lineage seeds armed by the recorded ``graph``'s
+    codec/error-feedback activity."""
+    return hazard_jaxpr_findings(
+        closed_jaxpr, approx_armed=graph_arms_approx(graph), rank=rank)
+
+
+def _span_intervals(graph: CollectiveGraph) -> dict:
+    """span id -> [start_pos, wait_pos or None, held buffer ids, start
+    event], by stream position (event stream positions and donation
+    ``pos`` values share one clock: ``len(events)`` at record time)."""
+    spans: dict = {}
+    for pos, e in enumerate(graph.events):
+        if e.span is None:
+            continue
+        if e.op.endswith("_start"):
+            held = set(getattr(e, "buffers", ()) or ())
+            spans[e.span] = [pos, None, held, e]
+        elif e.op.endswith("_wait"):
+            rec = spans.get(e.span)
+            if rec is not None and rec[1] is None:
+                rec[1] = pos
+    return spans
+
+
+@checker("MPX139")
+def check_span_donation_race(graph: CollectiveGraph) -> List[Finding]:
+    """Donation while an open async span holds the buffer."""
+    donations = graph.meta.get("donations", ())
+    if not donations:
+        return []
+    spans = _span_intervals(graph)
+    findings: List[Finding] = []
+    for dpos, ids, where in donations:
+        for span_id, (spos, wpos, held, start) in sorted(spans.items()):
+            if spos >= dpos:
+                continue  # span opened after the donation landed
+            if wpos is not None and wpos < dpos:
+                continue  # span already waited — buffer released
+            if held & set(ids):
+                findings.append(Finding(
+                    code="MPX139", op=start.op, index=start.index,
+                    message=(f"{where} donates a buffer the open async "
+                             f"span {span_id} ({start.where()}) still "
+                             "holds: the span's exchange phases read it "
+                             "after the start, so the wire may ship the "
+                             "overwritten bytes (write-after-start "
+                             "race)"),
+                    suggestion=("wait on the handle "
+                                f"({start.op.replace('_start', '_wait')})"
+                                " — or leave the mpx.overlap() region — "
+                                "before the donating call"),
+                ))
+    return findings
+
+
+@checker("MPX140")
+def check_use_after_donate(graph: CollectiveGraph) -> List[Finding]:
+    """Collective consuming a buffer a pinned call already donated."""
+    donations = graph.meta.get("donations", ())
+    if not donations:
+        return []
+    findings: List[Finding] = []
+    for pos, e in enumerate(graph.events):
+        bufs = set(getattr(e, "buffers", ()) or ())
+        if not bufs:
+            continue
+        for dpos, ids, where in donations:
+            if dpos <= pos and bufs & set(ids):
+                findings.append(Finding(
+                    code="MPX140", op=e.op, index=e.index,
+                    message=(f"{e.where()} consumes a buffer whose "
+                             f"storage {where} already donated to its "
+                             "executable: the read sees freed or "
+                             "aliased memory"),
+                    suggestion=("use the pinned program's OUTPUT instead "
+                                "of the stale donated reference, or drop "
+                                "the argument from donate_argnums "
+                                "(docs/aot.md)"),
+                ))
+                break
+    return findings

@@ -76,12 +76,22 @@ class Recorder:
     def __init__(self, mode: str):
         self.mode = mode
         self.events: List[CollectiveEvent] = []
-        # live token carriers: events store id()s, so carriers must stay
-        # alive for the recording or a GC'd token's id could be reused
+        # live token/buffer carriers: events store id()s, so carriers must
+        # stay alive for the recording or a GC'd carrier's id could be
+        # reused
         self.pins: List = []
+        # (event-stream position, frozenset of buffer ids, call site) per
+        # recorded pinned-call donation (record_donation) — the MPX139/
+        # MPX140 checkers' join key against per-event buffer ids
+        self.donations: List[tuple] = []
 
     def graph(self) -> CollectiveGraph:
-        return CollectiveGraph(events=self.events, meta=config_snapshot())
+        meta = config_snapshot()
+        if self.donations:
+            # present only when nonempty: pre-hazard snapshots (and every
+            # donation-free recording) stay byte-identical
+            meta["donations"] = tuple(self.donations)
+        return CollectiveGraph(events=self.events, meta=meta)
 
 
 def config_snapshot() -> dict:
@@ -185,7 +195,16 @@ def arm_context(ctx) -> None:
         return
     mode = effective_mode()
     if mode != "off":
-        ctx.analysis_recorder = Recorder(mode)
+        rec = Recorder(mode)
+        # donations that landed OUTSIDE any recording scope (a top-level
+        # pinned call between regions) pre-seed every fresh env-mode
+        # recorder at stream position 0: a later collective consuming the
+        # donated storage is still MPX140.  Position 0 precedes every
+        # span start, so a pre-seeded donation can never fake an MPX139
+        # race — correct, since no span was open when it landed.
+        for ids, where in _ambient_donations:
+            rec.donations.append((0, ids, where))
+        ctx.analysis_recorder = rec
 
 
 def _target(ctx) -> Optional[Recorder]:
@@ -235,7 +254,19 @@ def begin_event(opname: str, comm, arrays, token, ana: Optional[dict],
     ms = getattr(ctx, "megastep", None) if ctx is not None else None
     if ms is not None:
         evt.loop, evt.unroll = ms
+    # buffer identity of the array inputs (dataflow hazard join key,
+    # analysis/hazards.py) — recorded BEFORE ana so a fusion flush can
+    # overwrite it with the packed bucket's member buffers
+    live = [a for a in arrays if a is not None]
+    if live:
+        evt.buffers = tuple(id(a) for a in live)
+        rec.pins.extend(live)
     if ana:
+        carriers = ana.pop("buffer_carriers", None)
+        if carriers:
+            # a fusion flush hands the member arrays alongside their ids
+            # so they stay pinned like every other id carrier (graph.py)
+            rec.pins.extend(carriers)
         for k, v in ana.items():
             setattr(evt, k, v)
     if token is not None:
@@ -262,6 +293,52 @@ def abort_event(evt: CollectiveEvent) -> None:
     diagnostic — tagged at the raise site)."""
     if _open_events and _open_events[-1][0] is evt:
         _open_events.pop()
+
+
+# donations recorded outside any recording scope under the env mode:
+# (frozenset of buffer ids, call site), carriers pinned alongside.
+# Seeded into every fresh env-mode recorder at position 0 (arm_context);
+# capped so a long-running donating loop cannot grow host state
+# unboundedly, and cleared with the analysis caches.
+_AMBIENT_DONATION_CAP = 32
+_ambient_donations: List[tuple] = []
+_ambient_donation_pins: List = []
+
+
+def record_donation(arrays, where: str, ctx=None) -> None:
+    """Record that a pinned call (aot/pinning.py, ``donate_argnums``) just
+    handed the storage of ``arrays`` to its executable.  Pure host-side
+    bookkeeping like ``begin_event`` — never touches the trace.  With a
+    recorder active (explicit ``mpx.analyze``, or the caller passes the
+    armed region context for the env mode) the donation lands at the
+    current event-stream position; under the env mode with no recorder in
+    scope it joins the ambient log that pre-seeds the next armed region.
+    The MPX139/MPX140 checkers intersect the recorded ids with span holds
+    and later consumers."""
+    live = [a for a in arrays if a is not None]
+    if not live:
+        return
+    rec = _target(ctx)
+    if rec is not None:
+        rec.pins.extend(live)
+        rec.donations.append(
+            (len(rec.events), frozenset(id(a) for a in live), where))
+        return
+    if effective_mode() != "off" and \
+            len(_ambient_donations) < _AMBIENT_DONATION_CAP:
+        _ambient_donation_pins.extend(live)
+        _ambient_donations.append(
+            (frozenset(id(a) for a in live), where))
+
+
+def mark_last_event(key: str, value, ctx=None) -> None:
+    """Stamp an ``extra`` annotation on the most recently recorded event
+    — for op wrappers that only learn a fact AFTER their inner dispatch
+    returned (ops/_compress.ef_allreduce marks its reductions ``ef``,
+    arming the approximate-lineage seeds).  No-op when nothing records."""
+    rec = _target(ctx)
+    if rec is not None and rec.events:
+        rec.events[-1].extra[key] = value
 
 
 def annotate(**fields) -> None:
@@ -339,3 +416,5 @@ def analyze_cache() -> dict:
 
 def clear_analysis_caches() -> None:
     _analyze_cache.clear()
+    del _ambient_donations[:]
+    del _ambient_donation_pins[:]

@@ -332,8 +332,69 @@ CODES = {
             "inside one iteration (overlap is per-iteration in a "
             "megastep), or drop unroll= for this program.",
         ),
+        # --- dataflow hazard codes (analysis/dataflow.py + hazards.py):
+        # value-level safety over the closed jaxpr joined with the
+        # recorded dispatch graph — races, donation, and lineage taint,
+        # not schedule structure.
+        CodeInfo(
+            "MPX139", "buffer mutated while an async span holds it", ERROR,
+            "A buffer was donated (or rebound in place) while an open "
+            "async *_start/*_wait span still holds it: the span's "
+            "exchange phases read the buffer after the start, so a "
+            "donation or in-place update between start and wait is a "
+            "write-after-start race — the wire may ship the OVERWRITTEN "
+            "bytes.  This includes spans crossing mpx.overlap() region "
+            "boundaries and fusion LazyResults aliasing bucket members.  "
+            "Wait on the handle (or leave the overlap region) before "
+            "donating or rebinding the buffer.",
+        ),
+        CodeInfo(
+            "MPX140", "value consumed after donation", ERROR,
+            "A value was consumed by a later collective after the pinned "
+            "call (mpx.compile donate_argnums) that donated its buffer, "
+            "within one trace: the donated buffer's storage is handed to "
+            "the executable, so the later read sees freed or aliased "
+            "memory.  Drop the stale reference and use the pinned "
+            "program's OUTPUT, or remove the argument from "
+            "donate_argnums (docs/aot.md).",
+        ),
+        CodeInfo(
+            "MPX141", "rank-local lineage shapes the collective schedule",
+            ERROR,
+            "A rank-local (non-replicated) value — a Get_rank-derived "
+            "scalar, an error-feedback residual, any lineage that "
+            "differs per rank — flows into a predicate that gates "
+            "collectives (lax.cond/switch with communicating branches "
+            "that differ): the schedule itself then diverges across "
+            "ranks, the hang class the cross-rank pass only catches "
+            "after re-tracing every rank.  Replicate the value first "
+            "(allreduce it) or make the branch structure rank-invariant "
+            "(docs/sharp_bits.md).",
+        ),
+        CodeInfo(
+            "MPX142", "approximate lineage reaches an exactness-required "
+            "sink", ADVISORY,
+            "A value carrying approximate (wire-codec) lineage — it "
+            "passed through a quantize/dequantize roundtrip (bf16/fp8, "
+            "ops/_compress.py) — reaches a sink that assumes exact "
+            "arithmetic: a collective root or routing index, an MoE "
+            "capacity count, a branch predicate, or shard-store commit "
+            "bytes.  Quantization error can flip the decision "
+            "differently per rank or corrupt committed state; the "
+            "finding renders the taint frontier op by op.  Derive the "
+            "decision from exact values, or carry the error through "
+            "error feedback (docs/compression.md).",
+        ),
     )
 }
+
+# the dataflow-hazard code families, referenced by Report.hazards and the
+# ownership accounting in tests/test_analysis_pure.py: the graph half
+# (checker-registered in analysis/hazards.py) and the jaxpr half (emitted
+# by the analysis/dataflow.py walker, like MPX108).
+HAZARD_GRAPH_CODES = ("MPX139", "MPX140")
+HAZARD_JAXPR_CODES = ("MPX141", "MPX142")
+HAZARD_CODES = HAZARD_GRAPH_CODES + HAZARD_JAXPR_CODES
 
 
 def mpx_error(exc_type, code: str, message: str):
@@ -357,7 +418,13 @@ class Finding:
 
     ``rank`` and ``seq`` are the cross-rank provenance fields (which
     rank's schedule anchors the finding, and at which per-comm collective
-    sequence number) — ``None`` for single-trace findings."""
+    sequence number) — ``None`` for single-trace findings.
+
+    ``frontier`` is the taint frontier of a dataflow-hazard finding
+    (MPX141/MPX142): the op-by-op path from the lineage seed to the
+    sink, one human-readable step per entry.  Empty for every other
+    finding, and emitted in ``to_json`` only when non-empty, so
+    pre-hazard payloads are byte-identical."""
 
     code: str
     message: str
@@ -366,6 +433,7 @@ class Finding:
     index: Optional[int] = None
     rank: Optional[int] = None
     seq: Optional[int] = None
+    frontier: Tuple[str, ...] = ()
 
     @property
     def severity(self) -> str:
@@ -376,6 +444,8 @@ class Finding:
         if self.rank is not None:
             where += f" (rank {self.rank})"
         line = f"{self.code} [{self.severity}]{where}: {self.message}"
+        for step in self.frontier:
+            line += f"\n    taint: {step}"
         if self.suggestion:
             line += f"\n    fix: {self.suggestion}"
         return line
@@ -383,7 +453,7 @@ class Finding:
     def to_json(self) -> Dict:
         """Machine-readable form (one object per finding, with rank/op/
         seq provenance) — the unit of ``Report.to_json``."""
-        return {
+        out = {
             "code": self.code,
             "severity": self.severity,
             "title": CODES[self.code].title,
@@ -394,6 +464,11 @@ class Finding:
             "rank": self.rank,
             "seq": self.seq,
         }
+        if self.frontier:
+            # present only on taint findings: every other payload keeps
+            # its pre-hazard key set byte-for-byte
+            out["frontier"] = list(self.frontier)
+        return out
 
 
 def finding_from_exception(exc) -> Optional[Finding]:
@@ -435,6 +510,13 @@ class Report:
     @property
     def advisories(self) -> Tuple[Finding, ...]:
         return tuple(f for f in self.findings if f.severity == ADVISORY)
+
+    @property
+    def hazards(self) -> Tuple[Finding, ...]:
+        """The dataflow-hazard findings (MPX139-MPX142): races, donation
+        violations, and lineage taint — the value-level subset of
+        ``findings``."""
+        return tuple(f for f in self.findings if f.code in HAZARD_CODES)
 
     def render(self) -> str:
         if not self.findings:

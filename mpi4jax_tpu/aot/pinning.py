@@ -345,11 +345,13 @@ class PinnedProgram:
     """
 
     __slots__ = ("_call", "_world", "_stats", "_respec", "fn_name", "key",
-                 "from_disk", "donate_argnums", "fast_path", "unroll")
+                 "from_disk", "donate_argnums", "fast_path", "unroll",
+                 "_traceable", "_donate_call")
 
     def __init__(self, call, world: WorldStamp, respec, fn_name: str,
                  key, from_disk: bool, donate_argnums,
-                 fast_path: bool = False, unroll: int = 1):
+                 fast_path: bool = False, unroll: int = 1,
+                 traceable=None, donate_call=None):
         self._call = call
         self._world = world
         self._stats = _stats
@@ -360,6 +362,14 @@ class PinnedProgram:
         self.donate_argnums = donate_argnums
         self.fast_path = fast_path
         self.unroll = unroll
+        # the traceable jit twin of the pinned executable (same fn, same
+        # donation semantics): the dataflow hazard verifier's re-trace
+        # routes through it, because a Compiled cannot accept tracers
+        self._traceable = traceable
+        # donated positions in CALL-TIME coordinates (statics are folded
+        # at pin time and not passed) — what record_donation indexes
+        self._donate_call = tuple(donate_call) if donate_call is not None \
+            else tuple(donate_argnums)
 
     def __call__(self, *args):
         world = self._world
@@ -368,6 +378,13 @@ class PinnedProgram:
             _meter("aot.stale_raises")
             world.check(f"pinned program {self.fn_name!r}")
         self._stats.calls += 1
+        # dataflow hazard bookkeeping (analysis/hazards.py MPX139/MPX140):
+        # donation-free programs skip both branches on one attribute test
+        # each, keeping the zero-work call path intact
+        if self._donate_call:
+            _note_donation(self, args)
+        if self._traceable is not None and _analysis_recording():
+            return self._traceable(*args)
         return self._call(*args)
 
     def is_stale(self) -> bool:
@@ -387,6 +404,37 @@ class PinnedProgram:
                 + (f", unroll={self.unroll}" if self.unroll > 1 else "")
                 + (", cpp" if self.fast_path else "")
                 + (", STALE" if self.is_stale() else "") + ")")
+
+
+def _analysis_recording() -> bool:
+    """Is any analysis recorder capturing this call site?  Explicit
+    ``mpx.analyze`` (global recorder stack) or an armed env-mode region
+    context enclosing the call."""
+    try:
+        from ..analysis import hook
+        from ..parallel.region import _region_stack
+    except ImportError:  # pragma: no cover - isolated loaders
+        return False
+    if hook.recording():
+        return True
+    ctx = _region_stack[-1] if _region_stack else None
+    return ctx is not None and \
+        getattr(ctx, "analysis_recorder", None) is not None
+
+
+def _note_donation(program: "PinnedProgram", args) -> None:
+    """Hand this call's donated argument identities to the dataflow
+    hazard verifier (analysis/hook.record_donation — fully self-gating:
+    a no-op unless a recorder is active or the env mode is armed)."""
+    try:
+        from ..analysis import hook
+        from ..parallel.region import _region_stack
+    except ImportError:  # pragma: no cover - isolated loaders
+        return
+    ctx = _region_stack[-1] if _region_stack else None
+    donated = [args[i] for i in program._donate_call if i < len(args)]
+    hook.record_donation(donated, f"pinned call {program.fn_name!r}",
+                         ctx=ctx)
 
 
 def _normalize_statics(static_argnums, nargs: int) -> tuple:
@@ -515,6 +563,14 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
             a if i in statics else _abstract((a,))[0]
             for i, a in enumerate(abstract_args)
         )
+        # call-time coordinates: statics are folded and not passed, so a
+        # donated original position shifts left past every static below
+        # it (donate ∩ statics already rejected above)
+        donate_call = tuple(i - sum(1 for s in statics if s < i)
+                            for i in donate)
+        # with statics the jit twin's signature differs from the pinned
+        # call's — no traceable reroute there
+        traceable = jitted if not statics else None
         mesh = c.mesh
     else:
         if c.mesh is None:
@@ -557,6 +613,8 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
                            out_specs=ospecs)
         jitted = jax.jit(sm, donate_argnums=donate_dyn or None)
         trace_args = _abstract(dyn_args)
+        donate_call = donate_dyn
+        traceable = jitted
         mesh = c.mesh
 
     # capture BEFORE the trace: a flag that moves mid-compile leaves a
@@ -573,7 +631,8 @@ def compile(fn, *abstract_args, comm=None, donate_argnums=(),
         return compile(fn, *abstract_args, **spec)
 
     return PinnedProgram(call, world, respec, name, key, from_disk, donate,
-                         fast_path=fast, unroll=n_unroll)
+                         fast_path=fast, unroll=n_unroll,
+                         traceable=traceable, donate_call=donate_call)
 
 
 # ---------------------------------------------------------------------------

@@ -657,7 +657,13 @@ class overlap:
     emitted at the call site and the wait is deferred until the result is
     first used (or the region exits), so the compute issued in between
     overlaps with the wire phases.  Requires a managed parallel region
-    (``mpx.spmd`` / ``mpx.run``); see docs/overlap.md."""
+    (``mpx.spmd`` / ``mpx.run``); see docs/overlap.md.
+
+    While a start is in flight — including the implicit ones this region
+    defers — its input buffer is live on the wire: donating it to a
+    pinned executable (``mpx.compile(donate_argnums=...)``) before the
+    wait is a write-after-start race, flagged MPX139 by the dataflow
+    hazard verifier (docs/analysis.md "Dataflow hazards")."""
 
     def __enter__(self):
         from ..parallel.region import _region_stack

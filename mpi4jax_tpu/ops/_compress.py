@@ -304,6 +304,9 @@ def ef_allreduce(grads, residual, op=None, *, comm=None):
             f"tree ({len(res_leaves)} vs {len(leaves)} leaves) — "
             "initialize it with ef_zeros_like(grads)"
         )
+    from ..analysis import hook as _ana_hook
+    from ..parallel.region import _region_stack
+
     outs, new_res, token = [], [], None
     for g, r in zip(leaves, res_leaves):
         codec = dcn_codec(g, int(g.size) * g.dtype.itemsize, op)
@@ -311,6 +314,13 @@ def ef_allreduce(grads, residual, op=None, *, comm=None):
         q = roundtrip(comp, codec)
         new_res.append((comp - q).astype(g.dtype))
         out, token = allreduce(q, op=op, comm=comm, token=token)
+        # mark the recorded reduction as an error-feedback step: arms the
+        # approximate-lineage seeds of the dataflow taint pass
+        # (analysis/dataflow.graph_arms_approx) — the residual and the
+        # reduced value both carry codec error, and MPX141/MPX142 watch
+        # where that lineage flows (docs/analysis.md "Dataflow hazards")
+        _ana_hook.mark_last_event(
+            "ef", True, ctx=_region_stack[-1] if _region_stack else None)
         outs.append(out)
     return (jax.tree_util.tree_unflatten(treedef, outs),
             jax.tree_util.tree_unflatten(treedef, new_res), token)

@@ -416,12 +416,19 @@ def _flush_queue(q: _Queue) -> None:
             # the layout (per-member dtype + element count, in pack
             # order) is what the cross-rank matcher compares: two ranks
             # packing different flat buffers is MPX124
+            member_arrays = tuple(entries[i].array for i in members)
             _pending_ana = {"fused_members": len(members),
                             "fused_bytes": int(flat.size) * flat.dtype.itemsize,
                             "fused_layout": tuple(
-                                (str(entries[i].array.dtype),
-                                 int(entries[i].array.size))
-                                for i in members)}
+                                (str(a.dtype), int(a.size))
+                                for a in member_arrays),
+                            # the dataflow hazard join key: the packed op
+                            # charges the MEMBER buffers (not the flat
+                            # concatenation), so a LazyResult aliasing a
+                            # bucket member — or a donation of one — stays
+                            # traceable (analysis/hazards.py MPX139/140)
+                            "buffers": tuple(id(a) for a in member_arrays),
+                            "buffer_carriers": member_arrays}
             try:
                 fused = _run_member(q, flat)
             finally:

@@ -43,6 +43,7 @@ def _load_isolated():
         setattr(root, sub, m)
     for mod in ("utils.config", "ops._fusion", "analysis.report",
                 "analysis.graph", "analysis.checkers", "analysis.walker",
+                "analysis.dataflow", "analysis.hazards",
                 "analysis.hook", "analysis.schedule", "analysis.matcher",
                 "analysis.progress", "analysis.costmodel", "analysis.cost",
                 "parallel.rankspec"):
@@ -90,7 +91,11 @@ def test_catalog_is_fully_owned():
     # a graph checker
     cost_owned = set(cost.COST_CODES)
     raise_site_owned = {"MPX129"}
-    assert (checkers.registered_codes() | {"MPX108"} | crossrank_owned
+    # MPX141/142 are owned by the dataflow taint pass (analysis/
+    # dataflow.py) — jaxpr-level like MPX108; the graph-side hazard
+    # checkers (MPX139/140, analysis/hazards.py) register normally
+    jaxpr_owned = {"MPX108"} | set(report.HAZARD_JAXPR_CODES)
+    assert (checkers.registered_codes() | jaxpr_owned | crossrank_owned
             | cost_owned | raise_site_owned == set(report.CODES))
     # the registries never claim the same code
     assert not crossrank_owned & checkers.registered_codes()

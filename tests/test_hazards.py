@@ -1,0 +1,351 @@
+"""Dataflow hazard verifier: the traced integration half.
+
+Real 8-device programs through both front-ends (docs/analysis.md
+"Dataflow hazards"):
+
+- ``mpx.analyze(...)`` — findings land in ``Report.hazards``, the taint
+  frontier rides ``to_json()``;
+- the ambient ``MPI4JAX_TPU_ANALYZE=error`` path — the same pass at
+  trace time, before anything compiles.
+
+Covers the donation race (MPX139, the traced twin of
+examples/broken/overlap_donation_race.py), use-after-donate (MPX140),
+the rank-local schedule gate (MPX141, the traced twin of
+examples/broken/ef_divergent_gate.py without the compression layer —
+the hazard is structural), the approximate-lineage advisory (MPX142,
+codec-armed), the HLO byte-identity pin across analyze modes with a
+donating program, and the cache-token pin (flipping the mode stales
+pinned programs).  The pure fake-jaxpr matrix lives in
+tests/test_hazards_pure.py.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import pytest
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.analysis import hook
+from helpers import ranks_arange, world
+
+
+@pytest.fixture(autouse=True)
+def _reset_analysis(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE", raising=False)
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE_RANKS", raising=False)
+    yield
+    mpx.set_analyze_mode(None)
+    mpx.clear_caches()
+
+
+def codes(report):
+    return [f.code for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# MPX139: buffer donated while an open async span holds it
+# ---------------------------------------------------------------------------
+
+
+def _pinned_scale(donate=True):
+    local = jax.ShapeDtypeStruct((16,), jnp.float32)
+    kw = {"donate_argnums": (0,)} if donate else {}
+    return mpx.compile(lambda v: v * 2.0, local, wrap=False, **kw)
+
+
+def _donation_race_step(comm):
+    """The overlap_donation_race.py program: donate mid-span."""
+    scale = _pinned_scale()
+
+    def step(x):
+        handle, t = mpx.allreduce_start(x, mpx.SUM, comm=comm)
+        y = scale(x)  # BUG: x is still held by the open span
+        total, t = mpx.allreduce_wait(handle, token=t)
+        return total + y
+
+    return step
+
+
+def _wait_then_donate_step(comm):
+    """The fixed twin: the span closes before the donation."""
+    scale = _pinned_scale()
+
+    def step(x):
+        handle, t = mpx.allreduce_start(x, mpx.SUM, comm=comm)
+        total, t = mpx.allreduce_wait(handle, token=t)
+        y = scale(x)  # span closed: donating x is legal now
+        return total + y
+
+    return step
+
+
+def test_mpx139_donation_race_via_analyze():
+    comm, _ = world()
+    step = _donation_race_step(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert "MPX139" in codes(report)
+    f = next(f for f in report.findings if f.code == "MPX139")
+    assert f.severity == "error"
+    # buffer ids are equality handles, never rendered
+    assert "0x" not in f.message
+    # and the finding is surfaced through the hazards partition
+    assert "MPX139" in [g.code for g in report.hazards]
+
+
+def test_mpx139_donation_race_via_env_error():
+    comm, _ = world()
+    x = ranks_arange((16,))
+    mpx.set_analyze_mode("error")
+    # pin under the new mode epoch: flipping the analyze mode stales
+    # programs pinned before it
+    step = _donation_race_step(comm)
+    with pytest.raises(mpx.AnalysisError) as ei:
+        mpx.run(step, x, comm=comm)
+    assert any(f.code == "MPX139" for f in ei.value.findings)
+
+
+def test_mpx139_silent_when_donation_follows_wait():
+    comm, _ = world()
+    step = _wait_then_donate_step(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert not {"MPX139", "MPX140"} & set(codes(report))
+
+
+def test_mpx139_silent_without_donation():
+    comm, _ = world()
+    scale = _pinned_scale(donate=False)
+
+    def step(x):
+        handle, t = mpx.allreduce_start(x, mpx.SUM, comm=comm)
+        y = scale(x)  # no donate_argnums: reading mid-span is fine
+        total, t = mpx.allreduce_wait(handle, token=t)
+        return total + y
+
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert not {"MPX139", "MPX140"} & set(codes(report))
+
+
+# ---------------------------------------------------------------------------
+# MPX140: value consumed after the donating pinned call
+# ---------------------------------------------------------------------------
+
+
+def _use_after_donate_step(comm):
+    scale = _pinned_scale()
+
+    def step(x):
+        y = scale(x)  # donates x's storage
+        # BUG: the collective reads a buffer the executable may have
+        # already overwritten in place
+        total, _ = mpx.allreduce(x, mpx.SUM, comm=comm)
+        return total + y
+
+    return step
+
+
+def test_mpx140_use_after_donate_via_analyze():
+    comm, _ = world()
+    step = _use_after_donate_step(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert "MPX140" in codes(report)
+    f = next(f for f in report.findings if f.code == "MPX140")
+    assert f.severity == "error"
+    assert "MPX140" in [g.code for g in report.hazards]
+
+
+def test_mpx140_use_after_donate_via_env_error():
+    comm, _ = world()
+    x = ranks_arange((16,))
+    mpx.set_analyze_mode("error")
+    step = _use_after_donate_step(comm)
+    with pytest.raises(mpx.AnalysisError) as ei:
+        mpx.run(step, x, comm=comm)
+    assert any(f.code == "MPX140" for f in ei.value.findings)
+
+
+def test_mpx140_silent_when_collective_precedes_donation():
+    comm, _ = world()
+    scale = _pinned_scale()
+
+    def step(x):
+        total, _ = mpx.allreduce(x, mpx.SUM, comm=comm)
+        y = scale(x)  # donation last: nothing reads x afterwards
+        return total + y
+
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert not {"MPX139", "MPX140"} & set(codes(report))
+
+
+# ---------------------------------------------------------------------------
+# MPX141: rank-local lineage gates divergent collective schedules
+# ---------------------------------------------------------------------------
+
+
+def _divergent_gate_step(comm, diverge=True):
+    """The ef_divergent_gate.py shape without the compression layer: the
+    raw per-rank input is rank-varying by type, so gating a cond on it
+    is structurally the same hazard as gating on the EF residual."""
+
+    def step(x):
+        total, _ = mpx.allreduce(x, mpx.SUM, comm=comm)
+        drift = jnp.max(jnp.abs(x))  # rank-LOCAL: raw input, not total
+
+        def resync(v):
+            s, _ = mpx.allreduce(v, mpx.SUM, comm=comm)
+            m, _ = mpx.allreduce(jnp.mean(s) * jnp.ones_like(s),
+                                 mpx.SUM, comm=comm)
+            return s - m
+
+        def keep(v):
+            s, _ = mpx.allreduce(v, mpx.SUM, comm=comm)
+            return s
+
+        left = resync if diverge else keep
+        return lax.cond(drift > jnp.float32(0.5), left, keep, total)
+
+    return step
+
+
+def test_mpx141_divergent_gate_via_analyze():
+    comm, _ = world()
+    step = _divergent_gate_step(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert "MPX141" in codes(report)
+    f = next(f for f in report.findings if f.code == "MPX141")
+    assert f.severity == "error"
+    # the op-by-op taint frontier is rendered and serialized
+    assert f.frontier and "cond predicate" in f.frontier[-1]
+    assert "taint:" in report.render()
+    payload = next(d for d in report.to_json()["findings"]
+                   if d["code"] == "MPX141")
+    assert payload["frontier"]
+    # both branches communicate: the structural checker stays silent
+    assert "MPX108" not in codes(report)
+
+
+def test_mpx141_divergent_gate_via_env_error():
+    comm, _ = world()
+    step = _divergent_gate_step(comm)
+    x = ranks_arange((16,))
+    mpx.set_analyze_mode("error")
+    with pytest.raises(mpx.AnalysisError) as ei:
+        mpx.run(step, x, comm=comm)
+    assert any(f.code == "MPX141" for f in ei.value.findings)
+
+
+def test_mpx141_silent_when_schedules_agree():
+    comm, _ = world()
+    step = _divergent_gate_step(comm, diverge=False)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    # still rank-gated, but both branches issue the same schedule: no
+    # rank can hang another
+    assert "MPX141" not in codes(report)
+
+
+# ---------------------------------------------------------------------------
+# MPX142: approximate lineage reaches an exactness-required sink
+# ---------------------------------------------------------------------------
+
+
+def _codec_gate_step(comm):
+    def step(x):
+        total, _ = mpx.allreduce(x, mpx.SUM, comm=comm)
+        # a codec-style lossy roundtrip on the gating value
+        q = total.astype(jnp.bfloat16).astype(jnp.float32)
+
+        def a(v):
+            s, _ = mpx.allreduce(v, mpx.SUM, comm=comm)
+            return s
+
+        def b(v):
+            s, _ = mpx.allreduce(v, mpx.SUM, comm=comm)
+            return s
+
+        # same schedule on both sides: MPX141 has nothing to say, but
+        # quantization error can still flip the pick differently per rank
+        return lax.cond(jnp.max(q) > jnp.float32(0.5), a, b, total)
+
+    return step
+
+
+def test_mpx142_advisory_when_codec_armed(monkeypatch):
+    comm, _ = world()
+    monkeypatch.setenv("MPI4JAX_TPU_COMPRESS", "bf16")
+    mpx.clear_caches()
+    step = _codec_gate_step(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert "MPX142" in codes(report)
+    f = next(f for f in report.findings if f.code == "MPX142")
+    assert f.severity == "advisory"
+    assert f.frontier  # the downcast seed is named op by op
+    assert "MPX141" not in codes(report)
+
+
+def test_mpx142_unarmed_without_codec_activity(monkeypatch):
+    comm, _ = world()
+    # same program, no codec anywhere in the config or the recorded
+    # graph: plain mixed precision must never taint
+    monkeypatch.delenv("MPI4JAX_TPU_COMPRESS", raising=False)
+    mpx.clear_caches()
+    step = _codec_gate_step(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm)
+    assert "MPX142" not in codes(report)
+
+
+# ---------------------------------------------------------------------------
+# mode pins: byte-identical HLO + the analysis cache token
+# ---------------------------------------------------------------------------
+
+
+def test_hlo_byte_identical_across_modes_with_donation():
+    # the hazard pass is pure host-side bookkeeping: a CLEAN donating
+    # program must lower byte-identically in off/warn/error (the
+    # schedule-checker version of this pin lives in test_analysis.py /
+    # test_crossrank.py)
+    from mpi4jax_tpu.parallel.region import spmd
+
+    comm, _ = world()
+    x = ranks_arange((16,))
+    texts = {}
+    for mode in (None, "warn", "error"):
+        mpx.set_analyze_mode(mode)
+        mpx.clear_caches()
+        step = _wait_then_donate_step(comm)
+        twin = spmd(lambda v: mpx.varying(step(v)), comm=comm, jit=False)
+        texts[mode] = jax.jit(twin).lower(x).as_text()
+    assert texts[None] == texts["warn"] == texts["error"]
+
+
+def test_analysis_cache_token_tracks_mode(monkeypatch):
+    # the token is folded into every compiled-program cache key: a mode
+    # flip (or a cross-rank setting change) must stale pinned programs
+    base = hook.analysis_cache_token()
+    mpx.set_analyze_mode("error")
+    armed = hook.analysis_cache_token()
+    assert armed != base
+    mpx.set_analyze_mode(None)
+    assert hook.analysis_cache_token() == base
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_RANKS", "4")
+    assert hook.analysis_cache_token() != base
+
+
+def test_clean_program_clean_at_all_ranks():
+    # the acceptance shape of the CI analyze lane: a non-broken program
+    # carries zero hazard findings through the cross-rank path too
+    from mpi4jax_tpu.analysis.report import HAZARD_CODES
+
+    comm, _ = world()
+    step = _wait_then_donate_step(comm)
+    x = ranks_arange((16,))
+    report = mpx.analyze(step, x, comm=comm, ranks="all")
+    assert not set(HAZARD_CODES) & set(codes(report))
+    assert not report.hazards

@@ -1,0 +1,509 @@
+"""Dataflow hazard verifier: the pure-Python half (docs/analysis.md
+"Dataflow hazards").
+
+Positive/negative matrix for the two halves of the hazard verifier —
+the graph-side buffer checkers (analysis/hazards.py: MPX139 donation
+races against open async spans, MPX140 use-after-donate) driven by
+hand-built event streams with donation records, and the jaxpr-side
+taint pass (analysis/dataflow.py: MPX141 rank-local schedule gates,
+MPX142 approximate lineage) driven by duck-typed fake jaxprs — all
+loaded under a private package name (the tests/test_analysis_pure.py
+isolated loader) so these run even where the installed JAX is below the
+package's floor.  The traced integration half — the same hazards driven
+through ``mpx.analyze`` and the ambient env=error path on the 8-device
+mesh — lives in tests/test_hazards.py.
+"""
+
+import importlib
+import pathlib
+import sys
+import types
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_hazards_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "analysis", "ops", "parallel", "resilience"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "ops._fusion", "analysis.report",
+                "analysis.graph", "analysis.checkers", "analysis.walker",
+                "analysis.dataflow", "analysis.hazards", "analysis.hook",
+                "analysis.schedule", "analysis.matcher",
+                "analysis.progress", "resilience.elastic",
+                "analysis.crossrank", "parallel.rankspec"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+report = sys.modules[f"{_ISO_NAME}.analysis.report"]
+graph = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+checkers = sys.modules[f"{_ISO_NAME}.analysis.checkers"]
+dataflow = sys.modules[f"{_ISO_NAME}.analysis.dataflow"]
+hazards = sys.modules[f"{_ISO_NAME}.analysis.hazards"]
+crossrank = sys.modules[f"{_ISO_NAME}.analysis.crossrank"]
+
+E = graph.CollectiveEvent
+G = graph.CollectiveGraph
+
+
+# ---------------------------------------------------------------------------
+# duck-typed fake jaxprs (the tests/test_analysis_pure.py walker fakes,
+# extended with invars/outvars/avals for the taint environment)
+# ---------------------------------------------------------------------------
+
+
+class _Prim:
+    def __init__(self, name):
+        self.name = name
+
+
+class _Aval:
+    def __init__(self, dtype=None, vma=None):
+        self.dtype = dtype
+        self.vma = vma
+
+
+class _Var:
+    def __init__(self, aval=None):
+        self.aval = aval
+
+
+class _Lit:
+    def __init__(self, val=0):
+        self.val = val
+
+
+class _Eqn:
+    def __init__(self, name, invars=(), outvars=(), params=None):
+        self.primitive = _Prim(name)
+        self.invars = list(invars)
+        self.outvars = list(outvars)
+        self.params = params or {}
+
+
+class _Jaxpr:
+    def __init__(self, eqns, invars=(), outvars=()):
+        self.eqns = eqns
+        self.invars = list(invars)
+        self.outvars = list(outvars)
+
+
+class _Closed:
+    def __init__(self, jaxpr):
+        self.jaxpr = jaxpr
+
+
+def _branch(*coll_names):
+    """One cond branch taking one operand and issuing the named
+    collectives in a chain."""
+    v = _Var()
+    eqns, cur = [], v
+    for name in coll_names:
+        nxt = _Var()
+        eqns.append(_Eqn(name, [cur], [nxt]))
+        cur = nxt
+    return _Closed(_Jaxpr(eqns, invars=[v], outvars=[cur]))
+
+
+def _gate(pred, operand, left=("psum", "ppermute"), right=("psum",)):
+    """A cond whose branches issue the given collective schedules."""
+    return _Eqn("cond", [pred, operand], [_Var()],
+                {"branches": (_branch(*left), _branch(*right))})
+
+
+def _findings(eqns, **kw):
+    return dataflow.hazard_jaxpr_findings(
+        _Closed(_Jaxpr(eqns)), **kw)
+
+
+# ---------------------------------------------------------------------------
+# MPX141 — rank-local lineage gating the collective schedule
+# ---------------------------------------------------------------------------
+
+
+def test_mpx141_axis_index_seed_fires():
+    r, p, x = _Var(), _Var(), _Var()
+    fs = _findings([
+        _Eqn("axis_index", [], [r]),
+        _Eqn("gt", [r, _Lit()], [p]),
+        _gate(p, x),
+    ])
+    (f,) = fs
+    assert f.code == "MPX141"
+    assert report.CODES["MPX141"].severity == report.ERROR
+    assert "different collective schedules" in f.message
+    # the rendered per-branch signatures name the differing schedules
+    assert "psum" in f.message and "ppermute" in f.message
+    # the taint frontier runs seed -> sink
+    assert "axis_index" in f.frontier[0]
+    assert "cond predicate" in f.frontier[-1]
+    assert "taint:" in f.render()
+
+
+def test_mpx141_silent_when_schedules_agree():
+    r, p, x = _Var(), _Var(), _Var()
+    fs = _findings([
+        _Eqn("axis_index", [], [r]),
+        _Eqn("gt", [r, _Lit()], [p]),
+        _gate(p, x, left=("psum",), right=("psum",)),
+    ])
+    assert fs == []
+
+
+def test_mpx141_silent_on_untainted_predicate():
+    p, x = _Var(), _Var()
+    assert _findings([_gate(p, x)]) == []
+
+
+def test_mpx141_replicating_collective_launders():
+    # psum replicates its result across the axis: the gate is now
+    # rank-invariant, so no hazard
+    r, s, p, x = _Var(), _Var(), _Var(), _Var()
+    fs = _findings([
+        _Eqn("axis_index", [], [r]),
+        _Eqn("psum", [r], [s]),
+        _Eqn("gt", [s, _Lit()], [p]),
+        _gate(p, x),
+    ])
+    assert fs == []
+
+
+def test_mpx141_psum_scatter_does_not_launder():
+    # psum_scatter leaves a DIFFERENT shard on every rank — the prefix
+    # match must not mistake it for a replicating reduction
+    r, s, p, x = _Var(), _Var(), _Var(), _Var()
+    fs = _findings([
+        _Eqn("axis_index", [], [r]),
+        _Eqn("psum_scatter", [r], [s]),
+        _Eqn("gt", [s, _Lit()], [p]),
+        _gate(p, x),
+    ])
+    assert [f.code for f in fs] == ["MPX141"]
+
+
+def test_mpx141_implicit_vma_seed():
+    # shard_map's collective-varying type IS a rank-local verdict: a
+    # value typed vma={'x'} seeds without any axis_index in sight (the
+    # EF-residual lineage of examples/broken/ef_divergent_gate.py)
+    p, x = _Var(_Aval(vma={"x"})), _Var()
+    (f,) = _findings([_gate(p, x)])
+    assert f.code == "MPX141"
+    assert "vma={x}" in f.frontier[0]
+
+
+def test_replicates_table():
+    assert dataflow.replicates("psum")
+    assert dataflow.replicates("psum2")
+    assert dataflow.replicates("all_gather")
+    assert dataflow.replicates("pmax")
+    assert not dataflow.replicates("psum_scatter")
+    assert not dataflow.replicates("ppermute")
+    assert not dataflow.replicates("all_to_all")
+
+
+def test_collective_signature_counts_nested():
+    inner = _Jaxpr([_Eqn("psum"), _Eqn("psum")])
+    outer = _Jaxpr([_Eqn("pjit", params={"jaxpr": _Closed(inner)}),
+                    _Eqn("ppermute")])
+    assert dataflow.collective_signature(outer) == (
+        ("ppermute", 1), ("psum", 2))
+
+
+# ---------------------------------------------------------------------------
+# MPX142 — approximate lineage at exactness-required sinks
+# ---------------------------------------------------------------------------
+
+
+def _downcast_chain(pred_sink=True):
+    x = _Var(_Aval(dtype="float32"))
+    y, p, z = _Var(), _Var(), _Var()
+    eqns = [_Eqn("convert_element_type", [x], [y],
+                 {"new_dtype": "bfloat16"})]
+    if pred_sink:
+        eqns += [_Eqn("gt", [y, _Lit()], [p]),
+                 _gate(p, z, left=("psum",), right=("psum",))]
+    return eqns, y
+
+
+def test_mpx142_arming_gate():
+    eqns, _ = _downcast_chain()
+    # unarmed: a float downcast is ordinary mixed precision
+    assert _findings(eqns) == []
+    fs = _findings(eqns, approx_armed=True)
+    (f,) = fs
+    assert f.code == "MPX142"
+    assert report.CODES["MPX142"].severity == report.ADVISORY
+    assert "lossy codec downcast" in f.frontier[0]
+
+
+def test_mpx142_index_sink():
+    eqns, y = _downcast_chain(pred_sink=False)
+    arr, out = _Var(), _Var()
+    eqns.append(_Eqn("dynamic_slice", [arr, y], [out]))
+    (f,) = _findings(eqns, approx_armed=True)
+    assert f.code == "MPX142" and f.op == "dynamic_slice"
+    assert "index operand" in f.message
+
+
+def test_mpx142_approx_survives_reduction():
+    # replication launders RANK but APPROX error survives the psum
+    eqns, y = _downcast_chain(pred_sink=False)
+    s, p, z = _Var(), _Var(), _Var()
+    eqns += [_Eqn("psum", [y], [s]),
+             _Eqn("gt", [s, _Lit()], [p]),
+             _gate(p, z, left=("psum",), right=("psum",))]
+    (f,) = _findings(eqns, approx_armed=True)
+    assert f.code == "MPX142"
+
+
+def test_upcast_never_seeds():
+    x = _Var(_Aval(dtype="bfloat16"))
+    y, p, z = _Var(), _Var(), _Var()
+    fs = _findings([
+        _Eqn("convert_element_type", [x], [y], {"new_dtype": "float32"}),
+        _Eqn("gt", [y, _Lit()], [p]),
+        _gate(p, z, left=("psum",), right=("psum",)),
+    ], approx_armed=True)
+    assert fs == []
+
+
+def test_graph_arms_approx():
+    assert not dataflow.graph_arms_approx(None)
+    assert not dataflow.graph_arms_approx(G(events=[]))
+    assert not dataflow.graph_arms_approx(
+        G(events=[], meta={"compress": "off"}))
+    assert dataflow.graph_arms_approx(
+        G(events=[], meta={"compress": "bf16"}))
+    assert dataflow.graph_arms_approx(
+        G(events=[E(0, "allreduce", codec="fp8")]))
+    assert dataflow.graph_arms_approx(
+        G(events=[E(0, "allreduce", extra={"ef": True})]))
+
+
+# ---------------------------------------------------------------------------
+# propagation machinery: sub-jaxpr descent, scan feedback, trail cap
+# ---------------------------------------------------------------------------
+
+
+def test_taint_descends_pjit():
+    # the gate sits INSIDE a pjit wrapper; taint maps through the binder
+    r, p = _Var(), _Var()
+    inner_in, inner_p, inner_x = _Var(), _Var(), _Var()
+    inner = _Jaxpr([_Eqn("gt", [inner_in, _Lit()], [inner_p]),
+                    _gate(inner_p, inner_x)],
+                   invars=[inner_in], outvars=[inner_p])
+    fs = _findings([
+        _Eqn("axis_index", [], [r]),
+        _Eqn("pjit", [r], [p], {"jaxpr": _Closed(inner)}),
+    ])
+    assert [f.code for f in fs] == ["MPX141"]
+
+
+def test_scan_carry_feedback():
+    # the carry only becomes rank-local on iteration N+1: round one sees
+    # an untainted carry binder, the feedback round replays the body
+    # with the carry-output taint fed back in and catches the gate
+    c, cx = _Var(), _Var()
+    a = _Var()
+    body = _Jaxpr([_gate(c, cx),
+                   _Eqn("axis_index", [], [a])],
+                  invars=[c], outvars=[a])
+    x0 = _Var()
+    fs = _findings([
+        _Eqn("scan", [x0], [_Var()],
+             {"jaxpr": _Closed(body), "num_carry": 1, "num_consts": 0}),
+    ])
+    assert [f.code for f in fs] == ["MPX141"]
+
+
+def test_frontier_trail_caps_with_elision():
+    r, p, x = _Var(), _Var(), _Var()
+    eqns = [_Eqn("axis_index", [], [r])]
+    cur = r
+    for _ in range(3 * dataflow._TRAIL_CAP):
+        nxt = _Var()
+        eqns.append(_Eqn("sin", [cur], [nxt]))
+        cur = nxt
+    eqns += [_Eqn("gt", [cur, _Lit()], [p]), _gate(p, x)]
+    (f,) = _findings(eqns)
+    assert f.code == "MPX141"
+    assert len(f.frontier) <= dataflow._TRAIL_CAP + 2
+    assert dataflow._ELLIPSIS in f.frontier
+    # the seed end and the live end both survive the elision
+    assert "axis_index" in f.frontier[0]
+    assert "cond predicate" in f.frontier[-1]
+
+
+# ---------------------------------------------------------------------------
+# MPX139 — donation while an open async span holds the buffer
+# ---------------------------------------------------------------------------
+
+_BUF_A, _BUF_B = 0xA11, 0xB22
+
+
+def _donation(pos, ids, where="pinned call 'scale'"):
+    return (pos, frozenset(ids), where)
+
+
+def test_mpx139_fires_between_start_and_wait():
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=1,
+          buffers=(_BUF_A, _BUF_B)),
+        E(1, "allreduce_wait", comm_uid=1, span=1),
+    ], meta={"donations": (_donation(1, {_BUF_B}),)})
+    fs = [f for f in checkers.run_checkers(g) if f.code == "MPX139"]
+    (f,) = fs
+    assert "write-after-start race" in f.message
+    assert "pinned call 'scale'" in f.message
+    assert "allreduce_wait" in f.suggestion
+    # buffer ids are equality handles only — never rendered
+    assert hex(_BUF_B)[2:] not in f.render()
+
+
+def test_mpx139_unwaited_span_still_fires():
+    # a span crossing an mpx.overlap() boundary has no wait in-stream
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=1, buffers=(_BUF_A,)),
+    ], meta={"donations": (_donation(1, {_BUF_A}),)})
+    assert [f.code for f in checkers.run_checkers(g)
+            if f.code == "MPX139"] == ["MPX139"]
+
+
+def test_mpx139_negatives():
+    # donation BEFORE the span opens: the start captured fresh storage
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=1, buffers=(_BUF_A,)),
+        E(1, "allreduce_wait", comm_uid=1, span=1),
+    ], meta={"donations": (_donation(0, {_BUF_A}),)})
+    assert not [f for f in checkers.run_checkers(g) if f.code == "MPX139"]
+    # donation AFTER the wait: the span released the buffer
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=1, buffers=(_BUF_A,)),
+        E(1, "allreduce_wait", comm_uid=1, span=1),
+        E(2, "allreduce", comm_uid=1),
+    ], meta={"donations": (_donation(2, {_BUF_A}),)})
+    assert not [f for f in checkers.run_checkers(g) if f.code == "MPX139"]
+    # donation of a buffer the span does not hold
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=1, buffers=(_BUF_A,)),
+        E(1, "allreduce_wait", comm_uid=1, span=1),
+    ], meta={"donations": (_donation(1, {_BUF_B}),)})
+    assert not [f for f in checkers.run_checkers(g) if f.code == "MPX139"]
+
+
+def test_mpx139_fused_member_buffers():
+    # a fusion flush records the MEMBER buffer ids on the packed event,
+    # so donating a bucket member mid-span is still seen
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=7, fused_members=2,
+          buffers=(_BUF_A, _BUF_B)),
+        E(1, "allreduce_wait", comm_uid=1, span=7),
+    ], meta={"donations": (_donation(1, {_BUF_B}),)})
+    assert [f.code for f in checkers.run_checkers(g)
+            if f.code == "MPX139"] == ["MPX139"]
+
+
+# ---------------------------------------------------------------------------
+# MPX140 — value consumed after the pinned call that donated it
+# ---------------------------------------------------------------------------
+
+
+def test_mpx140_fires():
+    g = G(events=[
+        E(0, "allreduce", comm_uid=1, buffers=(_BUF_A,)),
+    ], meta={"donations": (_donation(0, {_BUF_A}),)})
+    (f,) = [f for f in checkers.run_checkers(g) if f.code == "MPX140"]
+    assert "already donated" in f.message
+    assert "donate_argnums" in f.suggestion
+
+
+def test_mpx140_negative_consume_before_donation():
+    g = G(events=[
+        E(0, "allreduce", comm_uid=1, buffers=(_BUF_A,)),
+    ], meta={"donations": (_donation(1, {_BUF_A}),)})
+    assert not [f for f in checkers.run_checkers(g) if f.code == "MPX140"]
+
+
+def test_no_donations_no_hazard_findings():
+    # without donation records neither checker walks anything — the
+    # byte-identity contract keeps "donations" out of meta entirely
+    g = G(events=[
+        E(0, "allreduce_start", comm_uid=1, span=1, buffers=(_BUF_A,)),
+        E(1, "allreduce_wait", comm_uid=1, span=1),
+    ])
+    assert "donations" not in g.meta
+    assert not [f for f in checkers.run_checkers(g)
+                if f.code in report.HAZARD_GRAPH_CODES]
+
+
+def test_hazard_findings_wrapper_arms_from_graph():
+    eqns, _ = _downcast_chain()
+    closed = _Closed(_Jaxpr(eqns))
+    armed = G(events=[], meta={"compress": "bf16"})
+    assert [f.code for f in hazards.hazard_findings(closed, armed)] \
+        == ["MPX142"]
+    assert hazards.hazard_findings(closed, G(events=[])) == []
+
+
+# ---------------------------------------------------------------------------
+# cross-rank dedup: the would-diverge rank pair
+# ---------------------------------------------------------------------------
+
+
+def _divergent_closed():
+    r, p, x = _Var(), _Var(), _Var()
+    return _Closed(_Jaxpr([
+        _Eqn("axis_index", [], [r]),
+        _Eqn("gt", [r, _Lit()], [p]),
+        _gate(p, x),
+    ]))
+
+
+def test_per_rank_mpx141_names_rank_pair():
+    closed = {0: _Closed(_Jaxpr([])), 1: _divergent_closed(),
+              3: _divergent_closed()}
+    fs = crossrank.per_rank_hazard_findings(closed, {})
+    (f,) = fs
+    assert f.code == "MPX141"
+    assert f.message.endswith("(ranks 1 and 3 would diverge here)")
+
+
+def test_per_rank_mpx141_single_rank_cites_successor():
+    closed = {2: _divergent_closed()}
+    (f,) = crossrank.per_rank_hazard_findings(closed, {})
+    assert f.message.endswith("(ranks 2 and 3 would diverge here)")
+
+
+# ---------------------------------------------------------------------------
+# report surface
+# ---------------------------------------------------------------------------
+
+
+def test_report_hazards_partition_and_json():
+    g = G(events=[
+        E(0, "allreduce", comm_uid=1, buffers=(_BUF_A,)),
+    ], meta={"donations": (_donation(0, {_BUF_A}),)})
+    taint = dataflow.hazard_jaxpr_findings(_Closed(_Jaxpr([
+        _Eqn("axis_index", [], [_v1 := _Var()]),
+        _Eqn("gt", [_v1, _Lit()], [_v2 := _Var()]),
+        _gate(_v2, _Var()),
+    ])))
+    findings = tuple(checkers.run_checkers(g)) + tuple(taint)
+    rep = report.Report(findings=findings, events=tuple(g.events))
+    assert {f.code for f in rep.hazards} >= {"MPX140", "MPX141"}
+    payload = rep.to_json()
+    by_code = {f["code"]: f for f in payload["findings"]}
+    assert "frontier" in by_code["MPX141"]
+    assert "frontier" not in by_code["MPX140"]

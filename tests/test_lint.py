@@ -239,6 +239,30 @@ def test_mpx_codes_sync():
     )
 
 
+def test_every_error_code_has_a_seeded_positive():
+    """Coverage lint: every ERROR-severity code in the catalog must be
+    demonstrably fireable — a seeded fixture under ``examples/broken/``
+    (the CI analyze lane asserts analyzing it FAILS with that code) or a
+    positive in the test suites (a hand-built graph/schedule or a traced
+    program asserting the code fires).  A code that nothing can
+    demonstrate is either dead or untested — both fail here."""
+    rep = _load_analysis_report()
+    error_codes = {c for c, info in rep.CODES.items()
+                   if info.severity == rep.ERROR}
+    fixtures = "\n".join(
+        p.read_text()
+        for p in sorted((REPO / "examples" / "broken").glob("*.py")))
+    suites = "\n".join(
+        p.read_text() for p in sorted((REPO / "tests").glob("test_*.py"))
+        if p.name != "test_lint.py")  # this file proves nothing
+    uncovered = sorted(c for c in error_codes
+                       if c not in fixtures and c not in suites)
+    assert not uncovered, (
+        "ERROR-severity MPX codes with neither a seeded examples/broken/ "
+        "fixture nor an in-suite positive: " + ", ".join(uncovered)
+    )
+
+
 def test_docs_list_every_registered_flag():
     """Docs-sync: each declared flag must appear in the docs flag tables
     (docs/usage.md, docs/resilience.md, docs/observability.md,
