@@ -1,0 +1,181 @@
+"""Deterministic health-plane replay -> BENCH_health.json.
+
+The wall-clock half of the health-overhead story lives in
+``benchmarks/micro.py --health-overhead`` (measured dispatch cost per
+telemetry configuration; the counters+ring column must stay within 10%
+of counters-only).  Wall clocks do not replay deterministically, so the
+RATCHET rides this script instead: it drives a fixed synthetic workload
+through the REAL telemetry stack (journal begin/end brackets, incident
+instants, the flight-recorder ring) under each configuration and
+records the **record volume** each one produces — journal records,
+ring pushes, ring overwrites, meter bumps, per-dispatch record cost.
+
+That is the invariant behind the "cheap enough for counters mode"
+claim: the ring adds ZERO journal records and exactly the spilled
+begin/end/instant pushes, with no new io_callbacks.  A change that
+starts emitting extra records per dispatch (the overhead class the 10%
+bound guards against) shifts these counts and trips
+``benchmarks/regress.py --suffix _records`` against the committed
+``BENCH_health.json`` in the CI microbench smoke lane — and the replay
+is byte-diffed, so ANY drift in the volume model must recapture the
+artifact (.github/workflows/test.yml).
+
+Run:  python benchmarks/health_replay.py [--out BENCH_health.json]
+
+Loads the library under an isolated package name (the tests' loader
+pattern), so it runs under any installed JAX — or none.
+"""
+
+import argparse
+import importlib
+import json
+import pathlib
+import sys
+import types
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_health_replay"
+
+
+def _load():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "telemetry"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "telemetry.hist", "telemetry.health",
+                "telemetry.core", "telemetry.journal", "telemetry.merge"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+# the fixed workload every configuration replays: RANKS local ranks,
+# STEPS iterations of OPS_PER_STEP bracketed collectives each, one
+# incident every INCIDENT_EVERY completed brackets — sized so the
+# events-tier journal stays under its cap while the small test ring
+# (RING_CAP) overwrites, exercising both bounded-buffer paths
+RANKS = 2
+STEPS = 40
+OPS_PER_STEP = 4
+INCIDENT_EVERY = 16
+RING_CAP = 64
+
+CONFIGS = (
+    ("counters", "counters", "off"),
+    ("counters_ring", "counters", "on"),
+    ("events", "events", "off"),
+    ("events_ring", "events", "on"),
+)
+
+SCHEMA = "mpx-health-replay/1"
+
+
+class _Arr:
+    """Shape of what ``core.open_op`` reads off a dispatch operand."""
+
+    class _DT:
+        itemsize = 4
+
+        def __str__(self):
+            return "float32"
+
+    def __init__(self, size):
+        self.size = size
+        self.dtype = self._DT()
+
+
+class _Comm:
+    uid = 0
+    axes = ("x",)
+
+
+def replay(core, journal, health, config, mode, hmode):
+    import os
+
+    os.environ["MPI4JAX_TPU_HEALTH"] = hmode
+    os.environ["MPI4JAX_TPU_FLIGHT_RING"] = str(RING_CAP)
+    os.environ.pop("MPI4JAX_TPU_TELEMETRY_DIR", None)
+    core.set_telemetry_mode(mode)
+    core.reset()
+    comm, arrays = _Comm(), [_Arr(1024)]
+    events = core.events_on()
+    completed = 0
+    for step in range(STEPS):
+        for op in range(OPS_PER_STEP):
+            call_id = f"c{op}"
+            # counters-tier feed: a committed dispatch record per rank
+            for rank in range(RANKS):
+                rec = core.open_op("allreduce", comm, arrays)
+                core.annotate(algo="native")
+                if events:
+                    journal.begin(call_id, rank,
+                                  {"op": "allreduce", "comm_uid": 0,
+                                   "bytes": 4096, "dtype": "float32"})
+                core.close_op(rec)
+            if events:
+                for rank in range(RANKS):
+                    journal.end(call_id, rank, {"algo": "native"})
+                    completed += 1
+                    if completed % INCIDENT_EVERY == 0:
+                        journal.instant("drill", rank,
+                                        {"detail": "replay"})
+    dispatches = STEPS * OPS_PER_STEP * RANKS
+    snap = core.snapshot(include_events=False)
+    ring = health.flight_snapshot()
+    row = {
+        "mode": mode,
+        "health": hmode,
+        "dispatch_records": dispatches,
+        "journal_records": len(journal.snapshot_events()),
+        "journal_dropped_records": journal.dropped_records(),
+        "ring_capacity_records": ring["capacity"],
+        "ring_pushed_records": ring["total"],
+        "ring_dropped_records": ring["dropped"],
+        "meter_bump_records": sum(snap.get("meters", {}).values()),
+    }
+    # the per-dispatch cost model the ratchet actually guards: how many
+    # bounded-buffer writes one collective execution costs in this
+    # configuration (x1000 to survive rounding as an integer)
+    row["ring_pushes_per_dispatch_x1000_records"] = (
+        ring["total"] * 1000 // dispatches)
+    core.set_telemetry_mode(None)
+    core.reset()
+    return row
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=str(REPO / "BENCH_health.json"))
+    args = p.parse_args(argv)
+    iso = _load()
+    core = sys.modules[f"{_ISO_NAME}.telemetry.core"]
+    journal = sys.modules[f"{_ISO_NAME}.telemetry.journal"]
+    health = sys.modules[f"{_ISO_NAME}.telemetry.health"]
+    rows = [replay(core, journal, health, label, mode, hmode)
+            for label, mode, hmode in CONFIGS]
+    payload = {
+        "schema": SCHEMA,
+        "workload": {
+            "ranks": RANKS, "steps": STEPS,
+            "ops_per_step": OPS_PER_STEP,
+            "incident_every": INCIDENT_EVERY, "ring_capacity": RING_CAP,
+        },
+        "configs": rows,
+        "reproduce": "python benchmarks/health_replay.py",
+    }
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

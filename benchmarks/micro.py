@@ -644,6 +644,66 @@ def bench_dispatch_unroll(comm, unrolls=(1, 8, 64), size_kb=0.004,
     }
 
 
+def bench_health_overhead(comm, sizes_kb=(0.004, 4, 64), iters=200):
+    """The health-plane overhead sweep (``--health-overhead``): per-call
+    dispatch cost of the SAME eager one-allreduce program under four
+    telemetry configurations — off, counters, counters + the armed
+    flight-recorder ring (``MPI4JAX_TPU_HEALTH=on``), and full events —
+    across payload sizes (docs/observability.md "Runtime health").
+
+    The acceptance bar the sweep documents: ``counters_ring_us`` within
+    10% of ``counters_us`` (``ring_overhead_ratio <= 1.10``) — the ring
+    spill is one dict build + one list store riding the counter commit
+    the counters tier already pays, with no new io_callbacks.  At the
+    smallest payload the device op is noise and the columns are pure
+    host dispatch, the worst case for relative overhead."""
+    n = comm.Get_size()
+    modes = (("off", "off", "off"),
+             ("counters", "counters", "off"),
+             ("counters_ring", "counters", "on"),
+             ("events", "events", "on"))
+    rows = []
+    saved = {k: os.environ.get(k) for k in
+             ("MPI4JAX_TPU_HEALTH", "MPI4JAX_TPU_FLIGHT_RING")}
+    try:
+        for kb in sizes_kb:
+            nelem = max(1, int(kb * 1e3 / 4))
+            x = jnp.ones((n, nelem), jnp.float32)
+            row = {"size_kb": round(nelem * 4 / 1e3, 3)}
+
+            def eager_call(v):
+                return mpx.allreduce(v, op=mpx.SUM)[0]
+
+            for label, tmode, hmode in modes:
+                os.environ["MPI4JAX_TPU_HEALTH"] = hmode
+                mpx.telemetry.reset()
+                mpx.set_telemetry_mode(tmode)
+                eager_call(x)
+                jax.block_until_ready(eager_call(x))  # compile + drain
+                best = float("inf")
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out = eager_call(x)
+                    jax.block_until_ready(out)
+                    best = min(best, (time.perf_counter() - t0) / iters)
+                row[f"{label}_us"] = round(best * 1e6, 3)
+            row["ring_overhead_ratio"] = (
+                round(row["counters_ring_us"] / row["counters_us"], 3)
+                if row["counters_us"] else None
+            )
+            rows.append(row)
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rows
+
+
 # saved-sweep schema version: bumped when the --save payload shape
 # changes, so the autotune fitter (mpi4jax_tpu/autotune/) can reject
 # captures it does not understand instead of misreading them
@@ -886,6 +946,18 @@ def main():
                         "unroll axis (mpx.compile(fn, ..., unroll=N): "
                         "per-step host cost amortizes ~1/N; "
                         "docs/aot.md 'Megastep execution')")
+    p.add_argument("--health-overhead", action="store_true",
+                   help="also run the health-plane overhead sweep "
+                        "(per-call dispatch cost under off / counters / "
+                        "counters+flight-ring / events across payloads; "
+                        "the counters+ring column must stay within 10% "
+                        "of counters-only — docs/observability.md "
+                        "'Runtime health')")
+    p.add_argument("--health-sizes-kb", type=float, nargs="+",
+                   default=[0.004, 4, 64],
+                   help="payload sizes for --health-overhead (KiB)")
+    p.add_argument("--health-iters", type=int, default=200,
+                   help="calls per timed loop for --health-overhead")
     p.add_argument("--cost-calibrate", action="store_true",
                    help="fit the static cost model's alpha/beta per "
                         "link class (least squares over the sendrecv "
@@ -963,6 +1035,10 @@ def main():
                    tuple(args.dispatch_unrolls),
                    min(args.dispatch_sizes_kb), args.dispatch_iters)
           if args.dispatch_sweep else None)
+    # NOT under _section: the sweep manages its own telemetry modes
+    ho = (bench_health_overhead(comm, tuple(args.health_sizes_kb),
+                                args.health_iters)
+          if args.health_overhead else None)
 
     payload = {
         "schema": MICRO_SCHEMA,
@@ -1010,6 +1086,8 @@ def main():
         }
     if du is not None:
         payload["dispatch_unroll"] = du
+    if ho is not None:
+        payload["health_overhead"] = ho
     if args.cost_calibrate:
         cm = build_cost_model(devices[0].platform, n, pp, al)
         payload["cost_model"] = cm
@@ -1094,6 +1172,16 @@ def main():
             print(f"  {r['size_kb']:>10.3f} KB   {r['eager_us']:>8.2f} us"
                   f"   {r['spmd_us']:>8.2f} us   {r['pinned_us']:>8.2f} us"
                   f"   {sp}")
+    if ho is not None:
+        print("\nhealth overhead (eager SUM)   off          counters"
+              "     +ring        events       ring/counters")
+        for r in ho:
+            ratio = (f"{r['ring_overhead_ratio']:>6.3f}x"
+                     if r["ring_overhead_ratio"] is not None else "-")
+            print(f"  {r['size_kb']:>10.3f} KB   {r['off_us']:>8.2f} us"
+                  f"   {r['counters_us']:>8.2f} us"
+                  f"   {r['counters_ring_us']:>8.2f} us"
+                  f"   {r['events_us']:>8.2f} us   {ratio}")
     if du is not None:
         print(f"\nmegastep unroll sweep ({du['size_kb']} KB; on-chip "
               f"~{du['onchip_per_step_us']} us/step)"

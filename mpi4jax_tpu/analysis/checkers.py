@@ -616,6 +616,54 @@ def check_unbucketed_batch(graph: CollectiveGraph) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# flight-ring capacity advisory (MPX143)
+# ---------------------------------------------------------------------------
+
+
+@checker("MPX143")
+def check_flight_ring_capacity(graph: CollectiveGraph) -> List[Finding]:
+    """A megastep loop body dispatching more collectives per iteration
+    than the health plane's flight-recorder ring can hold
+    (telemetry/health.py): in the events tier every execution spills a
+    begin AND an end record, so a ring of ``MPI4JAX_TPU_FLIGHT_RING``
+    records holds at most ``capacity // 2`` collectives — fewer than one
+    iteration means a postmortem bundle's ring has already overwritten
+    the iteration's own history by the time a hang is detected.  Gated
+    on ``graph.meta["flight_ring"]`` (recorded by
+    ``hook.config_snapshot`` only when ``MPI4JAX_TPU_HEALTH=on``), so
+    health-off traces — and hand-built graphs testing other rules — are
+    never flagged.  Fires at most once per loop id."""
+    capacity = graph.meta.get("flight_ring")
+    if not capacity:
+        return []
+    implied = int(capacity) // 2  # begin + end records per collective
+    loops: dict = {}
+    for e in graph.events:
+        if e.loop is not None:
+            loops.setdefault(e.loop, []).append(e)
+    findings: List[Finding] = []
+    for loop_id, events in sorted(loops.items()):
+        if len(events) <= implied:
+            continue
+        first = events[0]
+        findings.append(Finding(
+            code="MPX143", op=first.op, index=first.index,
+            message=(f"megastep loop {loop_id} dispatches {len(events)} "
+                     f"collectives per iteration, but "
+                     f"MPI4JAX_TPU_FLIGHT_RING={int(capacity)} holds "
+                     f"only ~{implied} (a begin + an end record each): "
+                     "the flight recorder overwrites the current "
+                     "iteration's own history, so a postmortem cannot "
+                     "show where the ranks diverged"),
+            suggestion=(f"raise MPI4JAX_TPU_FLIGHT_RING to at least "
+                        f"{2 * len(events)} (2 records per collective "
+                        "per iteration, plus headroom for incidents) — "
+                        "docs/observability.md 'Runtime health'"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # topology advisory (MPX113)
 # ---------------------------------------------------------------------------
 

@@ -149,6 +149,17 @@ def config_snapshot() -> dict:
     }
     if serving_buckets is not None:
         snap["serving_buckets"] = serving_buckets
+    # the flight-recorder capacity (telemetry/health.py), the MPX143
+    # gate: key present only when the health plane is armed, so every
+    # health-off snapshot stays byte-identical (the serving_buckets
+    # pattern above; guarded the same way)
+    try:
+        from ..telemetry.health import armed as _health_armed
+
+        if _health_armed():
+            snap["flight_ring"] = config.flight_ring_capacity()
+    except ImportError:
+        pass
     # measured crossovers from the cost-model tuning file (empty when
     # MPI4JAX_TPU_COST_MODEL is unset, keeping the snapshot — and with
     # it the MPX111/MPX113 advisory texts — byte-identical to a build

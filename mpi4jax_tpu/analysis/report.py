@@ -385,6 +385,22 @@ CODES = {
             "decision from exact values, or carry the error through "
             "error feedback (docs/compression.md).",
         ),
+        # --- health-plane codes (telemetry/health.py):
+        CodeInfo(
+            "MPX143", "flight ring smaller than one iteration's "
+            "collectives", ADVISORY,
+            "The health plane's flight recorder (MPI4JAX_TPU_HEALTH=on) "
+            "keeps the most recent MPI4JAX_TPU_FLIGHT_RING records, but "
+            "one iteration of this program's loop dispatches more "
+            "collectives than the ring holds: by the time a hang is "
+            "detected, the ring has already overwritten the iteration's "
+            "own history, so a postmortem bundle cannot show where the "
+            "ranks diverged.  Raise MPI4JAX_TPU_FLIGHT_RING above the "
+            "per-iteration collective count (with headroom for begin + "
+            "end records per op) or the bundles will only answer 'what "
+            "ran last', not 'who was stuck where' "
+            "(docs/observability.md).",
+        ),
     )
 }
 

@@ -1644,6 +1644,52 @@ def _incident(meter: str, name: str, rank: int, detail: str) -> None:
     journal.incident(meter, name, rank, detail)
 
 
+def _health_boundary(store, step: int, committed: bool) -> None:
+    """Health-detector tick at a run-loop step boundary
+    (telemetry/health.py): local slowdown check + the cross-rank digest
+    exchange over the store's mesh-bound comm.  A RankFailure raised by
+    the suspect handoff (MPI4JAX_TPU_HEALTH_SUSPECTS) must PROPAGATE —
+    it is how a persistent straggler enters the classify -> agree ->
+    shrink path; anything else from the observer is swallowed."""
+    try:
+        from ..telemetry import health as _health
+    except ImportError:
+        return
+    try:
+        _health.on_boundary(step, comm=store.comm, committed=committed)
+    except RankFailure:
+        raise
+    except Exception:
+        _meter("health.boundary_errors")
+
+
+def _health_failure(rf) -> None:
+    """Postmortem bundle the moment an exception classifies as a rank
+    failure, before recovery mutates any state (telemetry/health.py)."""
+    try:
+        from ..telemetry import health as _health
+    except ImportError:
+        return
+    try:
+        _health.on_failure_classified(rf)
+    except Exception:
+        pass
+
+
+def _health_rank_failed(failed, rf) -> None:
+    """Symmetric post-agreement verdict: every survivor journals one
+    ``health`` incident naming each agreed-failed rank
+    (telemetry/health.py)."""
+    try:
+        from ..telemetry import health as _health
+    except ImportError:
+        return
+    try:
+        _health.on_rank_failed(failed, getattr(rf, "detail", "") or "")
+    except Exception:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # ShardStore
 # ---------------------------------------------------------------------------
@@ -2573,6 +2619,10 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
                         stride)
                     auto_commit = None  # locked in for the rest of the run
                     _meter("elastic.auto_commits")
+                # health-detector tick BEFORE the boundary actions: a
+                # suspect RankFailure raised here lands in the except
+                # below and recovers like any peer death
+                _health_boundary(store, step, committed)
                 outcome = _boundary_actions(
                     store, step, steps, state, committed,
                     start_step, commit_every, servers)
@@ -2594,6 +2644,7 @@ def run(step_fn, state, store: ShardStore, *, steps: int,
                 rf = classify_failure(exc)
                 if rf is None:
                     raise
+                _health_failure(rf)
                 step, state = _recover(rf, store)
                 _restart_elastic_servers(servers, store)
         return state
@@ -3031,6 +3082,10 @@ def _recover(rf: RankFailure, store: ShardStore):
             "the majority threshold (split-brain guard): aborting instead "
             "of training a divergent minority partition",
         ) from rf
+    # the failed set is now AGREED: every survivor reaches this line
+    # with the identical verdict, so every survivor's journal gets the
+    # health incident naming each failed rank (telemetry/health.py)
+    _health_rank_failed(failed, rf)
     unit = config.elastic_fail_unit()
     mesh = getattr(comm, "mesh", None)
     mesh_shape = (tuple(mesh.shape.values()) if mesh is not None

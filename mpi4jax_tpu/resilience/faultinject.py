@@ -361,11 +361,32 @@ def probe_host(indexed_clauses, mpi_name: str, rank) -> int:
         elif clause.verb == "die":
             _fault_line(r, f"die injected in {mpi_name} "
                            f"({clause.canonical()})")
+            # the last chance to write a postmortem bundle: os._exit
+            # skips every atexit/finally.  Guarded + armed-gated inside;
+            # a fault probe must never die on observability plumbing.
+            try:
+                from ..telemetry import health as _health
+
+                _health.maybe_postmortem(
+                    f"fatal_fault: die injected in {mpi_name} on rank {r}")
+            except Exception:
+                pass
             sys.stderr.flush()
             os._exit(13)
         elif clause.verb == "hang":
             _fault_line(r, f"hang injected in {mpi_name} "
                            f"({clause.canonical()}) — sleeping forever")
+            # bundle now, not later: the hung rank may be blocking
+            # BEFORE its watchdog arm, so this is its one guaranteed
+            # postmortem — with the fault incident in the ring tail,
+            # which is what the postmortem CLI attributes the hang from
+            try:
+                from ..telemetry import health as _health
+
+                _health.maybe_postmortem(
+                    f"fault: hang injected in {mpi_name} on rank {r}")
+            except Exception:
+                pass
             sys.stderr.flush()
             _hang_forever()
         elif clause.verb == "preempt":

@@ -220,6 +220,19 @@ class _Registry:
                     f"{expired['opname']} call {expired['call_id']} "
                     f"exceeded {expired['timeout']:g}s",
                 )
+                # health-plane stall hook (telemetry/health.py): journal
+                # the stall incident and write the postmortem bundle
+                # while the in-flight registry + flight ring still show
+                # the stuck op — also before the handler can abort
+                try:
+                    from ..telemetry import health as _health
+                except ImportError:
+                    pass
+                else:
+                    try:
+                        _health.on_watchdog_expiry(expired)
+                    except Exception:
+                        pass
                 self.on_timeout(self.snapshot(), expired)
                 # only reachable with a non-fatal handler (the default
                 # aborts the process): drop the EXPIRED entries — healthy

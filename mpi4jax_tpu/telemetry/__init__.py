@@ -23,8 +23,16 @@ into one Perfetto/``chrome://tracing`` timeline::
 
     python -m mpi4jax_tpu.telemetry merge $MPI4JAX_TPU_TELEMETRY_DIR \\
         --perfetto trace.json
+
+``MPI4JAX_TPU_HEALTH=on`` additionally arms the live health plane
+(telemetry/health.py): a bounded flight-recorder ring
+(:func:`flight_snapshot`), an online straggler/degradation detector at
+megastep/commit boundaries, crash postmortem bundles
+(:func:`dump_postmortem`, merged by ``python -m mpi4jax_tpu.telemetry
+postmortem <dir>``), and :func:`prometheus_text` exposition.
 """
 
+from . import health  # noqa: F401
 from .core import (  # noqa: F401
     effective_mode,
     meter,
@@ -32,6 +40,11 @@ from .core import (  # noqa: F401
     set_telemetry_mode,
     snapshot,
     telemetry_cache_token,
+)
+from .health import (  # noqa: F401
+    dump_postmortem,
+    flight_snapshot,
+    prometheus_text,
 )
 from .hist import Histogram  # noqa: F401
 from .merge import chrome_trace, merge_dir, skew_table  # noqa: F401
@@ -51,4 +64,8 @@ __all__ = [
     "merge_dir",
     "chrome_trace",
     "skew_table",
+    "health",
+    "flight_snapshot",
+    "dump_postmortem",
+    "prometheus_text",
 ]

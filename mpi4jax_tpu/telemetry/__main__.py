@@ -5,6 +5,12 @@ event timeline (rank = pid, op rows = tids — open in Perfetto or
 ``chrome://tracing``) and prints the straggler attribution table.
 Exits non-zero on malformed journal lines (the CI telemetry lane's
 validation contract).  See mpi4jax_tpu/telemetry/merge.py.
+
+``python -m mpi4jax_tpu.telemetry postmortem <dir>`` instead reads the
+per-rank crash bundles the health plane wrote (``postmortem-p*.json``,
+docs/observability.md "Runtime health"), aligns the flight-recorder
+rings by call id, and prints each rank's last-known frontier with
+straggler attribution.
 """
 
 import sys

@@ -37,6 +37,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ..utils import config
+from . import health
 from .hist import Histogram
 
 __all__ = [
@@ -170,8 +171,10 @@ def meter(name: str, n: int = 1) -> None:
 
 def record_latency(key: str, seconds: float) -> None:
     """Feed one measured op latency into the per-op histogram (called by
-    the journal when an events-tier end bracket completes)."""
+    the journal when an events-tier end bracket completes) — and into
+    the health detector's rolling window (telemetry/health.py)."""
     _counters.record_latency(key, seconds)
+    health.feed_latency(key, seconds)
 
 
 def count_host_op(key: str, nbytes: int) -> None:
@@ -284,6 +287,7 @@ def open_op(opname: str, comm, arrays) -> Optional[OpRecord]:
     is off — the zero-cost default)."""
     if effective_mode() == "off":
         return None
+    health.ensure_boundary_hook()
     a0 = arrays[0] if arrays else None
     nbytes = 0
     dtype = ""
@@ -334,6 +338,7 @@ def close_op(rec: Optional[OpRecord]) -> None:
         _counters.count_op(rec.key(), rec.bytes,
                            rec.intra_bytes, rec.inter_bytes,
                            rec.wire_inter_bytes)
+        health.record_dispatch(rec)
 
 
 def abort_op(rec: Optional[OpRecord]) -> None:
@@ -352,6 +357,7 @@ def count_eager_call(cell: EagerCell, sig: tuple) -> None:
         _counters.count_op(rec.key(), rec.bytes,
                            rec.intra_bytes, rec.inter_bytes,
                            rec.wire_inter_bytes)
+        health.record_dispatch(rec)
 
 
 def current_open() -> Optional[OpRecord]:
@@ -443,6 +449,13 @@ def snapshot(include_events: bool = False) -> dict:
     tuning = _config.tuning_snapshot()
     if tuning:
         snap["tuning"] = tuning
+    # dropped-record accounting (journal overflow + flight-ring
+    # overwrites): present only when something was actually dropped, so
+    # a healthy snapshot stays byte-identical to the pre-health shape
+    dropped = {"journal": journal.dropped_records(),
+               "flight_ring": health.ring_dropped()}
+    if any(dropped.values()):
+        snap["dropped"] = dropped
     if include_events:
         snap["events"] = journal.snapshot_events()
     return snap
@@ -456,3 +469,4 @@ def reset() -> None:
     _counters.reset()
     del _open_ops[:]
     journal.reset()
+    health.reset()

@@ -34,6 +34,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from . import health
+
 __all__ = ["begin", "end", "instant", "snapshot_events", "reset",
            "process_index", "JOURNAL_FILE_PREFIX"]
 
@@ -119,6 +121,15 @@ class _Journal:
         if len(self.records) > MAX_RECORDS:
             del self.records[0]
             self.dropped += 1
+            # surfaced, not silent: the meter rides the snapshot and
+            # report(), and merge/postmortem warn from the counts
+            from . import core
+
+            core.meter("telemetry.dropped")
+        # flight-recorder spill (telemetry/health.py): completed op
+        # records and instants land in the bounded ring — no extra
+        # callbacks, just the record the journal already built
+        health.record_event(record)
         f = self._writer()
         if f is not None:
             f.write(json.dumps(record, sort_keys=True) + "\n")
@@ -129,6 +140,9 @@ class _Journal:
             self.pending.setdefault((call_id, rank), deque()).append(
                 (mono, wall, meta)
             )
+        # arrivals reach the ring immediately: the begin a rank never
+        # pairs with an end is the hung collective a postmortem needs
+        health.record_begin(call_id, rank, meta, mono, wall)
 
     def end(self, call_id: str, rank: int, end_meta: dict) -> None:
         mono, wall = _clocks()

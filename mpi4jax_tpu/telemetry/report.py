@@ -173,6 +173,18 @@ def render(snaps: List[dict]) -> str:
         lines.append("meters:")
         for name in sorted(total_meters):
             lines.append(f"  {name:<40} {total_meters[name]:>10}")
+    # dropped-record accounting: bounded buffers (journal overflow,
+    # flight-ring overwrites) degrade by dropping — which must be SAID,
+    # or the tables above silently claim completeness they don't have
+    total_dropped = {}
+    for snap in snaps:
+        for src, n in snap.get("dropped", {}).items():
+            total_dropped[src] = total_dropped.get(src, 0) + n
+    if any(total_dropped.values()):
+        lines.append("")
+        lines.append("dropped: " + ", ".join(
+            f"{n} {src} record(s)"
+            for src, n in sorted(total_dropped.items()) if n))
     # compile-cache section (docs/aot.md): AOT pins/calls/stale refusals
     # summed across processes, disk-cache traffic per process — the one-
     # glance answer to "did the second cold start actually deserialize?"

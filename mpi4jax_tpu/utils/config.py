@@ -473,6 +473,41 @@ FLAGS = {
              "plain Python ``Compiled`` call path (debugging, or a "
              "jaxlib whose fast path misbehaves).  Never shapes a "
              "trace: flipping it does not stale live pins."),
+        Flag("MPI4JAX_TPU_HEALTH", "choice", "off",
+             "Runtime health plane (mpi4jax_tpu/telemetry/health.py, "
+             "docs/observability.md 'Runtime health'): ``on`` arms the "
+             "flight-recorder ring, the online degradation detector at "
+             "megastep/commit boundaries, and postmortem bundles under "
+             "MPI4JAX_TPU_TELEMETRY_DIR.  ``off`` (default) keeps HLO "
+             "and both program-cache tokens byte-identical to a build "
+             "without the health plane — the layer is host-side only.",
+             choices=("off", "on")),
+        Flag("MPI4JAX_TPU_HEALTH_INTERVAL", "int", 1,
+             "Boundary stride of the health detector's cross-rank digest "
+             "exchange: every N-th megastep/commit boundary runs one "
+             "tiny allgather of per-(op, comm) latency-digest summaries "
+             "and the slowdown/skew checks.  Default 1 (every "
+             "boundary)."),
+        Flag("MPI4JAX_TPU_FLIGHT_RING", "int", 1024,
+             "Capacity (records) of the flight-recorder ring: the most "
+             "recent op begin/end/incident records kept in memory for "
+             "``mpx.telemetry.flight_snapshot()`` and postmortem "
+             "bundles.  Older records are overwritten; the ring's "
+             "dropped count says how many.  Default 1024."),
+        Flag("MPI4JAX_TPU_HEALTH_SUSPECTS", "bool", False,
+             "Opt-in straggler handoff: let the health detector post "
+             "persistent stragglers (and stalled in-flight collectives) "
+             "as suspects into the elastic agreement machinery "
+             "(resilience/elastic.py), so the elastic plane can act on "
+             "slow-but-alive ranks.  Default off — detection only "
+             "journals incidents and bumps meters."),
+        Flag("MPI4JAX_TPU_HEALTH_PROM", "bool", False,
+             "Write the Prometheus exposition rendering "
+             "(``mpx.telemetry.prometheus_text()``) to "
+             "``prom-p<process>.prom`` under MPI4JAX_TPU_TELEMETRY_DIR "
+             "at every detector boundary, for file-based fleet "
+             "scrapers.  Default off — the text surface is still "
+             "available on demand."),
     )
 }
 
@@ -1061,6 +1096,41 @@ def telemetry_dir() -> str:
     """Directory for the events-tier JSONL journals
     (``MPI4JAX_TPU_TELEMETRY_DIR``; '' = in-memory journal only)."""
     return (_getenv("MPI4JAX_TPU_TELEMETRY_DIR") or "").strip()
+
+
+def health_mode() -> str:
+    """Runtime health plane tier (``MPI4JAX_TPU_HEALTH``): ``off``
+    (default) / ``on`` — see mpi4jax_tpu/telemetry/health.py and
+    docs/observability.md 'Runtime health'."""
+    return _parse_env_choice("MPI4JAX_TPU_HEALTH")
+
+
+def health_interval() -> int:
+    """Boundary stride of the health detector's digest exchange
+    (``MPI4JAX_TPU_HEALTH_INTERVAL``; default 1 = every boundary)."""
+    return _parse_env_positive_int("MPI4JAX_TPU_HEALTH_INTERVAL", 1,
+                                   minimum=1)
+
+
+def flight_ring_capacity() -> int:
+    """Flight-recorder ring capacity in records
+    (``MPI4JAX_TPU_FLIGHT_RING``; default 1024, minimum 1)."""
+    return _parse_env_positive_int("MPI4JAX_TPU_FLIGHT_RING", 1024,
+                                   minimum=1)
+
+
+def health_suspects_enabled() -> bool:
+    """Whether the health detector may post persistent stragglers as
+    suspects into the elastic agreement machinery
+    (``MPI4JAX_TPU_HEALTH_SUSPECTS``; default off)."""
+    return parse_env_bool("MPI4JAX_TPU_HEALTH_SUSPECTS", False)
+
+
+def health_prom_enabled() -> bool:
+    """Whether detector boundaries also write the Prometheus exposition
+    file under the telemetry dir (``MPI4JAX_TPU_HEALTH_PROM``; default
+    off)."""
+    return parse_env_bool("MPI4JAX_TPU_HEALTH_PROM", False)
 
 
 def _parse_env_positive_int(name: str, default: int, minimum: int = 0) -> int:

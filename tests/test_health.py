@@ -1,0 +1,611 @@
+"""Runtime health plane tests (docs/observability.md "Runtime health").
+
+Covers mpi4jax_tpu/telemetry/health.py and its integrations:
+
+- the flight recorder: overwrite-ring semantics, the counters-tier
+  dispatch feed, the events-tier begin/record spill from the journal,
+  ``flight_snapshot()``/drop accounting, capacity changes;
+- the degradation detector: window-vs-baseline local slowdown, the pure
+  cross-rank ``judge_exchange`` verdicts, consecutive-strike promotion
+  to *persistent*, interval gating at boundaries, and the opt-in
+  suspect handoff into the elastic agreement machinery
+  (``MPI4JAX_TPU_HEALTH_SUSPECTS``);
+- postmortem bundles: write/overwrite with reason accumulation, the
+  watchdog-expiry and rank-failure triggers, ``read_bundles`` /
+  ``postmortem_report`` / ``render_postmortem`` and the ``postmortem``
+  CLI (exit 0 with bundles and a named straggler, 2 without);
+- dropped-record surfacing: the ``telemetry.dropped`` meter, the
+  only-when-nonzero ``dropped`` snapshot key, the ``report()`` line,
+  and the merge CLI warning;
+- ``prometheus_text()`` exposition and gauges;
+- the MPX143 ring-sizing advisory (pure checker + catalog sync);
+- the off-is-free invariants: no ring, no snapshot key, unchanged
+  cache token, no ``flight_ring`` in the verifier config snapshot.
+
+Everything here is the pure half (isolated loader, no jax); the HLO
+byte-identity pin for HEALTH=on/off and the multi-process drill live in
+tests/test_telemetry.py's jax half and the CI faults lane.
+"""
+
+import importlib
+import json
+import os
+import pathlib
+import sys
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_health_iso"
+
+
+def _load_isolated():
+    """The telemetry + analysis + resilience stack under a private
+    package name (tests/test_telemetry.py's loader, widened): bypasses
+    the package __init__'s JAX floor and isolates module state."""
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "telemetry", "analysis", "resilience"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in (
+        "utils.config",
+        "telemetry.hist",
+        "telemetry.health",
+        "telemetry.core",
+        "telemetry.journal",
+        "telemetry.merge",
+        "telemetry.report",
+        "analysis.graph",
+        "analysis.report",
+        "analysis.checkers",
+        "analysis.hook",
+        "resilience.faultinject",
+        "resilience.retry",
+        "resilience.watchdog",
+        "resilience.elastic",
+    ):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+config = ISO.utils.config
+health = ISO.telemetry.health
+core = ISO.telemetry.core
+journal = ISO.telemetry.journal
+merge = ISO.telemetry.merge
+treport = ISO.telemetry.report
+graphmod = ISO.analysis.graph
+checkers = ISO.analysis.checkers
+areport = ISO.analysis.report
+hook = ISO.analysis.hook
+wd = ISO.resilience.watchdog
+elastic = ISO.resilience.elastic
+
+E = graphmod.CollectiveEvent
+G = graphmod.CollectiveGraph
+
+_ENV = ("MPI4JAX_TPU_TELEMETRY", "MPI4JAX_TPU_TELEMETRY_DIR",
+        "MPI4JAX_TPU_HEALTH", "MPI4JAX_TPU_HEALTH_INTERVAL",
+        "MPI4JAX_TPU_FLIGHT_RING", "MPI4JAX_TPU_HEALTH_SUSPECTS",
+        "MPI4JAX_TPU_HEALTH_PROM")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    core.set_telemetry_mode(None)
+    core.reset()
+    saved = {k: os.environ.pop(k, None) for k in _ENV}
+    elastic.take_pending_failure()
+    yield
+    core.set_telemetry_mode(None)
+    core.reset()
+    elastic.take_pending_failure()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _arm(ring=8, interval=1, **env):
+    os.environ["MPI4JAX_TPU_HEALTH"] = "on"
+    os.environ["MPI4JAX_TPU_FLIGHT_RING"] = str(ring)
+    os.environ["MPI4JAX_TPU_HEALTH_INTERVAL"] = str(interval)
+    for k, v in env.items():
+        os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flags_registered():
+    for name in ("MPI4JAX_TPU_HEALTH", "MPI4JAX_TPU_HEALTH_INTERVAL",
+                 "MPI4JAX_TPU_FLIGHT_RING", "MPI4JAX_TPU_HEALTH_SUSPECTS",
+                 "MPI4JAX_TPU_HEALTH_PROM"):
+        assert name in config.FLAGS, name
+    assert config.health_mode() == "off"
+    assert config.flight_ring_capacity() == 1024
+    assert config.health_interval() >= 1
+
+
+def test_ring_off_is_inert():
+    core.set_telemetry_mode("events")
+    journal.begin("c1", 0, {"op": "allreduce", "comm_uid": 0,
+                            "bytes": 8, "dtype": "float32"})
+    journal.end("c1", 0, {})
+    snap = health.flight_snapshot()
+    assert snap == {"version": 1, "capacity": 0, "total": 0,
+                    "dropped": 0, "records": []}
+    assert health.ring_dropped() == 0
+
+
+def test_ring_overwrites_and_counts_drops():
+    _arm(ring=4)
+    for i in range(10):
+        health.record_event({"type": "instant", "name": f"e{i}", "t": i})
+    snap = health.flight_snapshot()
+    assert snap["capacity"] == 4
+    assert snap["total"] == 10
+    assert snap["dropped"] == 6
+    # the window is the newest records, oldest first
+    assert [r["name"] for r in snap["records"]] == ["e6", "e7", "e8", "e9"]
+
+
+def test_ring_capacity_change_recreates():
+    _arm(ring=4)
+    health.record_event({"name": "a"})
+    os.environ["MPI4JAX_TPU_FLIGHT_RING"] = "8"
+    health.record_event({"name": "b"})
+    snap = health.flight_snapshot()
+    assert snap["capacity"] == 8
+    assert [r["name"] for r in snap["records"]] == ["b"]
+
+
+def test_events_tier_feeds_begin_and_records():
+    _arm(ring=16)
+    core.set_telemetry_mode("events")
+    journal.begin("c1", 0, {"op": "allreduce", "comm_uid": 0,
+                            "bytes": 8, "dtype": "float32"})
+    kinds = [r.get("kind") or r.get("type")
+             for r in health.flight_snapshot()["records"]]
+    assert kinds == ["begin"]          # arrival spilled before completion
+    journal.end("c1", 0, {"algo": "native"})
+    journal.instant("drill", 0, {"detail": "x"})
+    kinds = [r.get("kind") or r.get("type")
+             for r in health.flight_snapshot()["records"]]
+    assert kinds == ["begin", "op", "instant"]
+
+
+def test_counters_tier_feeds_dispatch_records():
+    class _Arr:
+        size = 2
+
+        class dtype:
+            itemsize = 4
+
+            def __str__(self):
+                return "float32"
+        dtype = dtype()
+
+    class _Comm:
+        uid = 0
+        axes = ("i",)
+
+    _arm(ring=8)
+    core.set_telemetry_mode("counters")
+    rec = core.open_op("allreduce", _Comm(), [_Arr()])
+    core.annotate(algo="native")
+    core.close_op(rec)
+    recs = health.flight_snapshot()["records"]
+    assert [r["kind"] for r in recs] == ["dispatch"]
+    assert recs[0]["op"] == "allreduce"
+    # journal stays empty in counters mode: the ring rides the counter
+    # commit, it does not create journal records
+    assert journal.snapshot_events() == []
+
+
+# ---------------------------------------------------------------------------
+# degradation detector
+# ---------------------------------------------------------------------------
+
+
+def _feed(key, seconds, n):
+    for _ in range(n):
+        health.feed_latency(key, seconds)
+
+
+def test_local_degradation_detected():
+    _arm()
+    _feed("allreduce|0|native|float32", 0.001, 5)
+    assert health._summarize_window()["findings"] == []   # builds baseline
+    _feed("allreduce|0|native|float32", 0.010, 5)         # 10x slower
+    found = health._summarize_window()["findings"]
+    assert len(found) == 1
+    f = found[0]
+    assert f["kind"] == "degraded" and f["ratio"] > health.SLOW_RATIO
+
+
+def test_local_degradation_needs_min_samples():
+    _arm()
+    _feed("k|0|n|f", 0.001, health.MIN_SAMPLES)
+    health._summarize_window()
+    _feed("k|0|n|f", 0.010, health.MIN_SAMPLES - 1)       # too few
+    assert health._summarize_window()["findings"] == []
+
+
+def _peer(proc, mean, count=5):
+    return {"process": proc,
+            "summary": {"allreduce|0|native|float32":
+                        {"count": count, "mean": mean,
+                         "p50": mean, "max": mean}}}
+
+
+def test_judge_exchange_flags_slow_rank():
+    peers = [_peer(0, 0.001), _peer(1, 0.001), _peer(2, 0.001),
+             _peer(3, 0.005)]
+    found = health.judge_exchange(peers, my_process=0)
+    assert [f["rank"] for f in found] == [3]
+    assert found[0]["kind"] == "slow_rank"
+    assert found[0]["ratio"] == pytest.approx(5.0)
+
+
+def test_judge_exchange_negative_cases():
+    # within the ratio: nobody flagged
+    assert health.judge_exchange(
+        [_peer(0, 0.001), _peer(1, 0.0015)], 0) == []
+    # below MIN_SAMPLES: not judged
+    assert health.judge_exchange(
+        [_peer(0, 0.001, count=1), _peer(1, 0.01, count=1)], 0) == []
+    # a single process has no median to skew against
+    assert health.judge_exchange([_peer(0, 0.1)], 0) == []
+
+
+def test_exchange_strikes_promote_to_persistent(monkeypatch, capsys):
+    _arm()
+    core.set_telemetry_mode("counters")    # meters count from this tier
+    peers = [_peer(0, 0.001), _peer(1, 0.001), _peer(3, 0.005)]
+    monkeypatch.setattr(health, "_gather_json", lambda comm, p: peers)
+    f1 = health._exchange(None, {})
+    assert [f["persistent"] for f in f1] == [False]        # strike 1
+    f2 = health._exchange(None, {})
+    assert [f["persistent"] for f in f2] == [True]         # strike 2
+    snap = core.snapshot()
+    assert snap["meters"]["health.exchanges"] == 2
+    assert snap["meters"]["health.slow_ranks"] == 2
+    assert snap["meters"]["health.stragglers"] == 1
+    # a clean exchange clears the strikes
+    monkeypatch.setattr(health, "_gather_json",
+                        lambda comm, p: [_peer(0, 0.001), _peer(3, 0.001)])
+    assert health._exchange(None, {}) == []
+    assert health._detector.strikes == {}
+
+
+def test_suspect_handoff_posts_and_raises(monkeypatch):
+    """End-to-end (pure): a persistent straggler becomes a pending
+    RankFailure in the elastic agreement machinery AND the boundary
+    raise — the classify -> agree -> shrink entry path."""
+    _arm(MPI4JAX_TPU_HEALTH_SUSPECTS="1")
+    core.set_telemetry_mode("counters")
+    peers = [_peer(0, 0.001), _peer(1, 0.001), _peer(3, 0.005)]
+    monkeypatch.setattr(health, "_gather_json", lambda comm, p: peers)
+    health._exchange(None, {})                             # strike 1
+    with pytest.raises(elastic.RankFailure) as ei:
+        health._exchange(None, {})                         # strike 2
+    assert ei.value.suspects == frozenset({3})
+    assert "persistent straggler" in ei.value.detail
+    posted = elastic.take_pending_failure()
+    assert posted is not None and posted.suspects == frozenset({3})
+    assert core.snapshot()["meters"]["health.suspects_posted"] == 1
+
+
+def test_suspects_off_never_raises(monkeypatch):
+    _arm()                                                 # no SUSPECTS
+    peers = [_peer(0, 0.001), _peer(1, 0.001), _peer(3, 0.005)]
+    monkeypatch.setattr(health, "_gather_json", lambda comm, p: peers)
+    health._exchange(None, {})
+    found = health._exchange(None, {})                     # persistent...
+    assert [f["persistent"] for f in found] == [True]      # ...but no raise
+    assert elastic.take_pending_failure() is None
+
+
+def test_on_boundary_interval_gating():
+    _arm(interval=3)
+    assert health.on_boundary(0) is None                   # 1: not due
+    assert health.on_boundary(1) is None                   # 2: not due
+    assert health.on_boundary(2) == []                     # 3: due
+    assert health._detector.boundaries == 3
+    # off: no ticks at all
+    os.environ["MPI4JAX_TPU_HEALTH"] = "off"
+    assert health.on_boundary(3) is None
+    assert health._detector.boundaries == 3
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundles + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_dump_postmortem_requires_dir():
+    _arm()
+    assert health.dump_postmortem("no dir") is None
+
+
+def test_dump_postmortem_accumulates_reasons(tmp_path):
+    _arm(ring=8)
+    os.environ["MPI4JAX_TPU_TELEMETRY_DIR"] = str(tmp_path)
+    core.set_telemetry_mode("events")
+    journal.begin("c1", 0, {"op": "allreduce", "comm_uid": 0,
+                            "bytes": 8, "dtype": "float32"})
+    journal.end("c1", 0, {})
+    p1 = health.dump_postmortem("first")
+    p2 = health.dump_postmortem("second")
+    assert p1 == p2
+    bundle = json.loads(pathlib.Path(p1).read_text())
+    assert bundle["schema"] == "mpx-postmortem/1"
+    assert bundle["reasons"] == ["first", "second"]
+    assert bundle["flight"]["records"]                     # ring captured
+    assert bundle["dropped"] == {"journal": 0, "flight_ring": 0}
+    assert "MPI4JAX_TPU_HEALTH" in bundle["config"]["env"]
+    assert core.snapshot()["meters"]["health.postmortems"] == 2
+
+
+def test_watchdog_expiry_triggers_incident_and_bundle(tmp_path):
+    _arm()
+    os.environ["MPI4JAX_TPU_TELEMETRY_DIR"] = str(tmp_path)
+    core.set_telemetry_mode("events")
+    health.on_watchdog_expiry({"opname": "allreduce", "call_id": "c7",
+                               "rank": 2, "elapsed": 31.0, "timeout": 30.0})
+    events = journal.snapshot_events()
+    assert [e["name"] for e in events] == ["health"]
+    assert "c7 stalled" in events[0]["detail"]
+    assert core.snapshot()["meters"]["health.stalls"] == 1
+    assert list(tmp_path.glob("postmortem-p*.json"))
+
+
+def test_on_rank_failed_names_each_rank():
+    _arm()
+    core.set_telemetry_mode("events")
+    det = health._detector
+    with det.lock:
+        det.strikes[3] = 2
+        det.strikes[1] = 1
+    health.on_rank_failed(frozenset({3, 5}), "connection reset")
+    details = [e["detail"] for e in journal.snapshot_events()]
+    assert len(details) == 2
+    assert any("rank 3 agreed failed" in d for d in details)
+    assert any("rank 5 agreed failed" in d for d in details)
+    assert core.snapshot()["meters"]["health.ranks_failed"] == 2
+    # the agreed verdict settles the question: strikes for the failed
+    # ranks are dropped so a removed rank can never re-raise a suspect
+    with det.lock:
+        assert 3 not in det.strikes
+        assert det.strikes.get(1) == 1
+
+
+def _hang_bundles(tmp_path):
+    """Two bundles imitating the CI drill: rank 0 finished c2 and began
+    c3; rank 3 journaled a fault incident and never began c3."""
+    base = 100.0
+
+    def op(rank, cid, t0, dur, seq=0):
+        return {"type": "op", "op": "allreduce", "call_id": cid,
+                "seq": seq, "rank": rank, "process": rank,
+                "t_begin": t0, "t_end": t0 + dur, "latency": dur,
+                "bytes": 64, "dtype": "float32", "algo": "native"}
+
+    def begin(rank, cid, t0):
+        return {"kind": "begin", "call_id": cid, "rank": rank,
+                "op": "allreduce", "t": t0, "mono": t0}
+
+    b0 = {"schema": "mpx-postmortem/1", "process": 0,
+          "reason": "watchdog_expired: allreduce call c3",
+          "reasons": ["watchdog_expired: allreduce call c3"],
+          "t": base + 40, "snapshot": {},
+          "flight": {"version": 1, "capacity": 8, "total": 3,
+                     "dropped": 0,
+                     "records": [op(0, "c2", base, 0.01),
+                                 begin(0, "c3", base + 1)]},
+          "dropped": {"journal": 0, "flight_ring": 0},
+          "watchdog_inflight": [{"opname": "allreduce", "call_id": "c3",
+                                 "rank": 0, "elapsed": 31.0,
+                                 "timeout": 30.0}]}
+    b3 = {"schema": "mpx-postmortem/1", "process": 3,
+          "reason": "fault: hang injected in MPI_Allreduce on rank 3",
+          "reasons": ["fault: hang injected in MPI_Allreduce on rank 3"],
+          "t": base + 2, "snapshot": {},
+          "flight": {"version": 1, "capacity": 8, "total": 2,
+                     "dropped": 0,
+                     "records": [op(3, "c2", base, 0.01),
+                                 {"type": "instant", "name": "fault",
+                                  "rank": 3, "process": 3, "t": base + 0.5,
+                                  "detail": "hang injected"}]},
+          "dropped": {"journal": 2, "flight_ring": 0}}
+    for b in (b0, b3):
+        (tmp_path / f"postmortem-p{b['process']}.json").write_text(
+            json.dumps(b))
+    return b0, b3
+
+
+def test_postmortem_report_attributes_hung_rank(tmp_path):
+    _hang_bundles(tmp_path)
+    bundles = merge.read_bundles(str(tmp_path))
+    assert [b["process"] for b in bundles] == [0, 3]
+    report = merge.postmortem_report(bundles)
+    # frontier: c3 began on rank 0, never on rank 3
+    fr = report["frontier"]
+    assert fr["call_id"] == "c3"
+    assert 0 in fr["began"] and fr["missing"] == [3]
+    # attribution order: the fault incident names rank 3 first
+    assert report["suspects"][0]["rank"] == 3
+    assert "fault" in report["suspects"][0]["why"]
+    text = merge.render_postmortem(report)
+    assert "MISSING: rank(s) 3" in text
+    assert "suspected straggler: rank 3" in text
+    assert "2 journal record(s)" in text                   # dropped surfaced
+
+
+def test_postmortem_cli_exit_codes(tmp_path, capsys):
+    assert merge.main(["postmortem", str(tmp_path)]) == 2  # no bundles
+    assert "no postmortem-p" in capsys.readouterr().err
+    _hang_bundles(tmp_path)
+    out = tmp_path / "report.txt"
+    assert merge.main(["postmortem", str(tmp_path),
+                       "--out", str(out)]) == 0
+    printed = capsys.readouterr().out
+    assert "suspected straggler: rank 3" in printed
+    assert out.read_text() == printed
+    # malformed bundle: loud exit 2 (the CI contract)
+    (tmp_path / "postmortem-p9.json").write_text("{nope")
+    assert merge.main(["postmortem", str(tmp_path)]) == 2
+
+
+def test_merge_cli_warns_on_dropped(tmp_path, capsys):
+    rec = {"type": "op", "op": "allreduce", "call_id": "c1", "seq": 0,
+           "rank": 0, "process": 0, "t_begin": 1.0, "t_end": 1.1,
+           "latency": 0.1, "bytes": 8, "dtype": "float32",
+           "algo": "native"}
+    (tmp_path / "events-p0.jsonl").write_text(json.dumps(rec) + "\n")
+    _hang_bundles(tmp_path)                                # journal: 2
+    assert merge.main(["merge", str(tmp_path), "--no-skew"]) == 0
+    captured = capsys.readouterr()
+    assert "merged 1 records" in captured.out
+    assert "dropped records" in captured.err
+    assert "journal: 2" in captured.err
+
+
+# ---------------------------------------------------------------------------
+# dropped surfacing (meter / snapshot / report)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_drop_bumps_meter_and_snapshot(monkeypatch):
+    monkeypatch.setattr(journal, "MAX_RECORDS", 3)
+    core.set_telemetry_mode("events")
+    for i in range(5):
+        journal.instant(f"e{i}", 0, {})
+    assert journal.dropped_records() == 2
+    snap = core.snapshot()
+    assert snap["meters"]["telemetry.dropped"] == 2
+    assert snap["dropped"] == {"journal": 2, "flight_ring": 0}
+    text = treport.render([snap])
+    assert "dropped: 2 journal record(s)" in text
+
+
+def test_healthy_snapshot_has_no_dropped_key():
+    core.set_telemetry_mode("counters")
+    snap = core.snapshot()
+    assert "dropped" not in snap
+    assert "dropped:" not in treport.render([snap])
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_renders():
+    _arm()
+    core.set_telemetry_mode("counters")
+    core.meter("health.postmortems")
+    health.set_gauge("serving_slo_headroom_ms", 12.5)
+    health.set_gauge("serving_kv_occupancy", 0.75)
+    text = health.prometheus_text()
+    assert text.endswith("\n")
+    assert 'mpx_meter_total{name="health.postmortems"} 1' in text
+    assert 'mpx_dropped_records_total{source="journal"} 0' in text
+    assert 'mpx_dropped_records_total{source="flight_ring"} 0' in text
+    assert "mpx_serving_slo_headroom_ms 12.5" in text
+    assert "mpx_serving_kv_occupancy 0.75" in text
+    assert "mpx_health_boundaries_total 0" in text
+    # deterministic: two renders are identical
+    assert text == health.prometheus_text()
+
+
+def test_prom_file_written_at_due_boundary(tmp_path):
+    _arm(interval=1, MPI4JAX_TPU_HEALTH_PROM="1")
+    os.environ["MPI4JAX_TPU_TELEMETRY_DIR"] = str(tmp_path)
+    health.on_boundary(0)
+    files = list(tmp_path.glob("prom-p*.prom"))
+    assert len(files) == 1
+    assert "mpx_health_boundaries_total 1" in files[0].read_text()
+
+
+# ---------------------------------------------------------------------------
+# MPX143: flight ring smaller than one iteration's collectives
+# ---------------------------------------------------------------------------
+
+
+def _loop_graph(n_events, ring):
+    events = [E(index=i, op="allreduce", payload_bytes=64,
+                dtype="float32", shape=(2,), loop=7, unroll=4)
+              for i in range(n_events)]
+    meta = {"flight_ring": ring} if ring else {}
+    return G(events=events, meta=meta)
+
+
+def test_mpx143_fires_when_ring_too_small():
+    # ring 8 -> implied 4 collectives/iteration; 5 exceeds it
+    found = checkers.check_flight_ring_capacity(_loop_graph(5, ring=8))
+    assert [f.code for f in found] == ["MPX143"]
+    f = found[0]
+    assert "5 collectives" in f.message or "5" in f.message
+    assert "MPI4JAX_TPU_FLIGHT_RING" in f.suggestion
+    assert "10" in f.suggestion                            # 2 * count
+
+
+def test_mpx143_negative_cases():
+    # exactly at capacity: no finding
+    assert checkers.check_flight_ring_capacity(_loop_graph(4, ring=8)) == []
+    # health off: no flight_ring meta -> checker inert
+    assert checkers.check_flight_ring_capacity(_loop_graph(50, ring=0)) == []
+    # events outside any loop don't imply a per-iteration rate
+    g = G(events=[E(index=i, op="allreduce") for i in range(50)],
+          meta={"flight_ring": 8})
+    assert checkers.check_flight_ring_capacity(g) == []
+
+
+def test_mpx143_through_run_checkers_and_catalog():
+    found = [f for f in checkers.run_checkers(_loop_graph(5, ring=8))
+             if f.code == "MPX143"]
+    assert len(found) == 1
+    info = areport.CODES["MPX143"]
+    assert info.severity == areport.ADVISORY
+    assert "flight ring" in info.title
+
+
+def test_config_snapshot_gains_flight_ring_only_when_armed():
+    snap = hook.config_snapshot()
+    assert "flight_ring" not in snap                       # off: identical
+    _arm(ring=32)
+    snap = hook.config_snapshot()
+    assert snap["flight_ring"] == 32
+
+
+# ---------------------------------------------------------------------------
+# off-is-free invariants
+# ---------------------------------------------------------------------------
+
+
+def test_cache_token_unchanged_by_health():
+    token_off = core.telemetry_cache_token()
+    _arm()
+    assert core.telemetry_cache_token() == token_off       # still (mode,)
+    assert core.telemetry_cache_token() == (core.effective_mode(),)
+
+
+def test_health_flags_in_env_fingerprint():
+    fp_off = config.env_fingerprint()
+    _arm()
+    assert config.env_fingerprint() != fp_off              # retrace forced

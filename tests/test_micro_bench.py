@@ -250,6 +250,55 @@ def test_bench_dispatch_unroll_schema():
     assert mpx.cache_stats()["aot"]["pins"] >= 2
 
 
+def test_bench_health_overhead_schema():
+    # all four telemetry configurations of the same eager allreduce at a
+    # tiny size — a dispatch-path regression in any tier fails here; the
+    # 10% counters+ring bound itself is asserted in the CI smoke lane
+    # where iteration counts make the ratio meaningful
+    comm = _world_comm()
+    saved = {k: os.environ.get(k)
+             for k in ("MPI4JAX_TPU_HEALTH", "MPI4JAX_TPU_FLIGHT_RING")}
+    rows = micro.bench_health_overhead(comm, sizes_kb=[0.004], iters=2)
+    assert len(rows) == 1
+    r = rows[0]
+    for col in ("off_us", "counters_us", "counters_ring_us", "events_us"):
+        assert r[col] > 0, col
+    assert r["ring_overhead_ratio"] is not None
+    assert r["ring_overhead_ratio"] > 0
+    # the sweep must leave no telemetry or health state behind
+    assert mpx.telemetry.effective_mode() == "off"
+    for k, v in saved.items():
+        assert os.environ.get(k) == v, k
+
+
+def test_health_replay_artifact_current(tmp_path):
+    # the committed record-volume replay (BENCH_health.json) must be
+    # reproducible from its embedded recipe and carry the overhead
+    # invariants: counters+ring pushes exactly one ring record per
+    # dispatch and adds ZERO journal records over counters-only
+    import json
+    import pathlib
+    import subprocess
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    committed = json.loads((repo / "BENCH_health.json").read_text())
+    assert committed["schema"] == "mpx-health-replay/1"
+    by_mode = {(r["mode"], r["health"]): r for r in committed["configs"]}
+    ring = by_mode[("counters", "on")]
+    assert ring["ring_pushed_records"] == ring["dispatch_records"]
+    assert ring["journal_records"] == \
+        by_mode[("counters", "off")]["journal_records"] == 0
+    assert by_mode[("events", "on")]["journal_records"] == \
+        by_mode[("events", "off")]["journal_records"]
+    out = tmp_path / "replay.json"
+    subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "health_replay.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert json.loads(out.read_text()) == committed
+
+
 def test_save_results_roundtrip(tmp_path):
     import json
 

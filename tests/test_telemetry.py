@@ -73,6 +73,7 @@ def _load_isolated():
     for mod in (
         "utils.config",
         "telemetry.hist",
+        "telemetry.health",
         "telemetry.core",
         "telemetry.journal",
         "telemetry.merge",
@@ -103,7 +104,10 @@ def _clean_telemetry_state():
     core.reset()
     saved = {
         k: os.environ.pop(k, None)
-        for k in ("MPI4JAX_TPU_TELEMETRY", "MPI4JAX_TPU_TELEMETRY_DIR")
+        for k in ("MPI4JAX_TPU_TELEMETRY", "MPI4JAX_TPU_TELEMETRY_DIR",
+                  "MPI4JAX_TPU_HEALTH", "MPI4JAX_TPU_HEALTH_INTERVAL",
+                  "MPI4JAX_TPU_FLIGHT_RING", "MPI4JAX_TPU_HEALTH_SUSPECTS",
+                  "MPI4JAX_TPU_HEALTH_PROM")
     }
     yield
     core.set_telemetry_mode(None)
@@ -507,6 +511,52 @@ def test_merge_golden_file():
     assert table["per_rank"][1]["last_arrivals"] == 3
 
 
+def test_chrome_trace_overlapping_spans_distinct_tracks():
+    """Regression: overlapping spans on ONE rank — a megastep bracket
+    enclosing async start/wait collectives that themselves overlap —
+    must land on distinct thread rows (tid per op name), not nest into
+    one row, and the rendered trace must stay valid Chrome-trace JSON."""
+    recs = [
+        # megastep bracket 10.0-11.0 encloses everything on rank 0
+        _op_rec(0, 10.000, 1.0, cid="m1", op="megastep"),
+        # two async allreduce spans overlapping each other AND the
+        # megastep (start/wait pairs in flight simultaneously)
+        _op_rec(0, 10.100, 0.6, cid="a1", op="allreduce_async"),
+        _op_rec(0, 10.300, 0.6, cid="a2", seq=1, op="allreduce_async"),
+        # a plain collective overlapping the tail of both
+        _op_rec(0, 10.700, 0.2, cid="c1", op="psum"),
+        _op_rec(1, 10.000, 1.0, cid="m1", op="megastep"),
+        {"type": "instant", "name": "drill", "rank": 0, "process": 0,
+         "t": 10.5, "detail": "mid-megastep"},
+    ]
+    trace = merge.chrome_trace(recs)
+    blob = json.dumps(trace)                 # must not corrupt the JSON
+    assert json.loads(blob) == trace
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    r0 = [e for e in xs if e["pid"] == 0]
+    # one tid per op name: megastep / allreduce_async / psum are three
+    # distinct tracks, so the overlapping slices never stack in one row
+    tids = {}
+    for e in r0:
+        tids.setdefault(e["name"].split(" ")[0].split("#")[0], set()).add(
+            e["tid"])
+    assert len({t for s in tids.values() for t in s}) == 3
+    for name, s in tids.items():
+        assert len(s) == 1, f"op {name} split across tids {s}"
+    # the two async slices share a tid and genuinely overlap in time
+    a = sorted((e for e in r0 if "allreduce_async" in e["name"]),
+               key=lambda e: e["ts"])
+    assert len(a) == 2 and a[0]["tid"] == a[1]["tid"]
+    assert a[1]["ts"] < a[0]["ts"] + a[0]["dur"]
+    # tid assignment is consistent across pids (megastep row lines up)
+    mega_tids = {e["tid"] for e in xs if "megastep" in e["name"]}
+    assert len(mega_tids) == 1
+    # the instant row (tid 0) stays separate from every op row
+    inst = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert inst and all(e["tid"] not in
+                        {x["tid"] for x in xs} for e in inst)
+
+
 # ===========================================================================
 # JAX-integration half (needs a working mpi4jax_tpu import)
 # ===========================================================================
@@ -628,6 +678,40 @@ def test_hlo_byte_identical_off_and_counters(real_telemetry, monkeypatch):
     mpx.set_telemetry_mode("events")
     events = jax.jit(f).lower(x).as_text()
     assert events != default_off
+
+
+@needs_mpx
+def test_hlo_and_cache_tokens_unchanged_by_health(real_telemetry,
+                                                  monkeypatch):
+    """Acceptance pin for the health plane: arming ``MPI4JAX_TPU_HEALTH``
+    changes NOTHING the compiler sees — lowered HLO and the program-cache
+    tokens (the telemetry token every compiled-program key folds, for
+    the spmd and eager one-op caches alike) are byte-identical with the
+    flag off and on, in the off AND counters telemetry tiers.  The ring
+    is host-side bookkeeping riding existing hooks; only the telemetry
+    *tier* may move compiled artifacts."""
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as mpx
+    from mpi4jax_tpu.telemetry import core as real_core
+
+    @mpx.spmd
+    def f(x):
+        res, _ = mpx.allreduce(x, op=mpx.SUM)
+        return res
+
+    x = jnp.ones((8, 4))
+    for tier in (None, "counters"):
+        mpx.set_telemetry_mode(tier)
+        baseline_hlo = jax.jit(f).lower(x).as_text()
+        baseline_token = real_core.telemetry_cache_token()
+        with monkeypatch.context() as m:
+            m.setenv("MPI4JAX_TPU_HEALTH", "on")
+            m.setenv("MPI4JAX_TPU_FLIGHT_RING", "64")
+            assert jax.jit(f).lower(x).as_text() == baseline_hlo
+            assert real_core.telemetry_cache_token() == baseline_token
+        mpx.telemetry.reset()
 
 
 def _wait_for(pred, timeout=5.0):
