@@ -1,0 +1,162 @@
+"""Cost-model replay of the pipeline schedules -> BENCH_pipeline.json.
+
+The committed acceptance artifact of the ``mpx.pipeline`` PR
+(docs/pipeline.md): prices one forward round of every expressible
+schedule — plus the naive ladder it replaces — over the acceptance grid
+the PR names, 8 stages x {4, 8, 16} microbatches at a 1 MiB boundary
+activation, with the analytic cost model's documented defaults
+(``analysis/costmodel.py``; no accelerator, fully reproducible).
+
+Each grid row records the modeled wall clock, the modeled bubble time
+(wall minus the ``M*c`` a perfectly full pipe would take), the phase
+split the schedule compiler emits (``parallel/pipeline.py``), and the
+activation-stash bound.  The headline orderings the PR's acceptance
+criteria name, asserted at capture time so a stale artifact can never
+claim them silently:
+
+- ``1f1b < gpipe < ladder`` on modeled bubble time at every microbatch
+  count (async overlap hides the wire; microbatching kills the
+  serialized fill);
+- the 1F1B activation stash stays at ``min(S, M)`` while GPipe's grows
+  with ``M`` — the PipeDream-flush memory win;
+- ``schedule='auto'``'s argmin agrees with the per-row minimum.
+
+The artifact rides the CI perf ratchet (``benchmarks/regress.py``
+against the committed baseline) and is regenerated + byte-diffed in the
+pipeline lane (.github/workflows/test.yml), so any drift in the model
+or the formulas must recapture it.
+
+Run:  python benchmarks/pipeline_replay.py [--out BENCH_pipeline.json]
+
+Loads the library under an isolated package name (the tests' loader
+pattern), so it runs under any installed JAX — or none.
+"""
+
+import argparse
+import importlib
+import json
+import pathlib
+import sys
+import types
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_pipeline_replay"
+
+
+def _load():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "analysis", "parallel"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "analysis.costmodel", "parallel.pipeline"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+SCHEMA = "mpx-pipeline-replay/1"
+
+STAGES = 8
+MICROBATCH_GRID = (4, 8, 16)
+PAYLOAD_MB = 1
+VIRTUAL = 2  # the interleaved rows' chunks-per-rank
+
+
+def grid_row(cm, pl, model, schedule, m, payload, c):
+    virtual = VIRTUAL if schedule == "interleaved" else 1
+    wall = cm.pipeline_wall_us(schedule, STAGES, m, payload, c, model,
+                               virtual=virtual)
+    frac = cm.pipeline_bubble_fraction(schedule, STAGES, m, payload, c,
+                                       model, virtual=virtual)
+    row = {
+        "op": schedule,
+        "count": m,
+        "size_mb": PAYLOAD_MB,
+        "wall_us": round(wall, 2),
+        "bubble_us": round(wall * frac, 2),
+        "bubble_fraction_x1000": int(round(frac * 1000)),
+    }
+    if schedule != "ladder":
+        plan = pl.compile_phases(schedule, STAGES, m, virtual)
+        row.update(
+            warmup_ticks=plan.warmup,
+            steady_ticks=plan.steady,
+            cooldown_ticks=plan.cooldown,
+            max_stash=plan.max_stash,
+        )
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(REPO / "BENCH_pipeline.json"))
+    args = ap.parse_args()
+    root = _load()
+    cm = sys.modules[f"{_ISO_NAME}.analysis.costmodel"]
+    pl = sys.modules[f"{_ISO_NAME}.parallel.pipeline"]
+
+    model = cm.CostModel()  # the documented analytic defaults
+    payload = PAYLOAD_MB << 20
+    # the compiler's roofline floor for the per-microbatch stage
+    # compute: a stage at minimum streams its boundary activation in
+    # and out (parallel/pipeline.py PipelineProgram.plan)
+    c = model.compute_us(2 * payload)
+
+    grid = []
+    auto_picks = []
+    for m in MICROBATCH_GRID:
+        rows = {s: grid_row(cm, pl, model, s, m, payload, c)
+                for s in cm.PIPELINE_SCHEDULES}
+        grid.extend(rows[s] for s in cm.PIPELINE_SCHEDULES)
+        best, times = cm.best_schedule(STAGES, m, payload, c, model,
+                                       virtual=VIRTUAL)
+        auto_picks.append({
+            "count": m,
+            "pick": best,
+            "pick_wall_us": round(times[best], 2),
+        })
+        # the acceptance orderings, at capture time
+        assert rows["1f1b"]["bubble_us"] < rows["gpipe"]["bubble_us"] \
+            < rows["ladder"]["bubble_us"], rows
+        assert rows["1f1b"]["wall_us"] < rows["gpipe"]["wall_us"] \
+            < rows["ladder"]["wall_us"], rows
+        assert rows["1f1b"]["max_stash"] == min(STAGES, m), rows
+        assert rows["gpipe"]["max_stash"] == m, rows
+        assert best == min(
+            (s for s in times), key=lambda s: (times[s], s)), (best, times)
+
+    payload_out = {
+        "schema": SCHEMA,
+        "stages": STAGES,
+        "payload_mb": PAYLOAD_MB,
+        "stage_compute_us": round(c, 3),
+        "grid": grid,
+        "auto": auto_picks,
+        "cost_model": cm.CostModel().to_json(),
+        "provenance": {
+            "kind": "cost-model replay (no accelerator; the measured "
+                    "bubble fraction comes from the eager phase "
+                    "driver's pipeline.* meters in telemetry.report() "
+                    "— docs/pipeline.md 'Measured bubbles')",
+            "recipe": "python benchmarks/pipeline_replay.py",
+            "microbatch_grid": list(MICROBATCH_GRID),
+            "interleaved_virtual": VIRTUAL,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(payload_out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}: {len(grid)} grid row(s), auto picks "
+          f"{[(r['count'], r['pick']) for r in auto_picks]}")
+    del root
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Seeded interleave-cycle deadlock — INTENTIONALLY BROKEN (MPX121).
+
+A hand-rolled interleaved pipeline boundary gone wrong: every rank
+ships its two virtual stage-chunks around the wrap ring, but EVEN ranks
+move chunk 0 first and ODD ranks move chunk 1 first (a ``lax.cond`` on
+rank parity where both branches communicate, so the per-trace checkers
+stay silent).  Each rank's schedule is individually well-formed —
+send-before-recv, tags matched, tokens threaded — yet across ranks the
+chunk-0 receive of an even rank waits on its odd neighbor's SECOND
+send, which sits behind that rank's chunk-1 receive, which waits on an
+even rank's second send, ... around the ring: a wait-for cycle that
+deadlocks under any buffering.  This is exactly the cycle class the
+``mpx.pipeline`` schedule compiler can never emit (one fixed chunk
+order per tick on every rank — docs/pipeline.md "Interleaved virtual
+stages"); hand-rolled interleaving is how you get it.
+
+Only the cross-rank schedule pass catches it, by re-tracing once per
+rank and walking the wait-for graph (MPX121; a variant mixing a
+collective into the cycle surfaces as MPX122):
+
+    python examples/broken/pipeline_interleave_deadlock.py
+
+runs both front-ends — ``mpx.analyze(ranks='all')`` and the ambient
+``MPI4JAX_TPU_ANALYZE=error`` path — and asserts both flag the cycle.
+This file lives under ``examples/broken/`` so the CI sweep over
+``examples/*.py`` (which must come back clean) does not pick it up; the
+pipeline CI lane instead asserts that analyzing THIS file fails with
+MPX121 (.github/workflows/test.yml).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mpi4jax_tpu as mpx  # noqa: E402
+
+
+def build_boundary(comm):
+    """One interleave boundary tick over the wrap ring, chunk order
+    rank-divergent: even ranks ship chunk 0 (tag 0) then chunk 1
+    (tag 1), odd ranks the reverse."""
+    n = comm.Get_size()
+    ring = tuple((i, (i + 1) % n) for i in range(n))
+
+    def boundary(h):
+        r = comm.Get_rank()
+
+        def even_path(v):
+            t = mpx.send(v, ring, tag=0, comm=comm)
+            c0, t = mpx.recv(v, source=ring, tag=0, comm=comm, token=t)
+            t = mpx.send(c0, ring, tag=1, comm=comm, token=t)
+            c1, _t = mpx.recv(c0, source=ring, tag=1, comm=comm, token=t)
+            return c1
+
+        def odd_path(v):
+            t = mpx.send(v, ring, tag=1, comm=comm)
+            c1, t = mpx.recv(v, source=ring, tag=1, comm=comm, token=t)
+            t = mpx.send(c1, ring, tag=0, comm=comm, token=t)
+            c0, _t = mpx.recv(c1, source=ring, tag=0, comm=comm, token=t)
+            return c0
+
+        return lax.cond(r % 2 == 0, even_path, odd_path, h)
+
+    return boundary
+
+
+def main():
+    mesh = mpx.make_world_mesh(devices=jax.devices())
+    comm = mpx.Comm(mesh.axis_names[0], mesh=mesh)
+    n = comm.Get_size()
+    if n < 2 or n % 2:
+        print("needs an even rank count >= 2 (e.g. XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8); the parity "
+              "cycle needs both branches populated")
+        return
+    boundary = build_boundary(comm)
+    x = jnp.stack([jnp.full((16,), float(r)) for r in range(n)])
+
+    # --- front-end 1: explicit cross-rank analysis
+    report = mpx.analyze(boundary, x, comm=comm, ranks="all")
+    print(report.render(), file=sys.stderr)
+    codes = {f.code for f in report.findings}
+    assert codes & {"MPX121", "MPX122"}, \
+        f"expected MPX121/MPX122, got {sorted(codes)}"
+    print("mpx.analyze(ranks='all'): interleave cycle caught (MPX121)",
+          file=sys.stderr)
+
+    # --- front-end 2: the ambient env=error path
+    mpx.set_analyze_mode("error")
+    try:
+        try:
+            mpx.run(boundary, x, comm=comm)
+        except mpx.AnalysisError as e:
+            assert any(f.code in ("MPX121", "MPX122")
+                       for f in e.findings), e.findings
+            print("MPI4JAX_TPU_ANALYZE=error: interleave cycle caught "
+                  "at trace time", file=sys.stderr)
+        else:
+            raise AssertionError("ambient cross-rank pass missed the "
+                                 "interleave cycle")
+    finally:
+        mpx.set_analyze_mode(None)
+        mpx.clear_caches()
+
+
+if __name__ == "__main__":
+    main()

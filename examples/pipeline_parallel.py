@@ -1,32 +1,43 @@
-"""Pipeline parallelism: a minimal GPipe-style microbatch ladder.
+"""Pipeline parallelism: the naive ladder vs the compiled schedules.
 
-The first pipeline-shaped program in the examples suite (ROADMAP item
-5): eight pipeline stages, one per rank, each applying its own weight
-matrix.  Two variants of the SAME forward pass:
+Eight pipeline stages, one per rank, a 16-substage model (two substages
+per rank — so the interleaved schedule has real virtual stages to own).
+FIVE variants of the SAME forward pass, every one asserted BIT-IDENTICAL
+to the sequential single-device reference:
 
-- ``pipeline_fwd`` — the **naive ladder**: the whole batch enters stage
-  0 and crawls stage to stage over matched ``send``/``recv`` pairs.
-  Every hop waits for the previous stage's full compute + transfer, so
-  the S-1 hops serialize end to end.  This is the seeded positive for
-  the cost model's **MPX135** advisory (serialized point-to-point chain
-  on the critical path)::
+- the **naive ladder** — the whole batch crawls stage to stage over
+  matched ``send``/``recv`` pairs; the S-1 hops serialize end to end.
+  This is the seeded positive for the cost model's **MPX135** advisory
+  (serialized point-to-point chain on the critical path), whose text now
+  cites the modeled bubble fraction of the ladder and the 1F1B price
+  ``mpx.pipeline`` would get::
 
       python -m mpi4jax_tpu.analysis --ranks 8 --cost \
           examples/pipeline_parallel.py
 
-  reports MPX135 (advisory — exit code stays 0) with the chain's
-  predicted share of the step time;
+  reports MPX135 (advisory — exit code stays 0);
 
-- ``pipeline_fwd_microbatched`` — the **GPipe fix**: the batch splits
-  into M microbatches injected one per wavefront tick, every stage
-  boundary shipping simultaneously (one ``sendrecv`` shift per tick),
-  so stage i+1's transfer of microbatch m overlaps stage i's compute of
-  microbatch m+1.  Same math — the driver asserts both variants match
-  the sequential reference bit for bit — but the chain is pipelined.
+- ``mpx.pipeline(..., schedule='gpipe')`` — the GPipe wavefront: M
+  microbatches injected one per tick, every stage boundary shipping
+  simultaneously over a blocking ``sendrecv`` shift;
 
-Without ``--cost`` both variants verify clean: the ladder is *correct*
-(every send matched, no deadlock, tokens threaded); only the cost
-model can say it is *slow*.  See docs/analysis.md "Cost model".
+- ``mpx.pipeline(..., schedule='1f1b')`` — same wavefront, but the
+  boundary runs through the async point-to-point primitives
+  (``send_start``/``recv_start``/``p2p_wait``) so the transfer overlaps
+  the tick's compute, and the steady-state window compiles into ONE
+  megastep ``fori_loop`` dispatch;
+
+- ``mpx.pipeline(..., schedule='interleaved', virtual=2)`` — Megatron
+  interleaved virtual stages: rank r owns substages r and 8+r, the
+  boundary is a ring, and the pipeline fill shrinks by the chunk count;
+
+- ``mpx.pipeline(...)`` with the default ``schedule='auto'`` — the cost
+  model prices every expressible schedule (tuned alpha/beta when a
+  tuning file is loaded) and runs the argmin.
+
+The schedule math, the activation-stash bound, and when NOT to pipeline
+live in docs/pipeline.md; the deliberately deadlocked interleave twin is
+examples/broken/pipeline_interleave_deadlock.py (MPX121).
 
 Run: python examples/pipeline_parallel.py   (8 devices, e.g.
      XLA_FLAGS=--xla_force_host_platform_device_count=8)
@@ -42,105 +53,116 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 import mpi4jax_tpu as mpx  # noqa: E402
+from mpi4jax_tpu.parallel.pipeline import split_microbatches  # noqa: E402
 
-MICROBATCHES = 4
+MICROBATCHES = 16
+BATCH, DIM = 32, 8
 
 
-def stage_fn(h, w):
-    """One pipeline stage: a linear layer + nonlinearity."""
+def substage(h, w):
+    """One model substage: a linear layer + nonlinearity."""
     return jnp.tanh(h @ w)
 
 
-def make_pipeline(comm):
-    """Build both pipeline variants over ``comm`` (one stage per rank).
+def stage_pair(h, w2):
+    """One PIPELINE stage under the flat (virtual=1) schedules: the two
+    consecutive substages rank r owns (``w2`` is ``(2, DIM, DIM)``)."""
+    return substage(substage(h, w2[0]), w2[1])
 
-    Inputs are global arrays (leading axis = ranks): ``x[0]`` /
-    ``mbs[0]`` hold stage 0's real minibatch, ``ws[s]`` is stage s's
-    weight matrix.  The result lives on the LAST stage's row of the
-    global output.
-    """
+
+def make_ladder(comm):
+    """The naive ladder over ``comm`` (one stage per rank): compute,
+    ship the whole activation to the next stage, wait, repeat — S-1
+    serialized hops (MPX135).  Inputs are global arrays (leading axis =
+    ranks): ``x[0]`` holds the real minibatch, ``w2s[r]`` rank r's
+    substage pair; the result lives on the LAST stage's row."""
     stages = comm.Get_size()
 
     @mpx.spmd(comm=comm)
-    def pipeline_fwd(x, w):
-        # the naive ladder: compute, ship the whole activation to the
-        # next stage, wait, repeat — S-1 serialized hops (MPX135)
+    def ladder(x, w2):
         rank = comm.Get_rank()
-        h = stage_fn(x, w)  # stage 0's lane holds the real value
+        h = stage_pair(x, w2)  # stage 0's lane holds the real value
         tok = None
         for s in range(1, stages):
             tok = mpx.send(h, dest={s - 1: s}, tag=s, token=tok)
             got, tok = mpx.recv(h, source={s - 1: s}, tag=s, token=tok)
-            h = jnp.where(rank == s, stage_fn(got, w), h)
+            h = jnp.where(rank == s, stage_pair(got, w2), h)
         return h
 
-    @mpx.spmd(comm=comm)
-    def pipeline_fwd_microbatched(mbs, w):
-        # the GPipe wavefront: one shift per tick moves EVERY stage
-        # boundary at once; microbatch m's hop overlaps microbatch
-        # m+1's compute one stage upstream
-        rank = comm.Get_rank()
-        m = mbs.shape[0]
-        h = jnp.zeros_like(mbs[0])
-        outs = []
-        tok = None
-        for t in range(stages + m - 1):
-            got, tok = mpx.sendrecv(
-                h, h, dest=mpx.shift(1, wrap=False), token=tok)
-            feed = mbs[t] if t < m else jnp.zeros_like(mbs[0])
-            src = jnp.where(rank == 0, feed, got)
-            h = stage_fn(src, w)
-            outs.append(h)
-        # microbatch m leaves the last stage at tick m + stages - 1
-        return jnp.stack([outs[i + stages - 1] for i in range(m)])
-
-    return pipeline_fwd, pipeline_fwd_microbatched
+    return ladder
 
 
-def reference(x0, ws):
-    """Sequential single-device reference: the full stage composition."""
-    h = x0
-    for s in range(ws.shape[0]):
-        h = stage_fn(h, ws[s])
-    return h
+def reference(x0, ws16):
+    """Sequential single-device reference: all 16 substages, applied
+    per-microbatch so every variant (which computes on microbatch-sized
+    slices) can be pinned BIT-identical, not just allclose."""
+    mbs = split_microbatches(x0, MICROBATCHES)
+    outs = []
+    for m in range(MICROBATCHES):
+        h = mbs[m]
+        for k in range(ws16.shape[0]):
+            h = substage(h, ws16[k])
+        outs.append(h)
+    return jnp.concatenate(outs)
 
 
 def main():
     comm = mpx.get_default_comm()
     stages = comm.Get_size()
-    batch, dim = 8, 16
-    assert batch % MICROBATCHES == 0
+    assert BATCH % MICROBATCHES == 0
+    mb = BATCH // MICROBATCHES
     rng = np.random.default_rng(0)
 
-    x = jnp.zeros((stages, batch, dim), jnp.float32).at[0].set(
-        jnp.asarray(rng.normal(size=(batch, dim)), jnp.float32))
-    ws = jnp.asarray(rng.normal(size=(stages, dim, dim)) * 0.5,
-                     jnp.float32)
-    pipeline_fwd, pipeline_fwd_microbatched = make_pipeline(comm)
+    x0 = jnp.asarray(rng.normal(size=(BATCH, DIM)), jnp.float32)
+    ws16 = jnp.asarray(rng.normal(size=(2 * stages, DIM, DIM)) * 0.5,
+                       jnp.float32)
+    # rank r's substage pair under the flat schedules...
+    w2s = ws16.reshape(stages, 2, DIM, DIM)
+    # ...and its interleaved chunks: chunk c of rank r is substage
+    # c*S + r (the virtual-stage numbering docs/pipeline.md draws)
+    wi = ws16.reshape(2, stages, DIM, DIM).transpose(1, 0, 2, 3)
 
-    ref = reference(x[0], ws)
+    ref = np.asarray(reference(x0, ws16))
 
-    out = pipeline_fwd(x, ws)
-    np.testing.assert_allclose(out[-1], ref, rtol=1e-5, atol=1e-5)
+    # --- the naive ladder (the MPX135 positive)
+    ladder = make_ladder(comm)
+    x = jnp.zeros((stages, BATCH, DIM), jnp.float32).at[0].set(x0)
+    out = ladder(x, w2s)
+    np.testing.assert_array_equal(np.asarray(out[-1]), ref)
 
-    mb = batch // MICROBATCHES
-    mbs = jnp.zeros((stages, MICROBATCHES, mb, dim), jnp.float32).at[0].set(
-        x[0].reshape(MICROBATCHES, mb, dim))
-    out_mb = pipeline_fwd_microbatched(mbs, ws)
-    np.testing.assert_allclose(out_mb[-1].reshape(batch, dim), ref,
-                               rtol=1e-5, atol=1e-5)
+    # --- the compiled schedules: global microbatch view, stage 0 real
+    mbs = jnp.zeros((stages, MICROBATCHES, mb, DIM), jnp.float32).at[0].set(
+        split_microbatches(x0, MICROBATCHES))
+    for label, prog, params in (
+        ("gpipe", mpx.pipeline(stage_pair, MICROBATCHES,
+                               schedule="gpipe", comm=comm), w2s),
+        ("1f1b", mpx.pipeline(stage_pair, MICROBATCHES,
+                              schedule="1f1b", comm=comm), w2s),
+        ("interleaved", mpx.pipeline(substage, MICROBATCHES,
+                                     schedule="interleaved", virtual=2,
+                                     comm=comm), wi),
+        ("auto", mpx.pipeline(stage_pair, MICROBATCHES, comm=comm), w2s),
+    ):
+        got = prog(mbs, params)
+        np.testing.assert_array_equal(
+            np.asarray(got[-1]).reshape(BATCH, DIM), ref,
+            err_msg=f"schedule {label!r} diverged from the reference")
+        plan = prog.plan(stages, MICROBATCHES, mb * DIM * 4)
+        print(f"{label:<12} -> {plan.schedule}: warmup {plan.warmup} / "
+              f"steady {plan.steady} / cooldown {plan.cooldown} tick(s), "
+              f"activation stash <= {plan.max_stash}")
 
-    print(f"pipeline over {stages} stage(s): naive ladder and "
-          f"{MICROBATCHES}-microbatch wavefront both match the "
-          "sequential reference")
+    print(f"pipeline over {stages} stage(s): the ladder and every "
+          f"compiled schedule match the sequential reference bit for bit")
 
     # the cost model's verdict on the naive ladder: a serialized p2p
-    # chain on the critical path (MPX135) — the microbatched variant is
-    # the recommended fix
-    report = mpx.analyze(pipeline_fwd, x, ws, ranks="all", cost=True)
+    # chain on the critical path (MPX135), its text citing the modeled
+    # bubble fraction and the mpx.pipeline fix
+    report = mpx.analyze(ladder, x, w2s, ranks="all", cost=True)
     chain = [f for f in report.findings if f.code == "MPX135"]
     if chain:
         print(f"cost model: {chain[0].message}")
+        print(f"cost model: {chain[0].suggestion}")
     if report.cost is not None:
         print(f"predicted step time (naive ladder): "
               f"{report.cost.total_us:.1f} us")

@@ -32,6 +32,7 @@ from .ops import (  # noqa: F401
     SUM,
     AsyncHandle,
     Op,
+    P2PHandle,
     Status,
     Token,
     allgather,
@@ -48,7 +49,9 @@ from .ops import (  # noqa: F401
     create_token,
     gather,
     overlap,
+    p2p_wait,
     recv,
+    recv_start,
     reduce,
     reduce_scatter,
     reduce_scatter_start,
@@ -56,17 +59,20 @@ from .ops import (  # noqa: F401
     scan,
     scatter,
     send,
+    send_start,
     sendrecv,
     set_fusion_mode,
     varying,
 )
 from .parallel import (  # noqa: F401
     Comm,
+    PipelineProgram,
     get_default_comm,
     get_default_mesh,
     init_distributed,
     make_world_mesh,
     moe,
+    pipeline,
     run,
     set_default_mesh,
     shift,
@@ -189,9 +195,16 @@ __all__ = [
     "alltoall_wait",
     "reduce_scatter_start",
     "reduce_scatter_wait",
+    "send_start",
+    "recv_start",
+    "p2p_wait",
     "AsyncHandle",
+    "P2PHandle",
     "overlap",
     "set_fusion_mode",
+    # pipeline-parallel schedule compiler (docs/pipeline.md)
+    "pipeline",
+    "PipelineProgram",
     # expert-parallel MoE helper (docs/moe.md)
     "moe",
     # AOT pinning + persistent compile cache (docs/aot.md)

@@ -1,4 +1,4 @@
-"""Critical-path timing simulation + the performance critic (MPX131-135).
+"""Critical-path timing + the performance critic (MPX131-135, MPX144).
 
 ``mpx.analyze(fn, *args, ranks=..., cost=True)`` extends the cross-rank
 progress simulation (analysis/progress.py) into a **timed** one: the
@@ -16,12 +16,16 @@ Out the other end:
 - :class:`CostReport` (``Report.cost``): predicted step time, per-op and
   per-link-class latency+byte breakdown, the critical path rendered
   rank by rank, and the predicted megastep/fusion amortization;
-- five **quantified advisories** (each stated in predicted microseconds
+- six **quantified advisories** (each stated in predicted microseconds
   and bytes, never vibes): MPX131 overlap opportunity, MPX132 fusion
   opportunity (the quantified upgrade of MPX111), MPX133 algorithm
   mispick, MPX134 structural load imbalance, MPX135 serialized
   point-to-point chain on the critical path (the GPipe-shaped check —
-  ``examples/pipeline_parallel.py`` is the seeded positive).
+  ``examples/pipeline_parallel.py`` is the seeded positive, and the
+  advisory now cites the modeled bubble fraction of the ladder plus the
+  1F1B price ``mpx.pipeline`` would get), MPX144 pipeline schedule
+  mispick (a program stamped by the schedule compiler ran a schedule
+  the model prices measurably worse than an expressible alternative).
 
 Dependency-free at import (no jax): scripted schedules drive the timed
 simulation in tests/test_cost_pure.py under any JAX version; the jaxpr
@@ -43,7 +47,7 @@ from .report import Finding
 from .schedule import SchedOp
 
 # codes this module owns in the checker-coverage sense
-COST_CODES = ("MPX131", "MPX132", "MPX133", "MPX134", "MPX135")
+COST_CODES = ("MPX131", "MPX132", "MPX133", "MPX134", "MPX135", "MPX144")
 
 # MPX131: fraction of a blocking collective's predicted time the
 # adjacent compute must be able to hide before the advisory fires
@@ -526,6 +530,7 @@ def run_cost_pass(matched: MatchedProgram, *, model: Optional[CostModel]
     findings.extend(_check_mispick(sim, matched))
     findings.extend(_check_imbalance(sim, matched))
     findings.extend(_check_p2p_chain(sim, path, path_us))
+    findings.extend(_check_pipeline_mispick(sim, matched))
     findings.sort(key=lambda f: (f.index if f.index is not None else -1,
                                  f.code))
 
@@ -806,6 +811,25 @@ def _check_p2p_chain(sim: _TimedSimulation, path: List[_Node],
             if n.op.kind == "recv" and (i == 0 or run[i - 1].rank != n.rank)
         ) or f"rank {first.rank}"
         pct = 100.0 * span / path_us
+        # the chain is pipeline-shaped: price it as a naive ladder over
+        # len(ranks) stages and cite the modeled bubble fraction plus
+        # the 1F1B twin the schedule compiler would emit instead
+        # (satellite of the mpx.pipeline PR — the MPX111->MPX132 move)
+        payload = max(
+            (_op_payload(n.op) for n in run if n.op.kind == "recv"),
+            default=0)
+        s = len(ranks)
+        m = max(1, hops // max(1, s - 1))
+        c = sim.model.compute_us(2 * payload)
+        try:
+            ladder_us = costmodel.pipeline_wall_us(
+                "ladder", s, m, payload, c, sim.model)
+            f1b_us = costmodel.pipeline_wall_us(
+                "1f1b", s, m, payload, c, sim.model)
+            bubble = costmodel.pipeline_bubble_fraction(
+                "ladder", s, m, payload, c, sim.model)
+        except ValueError:
+            ladder_us = f1b_us = bubble = 0.0
         findings.append(Finding(
             code="MPX135", op=first.op.op, index=first.op.event_index,
             rank=first.rank, seq=first.op.seq,
@@ -814,11 +838,17 @@ def _check_p2p_chain(sim: _TimedSimulation, path: List[_Node],
                      f"{sorted(ranks)} occupies {span:.1f} us "
                      f"(~{pct:.0f}%) of the predicted critical path "
                      f"({chain}): each hop waits for the previous "
-                     "stage's full compute + transfer"),
-            suggestion=("microbatch the ladder (GPipe-style) so stage "
-                        "i+1's transfer overlaps stage i's compute — "
-                        "see examples/pipeline_parallel.py for the "
-                        "pipelined twin of this shape"),
+                     "stage's full compute + transfer — modeled as a "
+                     f"{s}-stage ladder its bubble fraction is "
+                     f"{100.0 * bubble:.0f}%"
+                     + _model_provenance(sim.model)),
+            suggestion=(f"microbatch the ladder with mpx.pipeline "
+                        f"(schedule='auto'): at this shape a 1F1B "
+                        f"schedule prices at {f1b_us:.1f} us/round vs "
+                        f"{ladder_us:.1f} us serialized, so stage i+1's "
+                        "transfer overlaps stage i's compute — see "
+                        "examples/pipeline_parallel.py and "
+                        "docs/pipeline.md"),
         ))
 
     for n in path:
@@ -828,4 +858,80 @@ def _check_p2p_chain(sim: _TimedSimulation, path: List[_Node],
             _close(run)
             run = []
     _close(run)
+    return findings
+
+
+def _check_pipeline_mispick(sim: _TimedSimulation,
+                            matched: MatchedProgram) -> List[Finding]:
+    """MPX144: a pipeline program (mpx.pipeline) stamped its boundary
+    transfers with a ``(schedule, stages, microbatches, virtual,
+    payload_bytes)`` tuple (SchedOp.meta["pipeline"], via
+    hook.mark_last_event); when the cost model prices an expressible
+    alternative schedule measurably better at that point, say so.  The
+    candidate set matches the compiler's own ``schedule='auto'`` search:
+    gpipe and 1f1b always, interleaved only when the program already
+    carries virtual stage-chunks (v >= 2) — an alternative that needs
+    restructuring is not 'expressible'."""
+    findings: List[Finding] = []
+    seen = set()
+    for r in matched.ranks:
+        for op in matched.schedules[r]:
+            stamp = (op.meta or {}).get("pipeline")
+            if not stamp:
+                continue
+            try:
+                schedule = str(stamp[0])
+                stages, microbatches, virtual, payload = (
+                    int(stamp[1]), int(stamp[2]), int(stamp[3]),
+                    int(stamp[4]))
+            except (TypeError, ValueError, IndexError, KeyError):
+                continue
+            key = (schedule, stages, microbatches, virtual, payload)
+            if key in seen:
+                continue
+            seen.add(key)
+            # same per-microbatch compute estimate the compiler's auto
+            # pick uses: the roofline floor of streaming the boundary
+            # activation in and out of each stage
+            c = sim.model.compute_us(2 * payload)
+            try:
+                chosen_us = costmodel.pipeline_wall_us(
+                    schedule, stages, microbatches, payload, c,
+                    sim.model, virtual=virtual)
+                best, times = costmodel.best_schedule(
+                    stages, microbatches, payload, c, sim.model,
+                    virtual=virtual)
+            except ValueError:
+                continue
+            best_us = times[best]
+            if best == schedule or chosen_us <= 0:
+                continue
+            delta = chosen_us - best_us
+            if delta < MISPICK_MIN_FRACTION * best_us:
+                continue
+            try:
+                bub_chosen = costmodel.pipeline_bubble_fraction(
+                    schedule, stages, microbatches, payload, c,
+                    sim.model, virtual=virtual)
+                bub_best = costmodel.pipeline_bubble_fraction(
+                    best, stages, microbatches, payload, c, sim.model,
+                    virtual=virtual)
+            except ValueError:
+                bub_chosen = bub_best = 0.0
+            findings.append(Finding(
+                code="MPX144", op=op.op, index=op.event_index, rank=r,
+                seq=op.seq,
+                message=(f"pipeline program runs schedule '{schedule}' "
+                         f"over {stages} stage(s) x {microbatches} "
+                         f"microbatch(es) ({payload} B boundary "
+                         f"payload): predicted {chosen_us:.1f} us/round "
+                         f"vs {best_us:.1f} us for '{best}' — bubble "
+                         f"fraction {100.0 * bub_chosen:.0f}% vs "
+                         f"{100.0 * bub_best:.0f}%"
+                         + _model_provenance(sim.model)),
+                suggestion=(f"pass schedule='auto' (or "
+                            f"schedule='{best}') to mpx.pipeline so "
+                            "the cost model picks the cheaper phase "
+                            "program (docs/pipeline.md)"),
+            ))
     return findings

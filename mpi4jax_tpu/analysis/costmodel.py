@@ -641,3 +641,110 @@ def best_algo(op: str, nbytes: int, k: int, model: CostModel,
         for a in candidates
     }
     return min(times, key=lambda a: (times[a], a)), times
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel schedule formulas (parallel/pipeline.py, docs/pipeline.md)
+# ---------------------------------------------------------------------------
+#
+# Wall-clock models of one pipeline round over S stages x M microbatches,
+# with c = per-microbatch per-stage compute (us) and x = one boundary
+# transfer of the microbatch activation (us, ``p2p_cost`` through the
+# model).  Per-rank useful work is always M*c; everything else is bubble.
+#
+# - ladder ("naive"): every stage computes the WHOLE batch then forwards
+#   it — the un-microbatched send/recv chain MPX135 flags.  Fully serial:
+#       wall = S*(M*c) + (S-1)*(M*x)
+# - gpipe (Huang et al.): M microbatches through S stages in lockstep
+#   ticks; the blocking sendrecv boundary puts the transfer on the
+#   critical path of every tick:
+#       wall = (M+S-1) * (c + x)
+# - 1f1b (PipeDream-flush, Narayanan et al.): same (M+S-1)-tick skeleton,
+#   but the boundary goes through send_start/recv_start so the steady-
+#   state transfer overlaps the next microbatch's compute.  Only the
+#   (S-1) warmup-edge transfers and any per-tick excess of x over c stay
+#   exposed:
+#       wall = (M+S-1)*c + (S-1)*x + max(0, x-c)*(M-1)
+# - interleaved (Megatron virtual stages): v stage-chunks per rank, so
+#   P = S*v virtual stages of compute c/v each; the fill shrinks by v
+#   while each chunk boundary moves 1/v of the activation bytes (alpha
+#   paid v times as often — the classic bubble-vs-latency trade):
+#       wall = M*c + (S-1)*(c/v) + (S-1)*x_v + max(0, x_v - c/v)*(M*v-1)
+#   with x_v = one transfer of payload_bytes/v.
+#
+# The orderings the BENCH_pipeline.json acceptance grid pins (x > 0,
+# M >= 2, S >= 2): ladder > gpipe (microbatching wins (S-1)*(M-1)*c of
+# fill) and gpipe > 1f1b (async overlap hides M*x - max(0,x-c)*(M-1) > 0
+# of wire time).  interleaved-vs-1f1b depends on alpha vs c/v — exactly
+# why ``schedule='auto'`` asks this model instead of hard-coding.
+
+PIPELINE_SCHEDULES = ("ladder", "gpipe", "1f1b", "interleaved")
+
+
+def pipeline_wall_us(schedule: str, stages: int, microbatches: int,
+                     payload_bytes: int, stage_compute_us: float,
+                     model: CostModel, same_host: bool = True,
+                     virtual: int = 2) -> float:
+    """Modeled wall-clock (us) of one forward round of ``schedule`` over
+    ``stages`` x ``microbatches`` with per-boundary activation payloads
+    of ``payload_bytes``."""
+    if stages < 1 or microbatches < 1:
+        raise ValueError("pipeline_wall_us: stages and microbatches "
+                         "must be >= 1")
+    s, m = stages, microbatches
+    c = stage_compute_us
+    x = model.time_us(p2p_cost(payload_bytes, same_host=same_host))
+    if schedule == "ladder":
+        return s * m * c + (s - 1) * m * x
+    if schedule == "gpipe":
+        return (m + s - 1) * (c + x)
+    if schedule == "1f1b":
+        return (m + s - 1) * c + (s - 1) * x + max(0.0, x - c) * (m - 1)
+    if schedule == "interleaved":
+        v = max(1, virtual)
+        cv = c / v
+        xv = model.time_us(p2p_cost(-(-payload_bytes // v),
+                                    same_host=same_host))
+        return (m * c + (s - 1) * cv + (s - 1) * xv
+                + max(0.0, xv - cv) * (m * v - 1))
+    raise ValueError(f"pipeline_wall_us: unknown schedule {schedule!r} "
+                     f"(expressible: {PIPELINE_SCHEDULES})")
+
+
+def pipeline_bubble_fraction(schedule: str, stages: int, microbatches: int,
+                             payload_bytes: int, stage_compute_us: float,
+                             model: CostModel, same_host: bool = True,
+                             virtual: int = 2) -> float:
+    """Predicted bubble fraction: the share of the round's wall clock a
+    rank spends NOT computing, ``1 - M*c / wall`` (0 = perfectly full)."""
+    wall = pipeline_wall_us(schedule, stages, microbatches, payload_bytes,
+                            stage_compute_us, model, same_host=same_host,
+                            virtual=virtual)
+    if wall <= 0.0:
+        return 0.0
+    busy = microbatches * stage_compute_us
+    return max(0.0, 1.0 - busy / wall)
+
+
+def best_schedule(stages: int, microbatches: int, payload_bytes: int,
+                  stage_compute_us: float, model: CostModel,
+                  same_host: bool = True, virtual: int = 2,
+                  candidates: Optional[Sequence[str]] = None,
+                  ) -> Tuple[str, Dict[str, float]]:
+    """Model-predicted schedule pick, mirroring :func:`best_algo`:
+    evaluates every expressible candidate and returns ``(best, {schedule:
+    wall_us})`` — ``mpx.pipeline(schedule='auto')``'s argmin and the
+    MPX144 mispick discriminator.  The ladder is never a candidate (it is
+    the shape :func:`pipeline` exists to replace); interleaved only
+    qualifies when ``virtual >= 2`` divides the stage count's chunking."""
+    if candidates is None:
+        candidates = ["gpipe", "1f1b"]
+        if virtual >= 2:
+            candidates.append("interleaved")
+    times = {
+        sched: pipeline_wall_us(sched, stages, microbatches, payload_bytes,
+                                stage_compute_us, model,
+                                same_host=same_host, virtual=virtual)
+        for sched in candidates
+    }
+    return min(times, key=lambda sched: (times[sched], sched)), times

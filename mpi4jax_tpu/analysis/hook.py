@@ -361,7 +361,8 @@ def annotate(**fields) -> None:
         return
     evt = _open_events[-1][0]
     for k, v in fields.items():
-        if k in ("queue_depth", "bare_int_routing", "traced_structure"):
+        if k in ("queue_depth", "bare_int_routing", "traced_structure",
+                 "pipeline"):
             evt.extra[k] = v
         else:
             setattr(evt, k, v)

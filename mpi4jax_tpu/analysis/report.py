@@ -401,6 +401,18 @@ CODES = {
             "ran last', not 'who was stuck where' "
             "(docs/observability.md).",
         ),
+        # --- pipeline-schedule codes (analysis/cost.py pipeline pass):
+        CodeInfo(
+            "MPX144", "pipeline runs a schedule the cost model prices "
+            "worse", ADVISORY,
+            "A pipeline program (mpx.pipeline) ran with a schedule the "
+            "cost model prices measurably worse than an expressible "
+            "alternative at this (stages, microbatches, payload) point: "
+            "the predicted wall time of the chosen schedule exceeds the "
+            "best candidate's by more than the mispick threshold.  Pass "
+            "schedule='auto' to let the model pick, or switch to the "
+            "named schedule in the finding (docs/pipeline.md).",
+        ),
     )
 }
 

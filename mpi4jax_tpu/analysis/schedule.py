@@ -345,12 +345,62 @@ def build_schedule(events, rank: int, world: Optional[int] = None,
             return tuple(range(world))
         return None  # unknown membership (sub-comm without groups info)
 
+    # async p2p (ops/_async.py send_start/recv_start/p2p_wait): the send
+    # half is buffered at issue, exactly like the blocking send; the recv
+    # half ADOPTS its routing at issue position (FIFO, same channel rule
+    # as above) but BLOCKS — and is therefore matched/priced — at its
+    # p2p_wait position.  span -> (comm_key, tag, pairs) carries the
+    # adoption from the start to the wait.
+    p2p_spans: Dict[int, Optional[Tuple]] = {}
+
     for e in events:
         ck = key_of(e.comm_uid)
         base = dict(rank=rank, pos=len(sched), op=e.op, comm_uid=e.comm_uid,
                     comm_key=ck, dtype=e.dtype, nelems=_nelems(e.shape),
                     payload_bytes=e.payload_bytes, eager=e.eager,
-                    event_index=e.index)
+                    event_index=e.index, meta=dict(e.extra))
+        if e.op in ("send_start", "recv_start", "p2p_wait"):
+            if e.op == "send_start":
+                pairs = e.pairs
+                if not e.eager:
+                    chan_sends.setdefault((ck, e.tag), []).append(pairs)
+                if e.span is not None:
+                    p2p_spans[e.span] = None  # send side: wait is local
+                if pairs:
+                    for s, d in pairs:
+                        if s == rank:
+                            sched.append(SchedOp(kind="send", src=rank,
+                                                 dst=d, tag=e.tag,
+                                                 span=e.span, **base))
+                            base = dict(base, pos=len(sched))
+            elif e.op == "recv_start":
+                pairs = e.pairs
+                if not e.eager:
+                    queued = chan_sends.get((ck, e.tag))
+                    adopted = queued.pop(0) if queued else None
+                    if pairs is None:
+                        pairs = adopted
+                if e.span is not None:
+                    p2p_spans[e.span] = (ck, e.tag, pairs)
+                # nothing blocks here: the transfer retires at the wait
+            else:  # p2p_wait
+                if e.span is None or e.span not in p2p_spans:
+                    continue  # unpaired wait: MPX112's domain
+                linked = p2p_spans.pop(e.span)
+                if linked is None:
+                    continue  # send-side wait never blocks on a peer
+                ck2, tag, pairs = linked
+                base["comm_key"] = ck2
+                if pairs is None:
+                    sched.append(SchedOp(kind="recv", src=None, dst=rank,
+                                         tag=tag, span=e.span, **base))
+                    continue
+                for s, d in pairs:
+                    if d == rank:
+                        sched.append(SchedOp(kind="recv", src=s, dst=rank,
+                                             tag=tag, span=e.span, **base))
+                        base = dict(base, pos=len(sched))
+            continue
         if e.op in P2P_OPS:
             pairs = e.pairs
             if e.op == "send" and not e.eager:

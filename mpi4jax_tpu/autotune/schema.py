@@ -58,6 +58,13 @@ KNOB_FLAGS = {
     # ("off"/"bf16"/"fp8", optionally payload-bucketed like
     # overlap_chunks); same schema-bump-free addition contract
     "compress": "MPI4JAX_TPU_COMPRESS",
+    # PR 20: pipeline-parallel schedule knobs (parallel/pipeline.py,
+    # docs/pipeline.md) — microbatch count for split_microbatches and
+    # the interleaved virtual-stage chunk count; same schema-bump-free
+    # addition contract (tuned values are >= 1; "unset" exists only as
+    # the static default 0 in the config layer)
+    "pipeline_microbatches": "MPI4JAX_TPU_PIPELINE_MICROBATCHES",
+    "pipeline_virtual_stages": "MPI4JAX_TPU_PIPELINE_VIRTUAL_STAGES",
 }
 
 # legal tuned codec values for the "compress" knob ("auto" is an env

@@ -26,13 +26,17 @@ from ._base import (  # noqa: F401
 )
 from ._async import (  # noqa: F401
     AsyncHandle,
+    P2PHandle,
     allreduce_start,
     allreduce_wait,
     alltoall_start,
     alltoall_wait,
     overlap,
+    p2p_wait,
+    recv_start,
     reduce_scatter_start,
     reduce_scatter_wait,
+    send_start,
 )
 from ._fusion import set_fusion_mode  # noqa: F401
 from .allgather import allgather  # noqa: F401

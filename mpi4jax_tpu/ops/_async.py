@@ -55,12 +55,16 @@ from .token import Token, consume, produce
 
 __all__ = [
     "AsyncHandle",
+    "P2PHandle",
     "allreduce_start",
     "allreduce_wait",
     "alltoall_start",
     "alltoall_wait",
     "reduce_scatter_start",
     "reduce_scatter_wait",
+    "send_start",
+    "recv_start",
+    "p2p_wait",
     "overlap",
     "overlap_cache_token",
     "overlap_chunk_split",
@@ -121,6 +125,25 @@ class AsyncHandle:
     def __repr__(self):
         state = "waited" if self.waited else "in-flight"
         return (f"AsyncHandle({self.kind}#{self.uid}, mode={self.mode}, "
+                f"{state})")
+
+
+class P2PHandle(AsyncHandle):
+    """In-flight state of one async point-to-point half
+    (``send_start``/``recv_start`` — the pipeline boundary transfers,
+    docs/pipeline.md).  ``kind`` is ``"send"`` or ``"recv"``; the pair
+    closes with :func:`p2p_wait`."""
+
+    __slots__ = ("tag", "pairs")
+
+    def __init__(self, kind, comm, tag):
+        super().__init__(kind, comm, None)
+        self.tag = tag
+        self.pairs = None
+
+    def __repr__(self):
+        state = "waited" if self.waited else "in-flight"
+        return (f"P2PHandle({self.kind}#{self.uid}, tag={self.tag}, "
                 f"{state})")
 
 
@@ -617,6 +640,180 @@ def reduce_scatter_wait(handle, *, token: Optional[Token] = None):
     handle.waited = True
     handle.pieces = None
     return res, tok
+
+
+# ---------------------------------------------------------------------------
+# async point-to-point: send_start / recv_start / p2p_wait
+# ---------------------------------------------------------------------------
+#
+# The pipeline boundary transfers (parallel/pipeline.py, docs/pipeline.md).
+# Semantics mirror the synchronous halves exactly — send_start queues the
+# payload on the region's (comm, tag) FIFO, recv_start pops the match and
+# emits the fused CollectivePermute — but the pair carries the span
+# instrumentation of the collective starts: watchdog arm at the start,
+# disarm at the wait, one events-tier bracket across the gap.  The
+# transfer is EMITTED at recv_start and first USED at p2p_wait, so
+# everything issued between the two (the next microbatch's stage compute)
+# has no data dependency on the wire and overlaps it.  The op names end
+# in ``_start``/``_wait`` deliberately: MPX112 (unpaired span) and MPX130
+# (span straddling a megastep iteration) apply as-is.
+
+
+@enforce_types(tag=int, comm=(Comm, None), token=(Token, None))
+def send_start(x, dest, tag: int = 0, *, comm: Optional[Comm] = None,
+               token: Optional[Token] = None):
+    """Begin an async send of ``x`` along routing ``dest``: queues the
+    payload for the matching ``recv_start`` on the same comm and tag
+    (buffered — the transfer itself is emitted at the receive) and opens
+    the instrumentation span.  Returns ``(handle, token)``; close the
+    span with :func:`p2p_wait` (docs/pipeline.md)."""
+    from ..parallel.rankspec import resolve_routing
+    from ..parallel.region import current_context
+    from ..utils.debug import log_op
+    from ._base import dispatch
+    from .send import PendingSend
+
+    comm = _require_region("send_start", comm)
+    handle = P2PHandle("send", comm, tag)
+
+    def body(comm, arrays, token):
+        from ..analysis.hook import annotate
+        from ..analysis.schedule import concretizing
+
+        arrays, token = _span_open("send", comm, arrays, token, handle)
+        (xl,) = arrays
+        xl = consume(token, xl)
+        handle.shape = xl.shape
+        handle.dtype = xl.dtype
+        pairs = resolve_routing(comm, None, dest, what="send")  # GLOBAL
+        handle.pairs = pairs
+        annotate(pairs=pairs)
+        log_op("MPI_Isend", comm.Get_rank(),
+               f"{xl.size} items along {list(pairs)} (tag {tag})")
+        if not concretizing():
+            # per-rank re-traces record one-sided (the cross-rank
+            # matcher pairs the halves); the real trace queues for the
+            # matching recv_start, exactly like the blocking send
+            ctx = current_context()
+            ctx.queue(comm.uid, tag).append(PendingSend(xl, pairs, token))
+        return xl, produce(token, xl)
+
+    res, tok = dispatch("send_start", comm, body, (x,), token,
+                        ana={"span": handle.uid, "tag": tag}, bare=True)
+    handle.pieces = (res,)
+    return handle, tok
+
+
+@enforce_types(tag=int, comm=(Comm, None), token=(Token, None))
+def recv_start(x, source=None, tag: int = 0, *, comm: Optional[Comm] = None,
+               token: Optional[Token] = None):
+    """Begin an async receive into ``x``'s shape/dtype: pops the matching
+    queued ``send_start``/``send`` (FIFO per (comm, tag);
+    ``source=None`` adopts the send's routing, like ``recv``) and emits
+    the fused CollectivePermute HERE — the result is first *used* at
+    :func:`p2p_wait`, so compute issued in the gap overlaps the wire.
+    Returns ``(handle, token)``."""
+    from ..parallel.rankspec import resolve_routing
+    from ..parallel.region import current_context
+    from ..utils.debug import log_op
+    from ._base import as_varying, dispatch
+    from .recv import _check_recv_match
+    from .sendrecv import _apply_permute
+
+    comm = _require_region("recv_start", comm)
+    handle = P2PHandle("recv", comm, tag)
+
+    def body(comm, arrays, token):
+        from ..analysis.hook import annotate
+        from ..analysis.report import mpx_error
+        from ..analysis.schedule import concretizing
+
+        arrays, token = _span_open("recv", comm, arrays, token, handle)
+        (template,) = arrays
+        handle.shape = template.shape
+        handle.dtype = template.dtype
+        if concretizing():
+            # per-rank schedule trace: record one-sided; the matcher
+            # pairs the transfer at the p2p_wait position (the blocking
+            # point — analysis/schedule.py routes the span there)
+            pairs = (resolve_routing(comm, source, None, what="recv")
+                     if source is not None else None)
+            handle.pairs = pairs
+            annotate(pairs=pairs)
+            res = as_varying(template, comm.axes)
+            return res, produce(token, res)
+        ctx = current_context()
+        q = ctx.queue(comm.uid, tag)
+        if not q:
+            raise mpx_error(
+                RuntimeError, "MPX102",
+                f"recv_start(tag={tag}): no matching send queued on this "
+                "comm. Under SPMD, the matching send/send_start must "
+                "appear earlier in the same parallel region (the "
+                "reference would deadlock here at run time; this "
+                "framework turns it into a trace error).",
+            )
+        if len(q) >= 2:
+            annotate(queue_depth=len(q))
+        pending = q.popleft()
+        _check_recv_match(pending, template, source, comm)
+        annotate(pairs=pending.pairs)
+        handle.pairs = pending.pairs
+        payload = as_varying(consume(token, pending.value), comm.axes)
+        log_op("MPI_Irecv", comm.Get_rank(),
+               f"{payload.size} items along {list(pending.pairs)} "
+               f"(tag {tag})")
+        res = _apply_permute(payload, template, pending.pairs, comm)
+        return res, produce(token, res)
+
+    res, tok = dispatch("recv_start", comm, body, (x,), token,
+                        ana={"span": handle.uid, "tag": tag}, bare=True)
+    handle.pieces = (res,)
+    return handle, tok
+
+
+@enforce_types(token=(Token, None))
+def p2p_wait(handle, *, token: Optional[Token] = None):
+    """Finish an async p2p half: returns ``(value, token)`` — the
+    received payload for a ``recv_start`` handle, the sent payload (a
+    passthrough) for a ``send_start`` handle — and closes the span
+    (watchdog disarm, events bracket end)."""
+    from ..telemetry.core import annotate as t_annotate
+    from ._base import dispatch
+
+    _check_p2p_handle("p2p_wait", handle)
+    comm = handle.comm
+
+    def body(comm, arrays, token):
+        res = consume(token, *arrays)
+        # the payload bytes were accounted at the start half; zero the
+        # wait's link attribution so the pair is not double-counted
+        t_annotate(link_bytes=(0, 0))
+        _span_close(handle, comm, res, [res])
+        return res, produce(token, res)
+
+    res, tok = dispatch("p2p_wait", comm, body, handle.pieces, token,
+                        ana={"span": handle.uid, "tag": handle.tag},
+                        bare=True)
+    handle.waited = True
+    handle.pieces = None
+    return res, tok
+
+
+def _check_p2p_handle(opname: str, handle) -> None:
+    from ..analysis.report import mpx_error
+
+    if not isinstance(handle, P2PHandle):
+        raise TypeError(
+            f"{opname} expects the P2PHandle returned by send_start/"
+            f"recv_start, got {handle!r}"
+        )
+    if handle.waited:
+        raise mpx_error(
+            RuntimeError, "MPX112",
+            f"{opname}: this handle was already waited — each "
+            "send_start/recv_start pairs with exactly one p2p_wait",
+        )
 
 
 def _check_handle(opname: str, handle, kind: str) -> None:

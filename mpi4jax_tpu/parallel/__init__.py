@@ -15,6 +15,10 @@ from .mesh import (  # noqa: F401
     set_default_mesh,
     shrink_world_mesh,
 )
+from .pipeline import (  # noqa: F401
+    PipelineProgram,
+    pipeline,
+)
 from .rankspec import (  # noqa: F401
     invert_pairs,
     normalize_dest,

@@ -1,0 +1,589 @@
+"""Pipeline-parallel schedule compiler: GPipe, 1F1B, interleaved.
+
+The last classic parallelism axis (ROADMAP item 1): one stage of a
+layered model per rank, microbatches wavefronting through the stage
+chain.  ``examples/pipeline_parallel.py``'s hand-rolled ladder showed
+the shape; this module makes it a *compiled schedule* the library owns:
+
+- :func:`compile_phases` — the pure half (no JAX; tests/
+  test_pipeline_pure.py runs it under the isolated loader): per-rank
+  forward/backward micro-op programs for every schedule, the
+  warmup/steady/cooldown tick split the traced driver executes, and the
+  activation-stash bound each schedule needs.  GPipe stashes every
+  microbatch (depth ``M``); 1F1B's early backwards cap the stash at
+  ``min(S, M)`` — the PipeDream-flush memory win (docs/pipeline.md
+  "Activation stash");
+- :class:`PipelineProgram` / :func:`pipeline` — the runnable program.
+  Boundary transfers go through the async point-to-point primitives
+  (``send_start``/``recv_start``/``p2p_wait``, ops/_async.py) under the
+  ``1f1b`` and ``interleaved`` schedules, so the wire overlaps the
+  compute issued inside the span; ``gpipe`` keeps the blocking
+  ``sendrecv`` boundary (the baseline the BENCH grid prices).  The
+  steady-state ticks — the 1F1B core — compose with the megastep
+  compiler (parallel/megastep.py): one device-resident ``fori_loop``
+  dispatch executes the whole steady window, and the MPX130 span rule
+  holds because every start/wait pair lives inside one iteration;
+- ``schedule='auto'`` — the cost model picks: ``costmodel.
+  best_schedule`` prices every expressible schedule with the active
+  alpha-beta model (tuned parameters when ``mpx-tuning/1`` is loaded)
+  and the argmin runs.  Programs annotate their (schedule, stages,
+  microbatches, virtual, payload) onto the event stream, and the MPX144
+  advisory (analysis/cost.py) fires when a run's schedule is priced
+  measurably worse than an expressible alternative.
+
+Interleaved virtual stages (Megatron-style): ``virtual=v`` gives every
+rank ``v`` stage-chunks — rank ``r`` owns virtual stages ``c*S + r`` —
+shrinking the pipeline fill by ``v`` at the price of ``v``x as many
+(1/v-sized) boundary messages.  The driver moves the whole chunk stack
+in one ring transfer per tick.
+
+Run :class:`PipelineProgram` eagerly (``prog(mbs, params)``) and the
+warmup/steady/cooldown phases dispatch separately under host telemetry
+brackets — ``pipeline.stage`` / ``pipeline.bubble_wait`` rows in the
+per-op table plus the bubble-time meters ``telemetry.report()`` turns
+into a MEASURED bubble fraction — or call ``prog.trace(...)`` inside an
+existing region to inline the whole round into a larger program.
+
+Only stdlib at import time (JAX and the ops load inside the drivers),
+so the pure half stays loadable under any JAX.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence, Tuple, Union
+
+__all__ = [
+    "PipelineProgram",
+    "SCHEDULES",
+    "PhasePlan",
+    "compile_phases",
+    "pipeline",
+    "rank_program",
+    "split_microbatches",
+    "stash_depth",
+]
+
+# the expressible schedules; "auto" resolves to one of these via
+# analysis.costmodel.best_schedule (the ladder is the anti-pattern this
+# module replaces — MPX135 points at it, it is never a candidate)
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+# ---------------------------------------------------------------------------
+# the pure half: per-rank micro-op programs + phase split (no JAX)
+# ---------------------------------------------------------------------------
+
+
+def _validate(schedule: str, stages: int, microbatches: int,
+              virtual: int) -> None:
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"pipeline: unknown schedule {schedule!r} "
+            f"(expressible: {SCHEDULES}, plus 'auto')"
+        )
+    if stages < 1:
+        raise ValueError(f"pipeline: stages must be >= 1, got {stages}")
+    if microbatches < 1:
+        raise ValueError(
+            f"pipeline: n_microbatches must be >= 1, got {microbatches}")
+    if virtual < 1:
+        raise ValueError(f"pipeline: virtual must be >= 1, got {virtual}")
+    if schedule == "interleaved" and virtual < 2:
+        raise ValueError(
+            "pipeline: the interleaved schedule needs virtual >= 2 "
+            "stage-chunks per rank (virtual=1 is plain 1f1b)"
+        )
+    if schedule != "interleaved" and virtual != 1:
+        raise ValueError(
+            f"pipeline: virtual={virtual} only applies to the "
+            "interleaved schedule"
+        )
+
+
+def rank_program(schedule: str, stages: int, microbatches: int, rank: int,
+                 virtual: int = 1) -> Tuple[Tuple[str, int, int], ...]:
+    """Rank ``rank``'s ordered micro-op program: ``("F"|"B", microbatch,
+    chunk)`` triples.  The F/B interleaving is what bounds the
+    activation stash (:func:`stash_depth`); the traced driver executes
+    the forward wavefront, and the program is the schedule's training-
+    shaped accounting (docs/pipeline.md "Schedule programs")."""
+    _validate(schedule, stages, microbatches, virtual)
+    if not 0 <= rank < stages:
+        raise ValueError(f"pipeline: rank {rank} out of range for "
+                         f"{stages} stage(s)")
+    s, m, v = stages, microbatches, virtual
+    if schedule == "gpipe":
+        # synchronous flush: every forward, then every backward
+        return tuple([("F", i, 0) for i in range(m)]
+                     + [("B", i, 0) for i in reversed(range(m))])
+    # 1f1b / interleaved: forward items in wavefront completion order
+    # (chunk c of this rank is virtual stage c*S + rank); warmup fills
+    # the pipe below this rank's deepest chunk, then strict one-forward-
+    # one-backward alternation, then the backward drain
+    items = sorted((i + c * s + rank, c, i)
+                   for i in range(m) for c in range(v))
+    fwd = [(i, c) for _t, c, i in items]
+    warmup = min(m * v, (s - 1 - rank) + (v - 1) * s)
+    prog = []
+    done = 0
+    for j, (i, c) in enumerate(fwd):
+        prog.append(("F", i, c))
+        if j >= warmup:
+            prog.append(("B",) + fwd[done])
+            done += 1
+    while done < len(fwd):
+        prog.append(("B",) + fwd[done])
+        done += 1
+    return tuple(prog)
+
+
+def stash_depth(program: Sequence[Tuple[str, int, int]]) -> int:
+    """Peak number of live activation stashes a micro-op program holds
+    (each F pushes its input activation for the matching B)."""
+    depth = peak = 0
+    for op, _i, _c in program:
+        if op == "F":
+            depth += 1
+            peak = max(peak, depth)
+        elif op == "B":
+            depth -= 1
+            if depth < 0:
+                raise ValueError("pipeline: program pops an activation "
+                                 "it never stashed")
+    return peak
+
+
+@dataclass(frozen=True)
+class PhasePlan:
+    """One compiled schedule: the tick split the traced driver executes
+    plus the per-rank stash accounting.
+
+    A forward round is ``ticks = M + P - 1`` wavefront ticks over
+    ``P = S * v`` virtual stages: ``warmup`` ticks fill the pipe
+    (Python-unrolled — early ticks have no valid output row), ``steady``
+    ticks are the full-pipe window ``[P-1, M-1]`` (megastep-eligible:
+    every input and output index is in range, no masks), ``cooldown``
+    ticks drain.  ``max_stash`` is the worst rank's activation-stash
+    bound: ``M`` for gpipe, ``min(S, M)`` for 1f1b — the 1F1B memory
+    claim tests/test_pipeline_pure.py pins.
+    """
+
+    schedule: str
+    stages: int
+    microbatches: int
+    virtual: int
+    warmup: int
+    steady: int
+    cooldown: int
+    ticks: int
+    max_stash: int
+    stash_by_rank: Tuple[int, ...]
+
+
+def compile_phases(schedule: str, stages: int, microbatches: int,
+                   virtual: int = 1) -> PhasePlan:
+    """Compile ``schedule`` over ``stages`` x ``microbatches`` (and
+    ``virtual`` chunks per rank) into its :class:`PhasePlan`."""
+    _validate(schedule, stages, microbatches, virtual)
+    p = stages * virtual
+    ticks = microbatches + p - 1
+    steady = max(0, microbatches - (p - 1))
+    warmup = p - 1
+    cooldown = ticks - warmup - steady
+    stash = tuple(
+        stash_depth(rank_program(schedule, stages, microbatches, r,
+                                 virtual))
+        for r in range(stages)
+    )
+    return PhasePlan(schedule=schedule, stages=stages,
+                     microbatches=microbatches, virtual=virtual,
+                     warmup=warmup, steady=steady, cooldown=cooldown,
+                     ticks=ticks, max_stash=max(stash),
+                     stash_by_rank=stash)
+
+
+def split_microbatches(x, n: Optional[int] = None):
+    """Split a batch-leading array ``(B, ...)`` into ``(M, B/M, ...)``
+    microbatches: ``n`` explicit, else the tuned
+    ``pipeline_microbatches`` knob (mpx-tuning/1, payload-bucketed by
+    the batch's byte size), else 1.  ``B`` must divide evenly."""
+    from ..utils import config
+
+    if n is None:
+        n = config.pipeline_microbatches(payload_bytes=_nbytes_of(x)) or 1
+    n = int(n)
+    b = int(x.shape[0])
+    if n < 1 or b % n:
+        raise ValueError(
+            f"pipeline: cannot split batch of {b} into {n} equal "
+            "microbatch(es)"
+        )
+    return x.reshape((n, b // n) + tuple(x.shape[1:]))
+
+
+def _nbytes_of(x) -> int:
+    n = 1
+    for d in x.shape:
+        n *= int(d)
+    return n * getattr(getattr(x, "dtype", None), "itemsize", 4)
+
+
+# ---------------------------------------------------------------------------
+# the runnable program
+# ---------------------------------------------------------------------------
+
+
+StageFns = Union[Callable, Sequence[Callable]]
+
+
+class PipelineProgram:
+    """A compiled pipeline round: call eagerly (phase-bracketed
+    dispatches) or ``trace`` inside an existing parallel region.
+
+    Eager inputs are global arrays (leading axis = ranks): ``mbs`` is
+    ``(S, M, mb, ...)`` with stage 0's row carrying the real
+    microbatches (:func:`split_microbatches` builds the per-rank view),
+    and the result is ``(S, M, mb, ...)`` whose LAST stage row holds
+    the model output.
+    """
+
+    def __init__(self, stage_fns: StageFns, n_microbatches: Optional[int],
+                 schedule: str, virtual: Optional[int], comm,
+                 megastep: bool):
+        if callable(stage_fns):
+            self._fns: Optional[Tuple[Callable, ...]] = None
+            self._fn: Optional[Callable] = stage_fns
+        else:
+            fns = tuple(stage_fns)
+            if not fns or not all(callable(f) for f in fns):
+                raise TypeError(
+                    "pipeline: stage_fns must be a callable or a "
+                    "non-empty sequence of callables (one per virtual "
+                    "stage-chunk)"
+                )
+            self._fns, self._fn = fns, None
+            if virtual is not None and virtual != len(fns):
+                raise ValueError(
+                    f"pipeline: virtual={virtual} disagrees with "
+                    f"{len(fns)} stage_fns"
+                )
+            virtual = len(fns)
+        if schedule != "auto" and schedule not in SCHEDULES:
+            raise ValueError(
+                f"pipeline: unknown schedule {schedule!r} "
+                f"(expressible: {SCHEDULES}, plus 'auto')"
+            )
+        self._requested = schedule
+        self._n_microbatches = n_microbatches
+        self._virtual = virtual
+        self._comm = comm
+        self._megastep = bool(megastep)
+        self._progs: Dict[tuple, tuple] = {}
+
+    # -- planning ----------------------------------------------------------
+
+    def _resolve_virtual(self, schedule: str) -> int:
+        from ..utils import config
+
+        v = self._virtual
+        if v is None:
+            v = config.pipeline_virtual_stages() or 0
+        if schedule == "interleaved":
+            return max(2, int(v))
+        if schedule == "auto":
+            return max(1, int(v))
+        return 1
+
+    def plan(self, stages: int, microbatches: int, payload_bytes: int
+             ) -> PhasePlan:
+        """Resolve ``schedule='auto'`` through the cost model and compile
+        the phase plan (also the introspection entry the tests and docs
+        use — pure, callable without a device in sight)."""
+        schedule = self._requested
+        virtual = self._resolve_virtual(schedule)
+        if schedule == "auto":
+            from ..analysis import costmodel
+
+            model = costmodel.load_model()
+            # roofline floor for the per-microbatch stage compute: a
+            # stage at minimum streams its boundary activation in and
+            # out (docs/pipeline.md "Choosing a schedule")
+            compute_us = model.compute_us(2 * payload_bytes)
+            schedule, _times = costmodel.best_schedule(
+                stages, microbatches, payload_bytes, compute_us, model,
+                virtual=virtual)
+        return compile_phases(
+            schedule, stages, microbatches,
+            virtual if schedule == "interleaved" else 1)
+
+    def _stamp(self, plan: PhasePlan, payload_bytes: int) -> tuple:
+        return (plan.schedule, plan.stages, plan.microbatches,
+                plan.virtual, payload_bytes)
+
+    # -- the traced driver -------------------------------------------------
+
+    def trace(self, mbs, params, *, token=None):
+        """Run one pipeline round inside the CURRENT parallel region.
+
+        ``mbs`` is the per-rank microbatch view ``(M, mb, ...)`` (stage
+        0's lanes real); returns ``(out, token)`` with ``out`` of shape
+        ``(M, mb, ...)`` — the last rank's lanes are the model output.
+        """
+        import jax.numpy as jnp
+
+        from .region import current_context
+
+        comm = self._comm if self._comm is not None else \
+            current_context().comm
+        stages = comm.Get_size()
+        m = int(mbs.shape[0])
+        if self._n_microbatches is not None and \
+                int(self._n_microbatches) != m:
+            raise ValueError(
+                f"pipeline: n_microbatches={self._n_microbatches} but "
+                f"the input carries {m} microbatch(es); split the batch "
+                "with mpx.parallel.pipeline.split_microbatches"
+            )
+        plan = self.plan(stages, m, _nbytes_of(mbs[0]))
+        ticks = _TickDriver(self, plan, comm, mbs, params)
+        h = jnp.stack([jnp.zeros_like(mbs[0])] * plan.virtual)
+        out = jnp.zeros(mbs.shape, mbs.dtype)
+        h, out, token = ticks.run(0, plan.warmup, h, out, token,
+                                  use_megastep=False)
+        h, out, token = ticks.run(plan.warmup, plan.warmup + plan.steady,
+                                  h, out, token,
+                                  use_megastep=self._megastep)
+        h, out, token = ticks.run(plan.warmup + plan.steady, plan.ticks,
+                                  h, out, token, use_megastep=False)
+        return out, token
+
+    # -- the eager phase driver --------------------------------------------
+
+    def __call__(self, mbs, params):
+        """One eagerly-dispatched pipeline round: warmup, steady, and
+        cooldown run as separate dispatches under ``pipeline.{phase}``
+        host telemetry brackets, so the MEASURED bubble share (warmup +
+        cooldown wall over total) lands in ``telemetry.report()``."""
+        import jax.numpy as jnp
+
+        from .region import resolve_comm
+
+        comm = resolve_comm(self._comm)
+        stages = comm.Get_size()
+        if int(mbs.shape[0]) != stages:
+            raise ValueError(
+                f"pipeline: global input leading axis {mbs.shape[0]} != "
+                f"comm size {stages} (one stage per rank)"
+            )
+        m = int(mbs.shape[1])
+        if self._n_microbatches is not None and \
+                int(self._n_microbatches) != m:
+            raise ValueError(
+                f"pipeline: n_microbatches={self._n_microbatches} but "
+                f"the input carries {m} microbatch(es)"
+            )
+        nbytes = _nbytes_of(mbs[0, 0])
+        plan = self.plan(stages, m, nbytes)
+        warm, steady, cool = self._phase_progs(comm, plan)
+        h = jnp.zeros((stages, plan.virtual) + tuple(mbs.shape[2:]),
+                      mbs.dtype)
+        out = jnp.zeros(mbs.shape, mbs.dtype)
+        with _phase_bracket(comm, plan, "bubble_wait", nbytes):
+            h, out = warm(mbs, h, out, params)
+        if steady is not None:
+            with _phase_bracket(comm, plan, "stage", nbytes):
+                h, out = steady(mbs, h, out, params)
+        with _phase_bracket(comm, plan, "bubble_wait", nbytes):
+            h, out = cool(mbs, h, out, params)
+        return out
+
+    def _phase_progs(self, comm, plan: PhasePlan):
+        from .region import spmd
+
+        key = (comm.uid, plan)
+        cached = self._progs.get(key)
+        if cached is not None:
+            return cached
+
+        def phase_fn(lo, hi, use_megastep):
+            def run(mbs, h, out, params):
+                ticks = _TickDriver(self, plan, comm, mbs, params)
+                h2, out2, _ = ticks.run(lo, hi, h, out, None,
+                                        use_megastep=use_megastep)
+                return h2, out2
+
+            return spmd(run, comm=comm)
+
+        warm = phase_fn(0, plan.warmup, False)
+        steady = None
+        if plan.steady:
+            steady = phase_fn(plan.warmup, plan.warmup + plan.steady,
+                              self._megastep)
+        cool = phase_fn(plan.warmup + plan.steady, plan.ticks, False)
+        progs = (warm, steady, cool)
+        self._progs[key] = progs
+        return progs
+
+
+class _TickDriver:
+    """The shared tick machinery of one pipeline round (per-rank view):
+    built fresh per trace, drives any ``[lo, hi)`` window of the plan's
+    ticks, Python-unrolled or as one megastep ``fori_loop``."""
+
+    def __init__(self, prog: PipelineProgram, plan: PhasePlan, comm,
+                 mbs, params):
+        self.prog, self.plan, self.comm = prog, plan, comm
+        self.mbs, self.params = mbs, params
+        self.stamped = False
+
+    def _chunk_fn(self, c: int):
+        prog, v = self.prog, self.plan.virtual
+        if prog._fns is not None:
+            return lambda x: prog._fns[c](x, self.params)
+        if v == 1:
+            return lambda x: prog._fn(x, self.params)
+        import jax
+
+        pc = jax.tree.map(lambda leaf: leaf[c], self.params)
+        return lambda x: prog._fn(x, pc)
+
+    def _mark(self):
+        if self.stamped:
+            return
+        self.stamped = True
+        from ..analysis.hook import mark_last_event
+        from .region import current_context
+
+        stamp = self.prog._stamp(self.plan, _nbytes_of(self.mbs[0]))
+        mark_last_event("pipeline", stamp, current_context())
+
+    def _boundary(self, h, tok):
+        from ..ops._async import p2p_wait, recv_start, send_start
+        from ..ops.sendrecv import sendrecv
+        from .rankspec import shift
+
+        # interleaved boundaries form a ring (the last rank's chunk-c
+        # output is rank 0's chunk-(c+1) input); a flat pipe stops at
+        # the edge
+        dest = shift(1, wrap=self.plan.virtual > 1)
+        if self.plan.schedule == "gpipe":
+            got, tok = sendrecv(h, h, dest=dest, token=tok)
+            self._mark()
+            return got, tok
+        # async boundary: the transfer is emitted at recv_start and
+        # first used at the wait, so the input gather and the stash
+        # bookkeeping between them overlap the wire
+        sh, tok = send_start(h, dest, token=tok)
+        rh, tok = recv_start(h, token=tok)
+        got, tok = p2p_wait(rh, token=tok)
+        self._mark()
+        _, tok = p2p_wait(sh, token=tok)
+        return got, tok
+
+    def _advance(self, h, got, feed):
+        import jax.numpy as jnp
+
+        rank = self.comm.Get_rank()
+        v = self.plan.virtual
+        # chunk c's input: the upstream stage's output — got[c] from
+        # rank r-1, except rank 0 where the ring delivers the last
+        # rank's chunk c-1 (and chunk 0 eats the fresh microbatch)
+        shifted = jnp.concatenate([feed[None], got[:-1]], axis=0) \
+            if v > 1 else feed[None]
+        inp = jnp.where(rank == 0, shifted, got)
+        return jnp.stack([self._chunk_fn(c)(inp[c]) for c in range(v)])
+
+    def _tick_py(self, t: int, h, out, tok):
+        import jax.numpy as jnp
+
+        plan = self.plan
+        p = plan.stages * plan.virtual
+        got, tok = self._boundary(h, tok)
+        feed = self.mbs[t] if t < plan.microbatches \
+            else jnp.zeros_like(self.mbs[0])
+        h = self._advance(h, got, feed)
+        if t >= p - 1:
+            out = out.at[t - (p - 1)].set(h[plan.virtual - 1])
+        return h, out, tok
+
+    def _tick_traced(self, t, h, out, tok):
+        from jax import lax
+
+        plan = self.plan
+        p = plan.stages * plan.virtual
+        got, tok = self._boundary(h, tok)
+        feed = lax.dynamic_index_in_dim(self.mbs, t, 0, keepdims=False)
+        h = self._advance(h, got, feed)
+        out = lax.dynamic_update_index_in_dim(out, h[plan.virtual - 1],
+                                              t - (p - 1), 0)
+        return h, out, tok
+
+    def run(self, lo: int, hi: int, h, out, tok, *, use_megastep: bool):
+        if hi <= lo:
+            return h, out, tok
+        if use_megastep and hi - lo > 1:
+            from .megastep import megastep_loop
+
+            def one(i, carry):
+                hh, oo = carry
+                hh, oo, _ = self._tick_traced(i + lo, hh, oo, None)
+                return hh, oo
+
+            h, out = megastep_loop(
+                one, (h, out), hi - lo, self.comm,
+                label=f"pipeline[{self.plan.schedule}]")
+            return h, out, tok
+        for t in range(lo, hi):
+            h, out, tok = self._tick_py(t, h, out, tok)
+        return h, out, tok
+
+
+def _phase_bracket(comm, plan: PhasePlan, phase: str, nbytes: int):
+    """Serving-style host bracket around one phase dispatch: a
+    ``pipeline.{phase}`` row in the per-op table, a latency sample, and
+    the integer-microsecond bubble/stage meters ``telemetry.report()``
+    folds into the measured bubble fraction."""
+    import contextlib
+
+    from ..telemetry import core as tcore
+
+    @contextlib.contextmanager
+    def bracket():
+        if tcore.effective_mode() == "off":
+            yield
+            return
+        key = tcore.op_key(f"pipeline.{phase}", comm.uid,
+                           plan.schedule, "")
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            tcore.count_host_op(key, nbytes)
+            tcore.record_latency(key, dt)
+            tcore.meter(f"pipeline.{phase}_us", max(0, int(dt * 1e6)))
+            if phase == "stage":
+                tcore.meter("pipeline.rounds")
+
+    return bracket()
+
+
+def pipeline(stage_fns: StageFns, n_microbatches: Optional[int] = None,
+             schedule: str = "auto", *, virtual: Optional[int] = None,
+             comm=None, megastep: bool = True) -> PipelineProgram:
+    """Compile a pipeline-parallel round over the comm's ranks (one
+    stage per rank; ``virtual`` stage-chunks per rank under the
+    interleaved schedule).  See docs/pipeline.md.
+
+    ``stage_fns`` is one ``f(h, params)`` callable (with ``virtual=v >
+    1`` every params leaf carries a leading chunk axis) or a sequence of
+    per-chunk callables.  ``schedule`` is ``'auto'`` (the cost model
+    picks — tuned parameters when a tuning file is active), ``'gpipe'``,
+    ``'1f1b'``, or ``'interleaved'``.  ``megastep=False`` keeps the
+    steady state Python-unrolled (debugging; the compiled program is the
+    point).
+    """
+    return PipelineProgram(stage_fns, n_microbatches, schedule, virtual,
+                           comm, megastep)

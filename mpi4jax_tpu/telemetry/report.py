@@ -278,6 +278,26 @@ def render(snaps: List[dict]) -> str:
                            ("drain re-admissions", "readmissions")):
             if key in srv:
                 lines.append(f"  {label:<22} {srv[key]:>10}")
+    # pipeline section (docs/pipeline.md): the MEASURED bubble story the
+    # modeled MPX144/MPX135 advisories cannot carry — host-bracket time
+    # inside the steady-state rounds ("stage") vs the warmup/cooldown
+    # phases ("bubble_wait"), summed across processes, and the measured
+    # bubble fraction they imply
+    pipe = {name[len("pipeline."):]: n for name, n in total_meters.items()
+            if name.startswith("pipeline.")}
+    if pipe:
+        lines.append("")
+        lines.append("pipeline:")
+        for label, key in (("steady rounds", "rounds"),
+                           ("stage time (us)", "stage_us"),
+                           ("bubble wait (us)", "bubble_wait_us")):
+            if key in pipe:
+                lines.append(f"  {label:<22} {pipe[key]:>10}")
+        stage_us = pipe.get("stage_us", 0)
+        bubble_us = pipe.get("bubble_wait_us", 0)
+        if stage_us + bubble_us > 0:
+            frac = bubble_us / float(stage_us + bubble_us)
+            lines.append(f"  {'bubble fraction':<22} {frac:>10.1%}")
     epochs = {}
     for snap in snaps:
         for rec in snap.get("epochs", ()):

@@ -138,6 +138,14 @@ DEFAULT_COMPRESS_ERROR_BUDGET = 1e-2
 # same double-buffering default as MPI4JAX_TPU_OVERLAP_CHUNKS.
 DEFAULT_MOE_CAPACITY_CHUNKS = 2
 
+# pipeline-parallel schedule knobs (parallel/pipeline.py).  0 means
+# "unset": split_microbatches falls back to no splitting and
+# PipelineProgram derives the interleaved virtual-stage count from the
+# stage-function list.  Tuned values (mpx-tuning/1 knob records) are
+# always >= 1.
+DEFAULT_PIPELINE_MICROBATCHES = 0
+DEFAULT_PIPELINE_VIRTUAL_STAGES = 0
+
 FLAGS = {
     f.name: f
     for f in (
@@ -310,6 +318,23 @@ FLAGS = {
              "(alltoall_start) overlaps chunk i+1's expert MLP.  1 "
              "disables the overlap pipeline (one synchronous combine).  "
              "Default 2 (docs/moe.md)."),
+        Flag("MPI4JAX_TPU_PIPELINE_MICROBATCHES", "int",
+             DEFAULT_PIPELINE_MICROBATCHES,
+             "Microbatch count of the pipeline schedule compiler "
+             "(``mpx.pipeline``, parallel/pipeline.py): "
+             "``split_microbatches`` slices the global batch into this "
+             "many microbatches when no explicit count is passed.  0 "
+             "(default) means unset — the tuned ``pipeline_microbatches`` "
+             "knob applies if a tuning file is loaded, else no split "
+             "(docs/pipeline.md)."),
+        Flag("MPI4JAX_TPU_PIPELINE_VIRTUAL_STAGES", "int",
+             DEFAULT_PIPELINE_VIRTUAL_STAGES,
+             "Virtual stage-chunk count per rank of the interleaved "
+             "pipeline schedule (``mpx.pipeline(..., "
+             "schedule='interleaved')``).  0 (default) means unset — the "
+             "tuned ``pipeline_virtual_stages`` knob applies if a tuning "
+             "file is loaded, else the count is derived from the "
+             "stage-function list (docs/pipeline.md)."),
         Flag("MPI4JAX_TPU_ANALYZE", "choice", "off",
              "Trace-time collective verifier (analysis/): ``warn`` runs "
              "the MPX checkers over every spmd region / eager op as it "
@@ -665,6 +690,8 @@ def tuning_snapshot() -> Optional[dict]:
         "fusion_bucket_bytes": DEFAULT_FUSION_BUCKET_BYTES,
         "overlap_chunks": DEFAULT_OVERLAP_CHUNKS,
         "compress": "off",
+        "pipeline_microbatches": DEFAULT_PIPELINE_MICROBATCHES,
+        "pipeline_virtual_stages": DEFAULT_PIPELINE_VIRTUAL_STAGES,
     }
     getters = {
         "ring_crossover_bytes": ring_crossover_bytes,
@@ -673,6 +700,8 @@ def tuning_snapshot() -> Optional[dict]:
         "fusion_bucket_bytes": fusion_bucket_bytes,
         "overlap_chunks": overlap_chunks,
         "compress": compress_mode,
+        "pipeline_microbatches": pipeline_microbatches,
+        "pipeline_virtual_stages": pipeline_virtual_stages,
     }
     knobs = {}
     for name, flag in KNOB_FLAGS.items():
@@ -999,6 +1028,28 @@ def moe_capacity_chunks() -> int:
     return _parse_env_positive_int(
         "MPI4JAX_TPU_MOE_CAPACITY_CHUNKS", DEFAULT_MOE_CAPACITY_CHUNKS,
         minimum=1,
+    )
+
+
+def pipeline_microbatches(payload_bytes: Optional[int] = None) -> int:
+    """Microbatch count of the pipeline schedule compiler
+    (``MPI4JAX_TPU_PIPELINE_MICROBATCHES``; default 0 = unset — see
+    parallel/pipeline.py and docs/pipeline.md; a tuning layer's measured
+    value applies when the flag is not explicitly set)."""
+    return _env_or_tuned(
+        "MPI4JAX_TPU_PIPELINE_MICROBATCHES", "pipeline_microbatches",
+        DEFAULT_PIPELINE_MICROBATCHES, payload_bytes=payload_bytes,
+    )
+
+
+def pipeline_virtual_stages(payload_bytes: Optional[int] = None) -> int:
+    """Virtual stage-chunk count per rank of the interleaved pipeline
+    schedule (``MPI4JAX_TPU_PIPELINE_VIRTUAL_STAGES``; default 0 = unset
+    — see parallel/pipeline.py and docs/pipeline.md; a tuning layer's
+    measured value applies when the flag is not explicitly set)."""
+    return _env_or_tuned(
+        "MPI4JAX_TPU_PIPELINE_VIRTUAL_STAGES", "pipeline_virtual_stages",
+        DEFAULT_PIPELINE_VIRTUAL_STAGES, payload_bytes=payload_bytes,
     )
 
 

@@ -267,15 +267,16 @@ def test_docs_list_every_registered_flag():
     """Docs-sync: each declared flag must appear in the docs flag tables
     (docs/usage.md, docs/resilience.md, docs/observability.md,
     docs/overlap.md, docs/topology.md, docs/aot.md, docs/autotune.md,
-    docs/serving.md, docs/moe.md, or docs/compression.md) — a flag
-    without documentation is indistinguishable from an undocumented
-    sharp bit."""
+    docs/serving.md, docs/moe.md, docs/compression.md, or
+    docs/pipeline.md) — a flag without documentation is
+    indistinguishable from an undocumented sharp bit."""
     config = _load_config()
     docs = "\n".join(
         (REPO / "docs" / f).read_text()
         for f in ("usage.md", "resilience.md", "observability.md",
                   "overlap.md", "topology.md", "aot.md", "autotune.md",
-                  "serving.md", "moe.md", "compression.md")
+                  "serving.md", "moe.md", "compression.md",
+                  "pipeline.md")
     )
     missing = [name for name in config.FLAGS if name not in docs]
     assert not missing, (
@@ -283,5 +284,6 @@ def test_docs_list_every_registered_flag():
         "tables (docs/usage.md / docs/resilience.md / "
         "docs/observability.md / docs/overlap.md / docs/topology.md / "
         "docs/aot.md / docs/autotune.md / docs/serving.md / "
-        "docs/moe.md / docs/compression.md): " + ", ".join(missing)
+        "docs/moe.md / docs/compression.md / docs/pipeline.md): "
+        + ", ".join(missing)
     )

@@ -1,0 +1,349 @@
+"""Traced half of the pipeline schedule suite (docs/pipeline.md):
+real 8-device rounds through ``mpx.pipeline`` on the virtual CPU mesh.
+
+- every schedule (gpipe / 1f1b / interleaved / auto) bit-identical to
+  the sequential single-device reference — the eager phase driver AND
+  ``PipelineProgram.trace`` composed inside an existing region (whose
+  1F1B steady window compiles through the megastep ``fori_loop``);
+- the async p2p primitives inside megastep loops: a wildcard
+  ``recv_start(source=None)`` ring under ``unroll=N`` matches N eager
+  steps bit for bit and analyzes clean (the PR 7 FIFO-adoption rule at
+  exactly the spot 1F1B steady state lives), while a send span with no
+  wait in the iteration is MPX130;
+- MPX144 through ``mpx.analyze(cost=True)``: a forced ``gpipe`` round
+  at a 1f1b-favored shape fires the mispick advisory citing both bubble
+  fractions; the 1f1b round at the same shape stays quiet;
+- the eager phase driver's host telemetry: ``pipeline.stage`` /
+  ``pipeline.bubble_wait`` brackets, the ``pipeline.*_us`` meters, and
+  the measured "bubble fraction" line in ``telemetry.report()``.
+
+The pure half (schedule programs, stash bounds, wall-time formulas,
+``build_schedule`` p2p roles, the MPX144 checker on hand-built
+schedules) runs under any JAX in tests/test_pipeline_pure.py via the
+isolated loader.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as mpx
+from mpi4jax_tpu.parallel.pipeline import split_microbatches
+from mpi4jax_tpu.resilience import elastic as el
+from mpi4jax_tpu.resilience import runtime as resilience_runtime
+
+UNROLL = 4
+DIM = 4
+MICRO = 16  # microbatches: > stages, so the flat schedules have a
+            # steady window for the megastep compiler to own
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    el._reset_epoch_for_tests()
+    mpx.set_default_mesh(None)
+    mpx.clear_caches()
+    yield
+    mpx.set_telemetry_mode(None)
+    mpx.set_analyze_mode(None)
+    mpx.set_fusion_mode(None)
+    resilience_runtime.reset_overrides()
+    el._reset_epoch_for_tests()
+    mpx.set_default_mesh(None)
+    mpx.clear_caches()
+    from mpi4jax_tpu.parallel import region as _region
+
+    _region._default_comm = None
+
+
+def _world_comm():
+    mesh = mpx.make_world_mesh()
+    return mpx.Comm(mesh.axis_names[0], mesh=mesh)
+
+
+def _substage(h, w):
+    return jnp.tanh(h @ w)
+
+
+def _reference(x0, ws_flat, m):
+    """Sequential single-device model: every substage in order, applied
+    per-microbatch so the pipelined variants (which compute on
+    microbatch-sized slices) pin bit-identical, not just allclose."""
+    mbs = split_microbatches(x0, m)
+    outs = []
+    for i in range(m):
+        h = mbs[i]
+        for k in range(ws_flat.shape[0]):
+            h = _substage(h, ws_flat[k])
+        outs.append(h)
+    return np.asarray(jnp.stack(outs))
+
+
+def _problem(comm, virtual=1):
+    """A (stages * virtual)-substage model + its global pipeline view:
+    ``mbs`` is ``(S, M, mb, DIM)`` with stage 0's row real, ``ws`` is
+    rank r's substage stack (chunk c of rank r = substage c*S + r)."""
+    s = comm.Get_size()
+    rng = np.random.default_rng(7)
+    x0 = jnp.asarray(rng.normal(size=(MICRO, DIM)), jnp.float32)
+    ws_flat = jnp.asarray(rng.normal(size=(s * virtual, DIM, DIM)) * 0.5,
+                          jnp.float32)
+    mbs = jnp.zeros((s, MICRO, 1, DIM), jnp.float32).at[0].set(
+        split_microbatches(x0, MICRO))
+    if virtual == 1:
+        ws = ws_flat
+    else:
+        ws = ws_flat.reshape(virtual, s, DIM, DIM).transpose(1, 0, 2, 3)
+    want = _reference(x0, ws_flat, MICRO)
+    return mbs, ws, want
+
+
+def _check(prog, mbs, ws, want, label):
+    got = np.asarray(prog(mbs, ws))
+    np.testing.assert_array_equal(
+        got[-1], want,
+        err_msg=f"schedule {label!r} diverged from the reference")
+
+
+# ---------------------------------------------------------------------------
+# schedule bit-identity: eager phase driver vs the sequential reference
+# ---------------------------------------------------------------------------
+
+
+def test_gpipe_matches_sequential_reference():
+    comm = _world_comm()
+    mbs, ws, want = _problem(comm)
+    prog = mpx.pipeline(_substage, MICRO, schedule="gpipe", comm=comm)
+    _check(prog, mbs, ws, want, "gpipe")
+
+
+def test_1f1b_matches_sequential_reference():
+    comm = _world_comm()
+    mbs, ws, want = _problem(comm)
+    # megastep on (the default): the steady window is one fori_loop
+    # dispatch, every send_start/recv_start/p2p_wait span inside one
+    # iteration
+    prog = mpx.pipeline(_substage, MICRO, schedule="1f1b", comm=comm)
+    plan = prog.plan(comm.Get_size(), MICRO, DIM * 4)
+    assert plan.steady == MICRO - (comm.Get_size() - 1)
+    _check(prog, mbs, ws, want, "1f1b")
+
+
+def test_1f1b_megastep_off_is_bit_identical_too():
+    comm = _world_comm()
+    mbs, ws, want = _problem(comm)
+    prog = mpx.pipeline(_substage, MICRO, schedule="1f1b", comm=comm,
+                        megastep=False)
+    _check(prog, mbs, ws, want, "1f1b[megastep=False]")
+
+
+def test_interleaved_virtual2_matches_sequential_reference():
+    comm = _world_comm()
+    mbs, ws, want = _problem(comm, virtual=2)
+    prog = mpx.pipeline(_substage, MICRO, schedule="interleaved",
+                        virtual=2, comm=comm)
+    _check(prog, mbs, ws, want, "interleaved")
+
+
+def test_auto_resolves_through_cost_model_and_matches_reference():
+    comm = _world_comm()
+    mbs, ws, want = _problem(comm)
+    prog = mpx.pipeline(_substage, MICRO, comm=comm)  # schedule='auto'
+    plan = prog.plan(comm.Get_size(), MICRO, DIM * 4)
+    assert plan.schedule in ("gpipe", "1f1b")  # resolved, never 'auto'
+    _check(prog, mbs, ws, want, "auto")
+
+
+def test_trace_composes_inside_region():
+    comm = _world_comm()
+    mbs, ws, want = _problem(comm)
+    prog = mpx.pipeline(_substage, MICRO, schedule="1f1b", comm=comm)
+
+    @mpx.spmd(comm=comm)
+    def round_fn(m, w):
+        out, _tok = prog.trace(m, w)
+        return out
+
+    got = np.asarray(round_fn(mbs, ws))
+    np.testing.assert_array_equal(got[-1], want)
+    # and the composed round is analyzer-clean: every p2p span opens
+    # and closes inside one steady-loop iteration
+    report = mpx.analyze(round_fn, mbs, ws)
+    bad = [f for f in report.findings
+           if f.code in ("MPX112", "MPX130")]
+    assert not bad, report.render()
+
+
+# ---------------------------------------------------------------------------
+# async p2p inside megastep loops: wildcard adoption + span rules
+# ---------------------------------------------------------------------------
+
+
+def _ring_step(comm):
+    n = comm.Get_size()
+    ring = tuple(((i, (i + 1) % n)) for i in range(n))
+
+    def step(v):
+        # send_start queues the payload; the wildcard recv_start
+        # (source=None) adopts the queued send's ring routing — the
+        # exact FIFO-adoption rule 1F1B steady state leans on
+        sh, tok = mpx.send_start(v, ring)
+        rh, tok = mpx.recv_start(v, token=tok)
+        got, tok = mpx.p2p_wait(rh, token=tok)
+        _, tok = mpx.p2p_wait(sh, token=tok)
+        return got * 0.5 + v * 0.25
+
+    return step
+
+
+def test_wildcard_recv_adoption_inside_megastep_bit_identity():
+    comm = _world_comm()
+    k = comm.Get_size()
+    step = _ring_step(comm)
+    x = jnp.arange(k * DIM, dtype=jnp.float32).reshape(k, DIM) * 0.125
+
+    out = x
+    eager = mpx.spmd(step, comm=comm)
+    for _ in range(UNROLL):
+        out = eager(out)
+    want = np.asarray(out)
+
+    pinned = mpx.spmd(step, comm=comm, unroll=UNROLL)
+    np.testing.assert_array_equal(want, np.asarray(pinned(x)))
+
+
+def test_p2p_spans_inside_megastep_analyze_clean():
+    comm = _world_comm()
+    step = _ring_step(comm)
+    k = comm.Get_size()
+    x = jnp.ones((k, DIM), jnp.float32)
+    report = mpx.analyze(mpx.spmd(step, comm=comm, unroll=UNROLL), x)
+    assert not any(f.code in ("MPX112", "MPX130") for f in
+                   report.findings), report.render()
+
+
+def test_p2p_span_straddling_megastep_boundary_is_mpx130():
+    comm = _world_comm()
+    n = comm.Get_size()
+    ring = tuple(((i, (i + 1) % n)) for i in range(n))
+
+    def straddling(v):
+        # a send span opened in the iteration with no p2p_wait: the
+        # span straddles the loop boundary by construction
+        _sh, _tok = mpx.send_start(v, ring)
+        return mpx.varying(v * 1.0)
+
+    x = jnp.ones((n, DIM), jnp.float32)
+    bad = mpx.spmd(straddling, comm=comm, unroll=UNROLL)
+    report = mpx.analyze(bad, x)
+    assert any(f.code == "MPX130" for f in report.findings), \
+        report.render()
+
+
+# ---------------------------------------------------------------------------
+# MPX144: the schedule-mispick advisory end to end
+# ---------------------------------------------------------------------------
+
+# 8 stages x 8 microbatches x 64 KiB boundary payload: the cost model
+# prices gpipe >10% over 1f1b there (tests/test_pipeline_pure.py pins
+# the formula-level margin), so a forced gpipe round is a mispick.
+_MISPICK_M = 8
+_MISPICK_MB, _MISPICK_DIM = 64, 256  # 64 * 256 * 4 B = 64 KiB
+
+
+def _mispick_round(comm, schedule):
+    prog = mpx.pipeline(_substage, _MISPICK_M, schedule=schedule,
+                        comm=comm)
+
+    def round_fn(m, w):
+        out, _tok = prog.trace(m, w)
+        return out
+
+    return round_fn
+
+
+def _mispick_analyze(comm, schedule):
+    # abstract templates: analyze re-traces, nothing executes, so the
+    # 64 KiB-per-boundary shape costs no memory
+    s = comm.Get_size()
+    mbs = jax.ShapeDtypeStruct(
+        (s, _MISPICK_M, _MISPICK_MB, _MISPICK_DIM), jnp.float32)
+    ws = jax.ShapeDtypeStruct((s, _MISPICK_DIM, _MISPICK_DIM),
+                              jnp.float32)
+    return mpx.analyze(_mispick_round(comm, schedule), mbs, ws,
+                       comm=comm, ranks="all", cost=True)
+
+
+def test_mpx144_fires_on_mispicked_gpipe_round():
+    comm = _world_comm()
+    report = _mispick_analyze(comm, "gpipe")
+    hits = [f for f in report.findings if f.code == "MPX144"]
+    assert hits, report.render()
+    f = hits[0]
+    assert "'gpipe'" in f.message
+    assert "'1f1b'" in f.message
+    assert "bubble fraction" in f.message
+    assert "schedule='auto'" in f.suggestion
+    from mpi4jax_tpu.analysis import CODES
+
+    assert CODES["MPX144"].severity == "advisory"
+
+
+def test_mpx144_quiet_when_the_schedule_is_the_argmin():
+    comm = _world_comm()
+    report = _mispick_analyze(comm, "1f1b")
+    assert not any(f.code == "MPX144" for f in report.findings), \
+        report.render()
+
+
+# ---------------------------------------------------------------------------
+# telemetry: phase brackets, meters, and the measured bubble fraction
+# ---------------------------------------------------------------------------
+
+
+def test_eager_phases_meter_the_bubble_and_report_renders_it():
+    mpx.telemetry.reset()
+    mpx.set_telemetry_mode("counters")
+    try:
+        comm = _world_comm()
+        mbs, ws, want = _problem(comm)
+        prog = mpx.pipeline(_substage, MICRO, schedule="1f1b", comm=comm)
+        got = prog(mbs, ws)
+        jax.block_until_ready(got)
+        np.testing.assert_array_equal(np.asarray(got)[-1], want)
+
+        snap = mpx.telemetry.snapshot()
+        meters = snap["meters"]
+        assert meters.get("pipeline.rounds", 0) >= 1, meters
+        assert meters.get("pipeline.stage_us", 0) > 0, meters
+        assert "pipeline.bubble_wait_us" in meters, meters
+
+        from mpi4jax_tpu.telemetry.core import op_key
+
+        stage_key = op_key("pipeline.stage", comm.uid, "1f1b", "")
+        wait_key = op_key("pipeline.bubble_wait", comm.uid, "1f1b", "")
+        assert snap["ops"][stage_key]["calls"] == 1, snap["ops"].keys()
+        # warmup + cooldown: two bubble_wait dispatches per round
+        assert snap["ops"][wait_key]["calls"] == 2
+
+        from mpi4jax_tpu.telemetry import report as treport
+
+        text = treport.render([snap])
+        assert "pipeline:" in text
+        assert "bubble fraction" in text
+    finally:
+        mpx.set_telemetry_mode(None)
+        mpx.telemetry.reset()
+
+
+def test_telemetry_off_adds_no_pipeline_meters():
+    mpx.telemetry.reset()
+    comm = _world_comm()
+    mbs, ws, _want = _problem(comm)
+    prog = mpx.pipeline(_substage, MICRO, schedule="gpipe", comm=comm)
+    jax.block_until_ready(prog(mbs, ws))
+    snap = mpx.telemetry.snapshot()
+    assert not any(k.startswith("pipeline.") for k in snap["meters"]), \
+        snap["meters"]

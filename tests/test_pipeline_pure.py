@@ -1,0 +1,481 @@
+"""Pipeline schedule compiler: the pure-Python half (docs/pipeline.md).
+
+The per-rank micro-op programs and their activation-stash bounds, the
+warmup/steady/cooldown phase split, the bubble-time formulas and
+``best_schedule`` argmin, the schedule builder's async point-to-point
+extension (``send_start``/``recv_start``/``p2p_wait`` roles, wildcard
+FIFO adoption, span matching — including inside megastep loop bodies),
+the MPX144 schedule-mispick critic, the upgraded MPX135 advisory text,
+and the ``pipeline_microbatches``/``pipeline_virtual_stages`` knob
+plumbing — all loaded under a private package name (the
+tests/test_analysis_pure.py isolated loader) so everything here runs
+even where the installed JAX is below the package's floor.  The traced
+integration half — real 8-device rounds through ``mpx.pipeline`` —
+lives in tests/test_pipeline.py.
+"""
+
+import importlib
+import pathlib
+import sys
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "mpi4jax_tpu"
+
+_ISO_NAME = "_mpx_pipeline_iso"
+
+
+def _load_isolated():
+    if _ISO_NAME in sys.modules:
+        return sys.modules[_ISO_NAME]
+    root = types.ModuleType(_ISO_NAME)
+    root.__path__ = [str(PKG)]
+    sys.modules[_ISO_NAME] = root
+    for sub in ("utils", "analysis", "ops", "parallel", "autotune"):
+        m = types.ModuleType(f"{_ISO_NAME}.{sub}")
+        m.__path__ = [str(PKG / sub)]
+        sys.modules[f"{_ISO_NAME}.{sub}"] = m
+        setattr(root, sub, m)
+    for mod in ("utils.config", "ops._fusion", "ops._algos",
+                "ops._hierarchy", "analysis.report", "analysis.graph",
+                "analysis.checkers", "analysis.schedule",
+                "analysis.matcher", "analysis.progress",
+                "analysis.costmodel", "analysis.cost",
+                "parallel.topology", "parallel.pipeline",
+                "autotune.schema"):
+        importlib.import_module(f"{_ISO_NAME}.{mod}")
+    return root
+
+
+ISO = _load_isolated()
+config = sys.modules[f"{_ISO_NAME}.utils.config"]
+cm = sys.modules[f"{_ISO_NAME}.analysis.costmodel"]
+cost = sys.modules[f"{_ISO_NAME}.analysis.cost"]
+graph = sys.modules[f"{_ISO_NAME}.analysis.graph"]
+schedule = sys.modules[f"{_ISO_NAME}.analysis.schedule"]
+matcher = sys.modules[f"{_ISO_NAME}.analysis.matcher"]
+progress = sys.modules[f"{_ISO_NAME}.analysis.progress"]
+pipe = sys.modules[f"{_ISO_NAME}.parallel.pipeline"]
+schema = sys.modules[f"{_ISO_NAME}.autotune.schema"]
+
+S = schedule.SchedOp
+E = graph.CollectiveEvent
+MODEL = cm.CostModel()
+
+
+def verify(schedules):
+    m = matcher.match_schedules(schedules)
+    return [f.code for f in m.findings + progress.check_progress(m)]
+
+
+def run(schedules, **kw):
+    matched = matcher.match_schedules(schedules)
+    assert not matched.findings, matched.findings
+    return cost.run_cost_pass(matched, model=kw.pop("model", MODEL), **kw)
+
+
+# ---------------------------------------------------------------------------
+# schedule programs + the activation-stash bound
+# ---------------------------------------------------------------------------
+
+
+def test_gpipe_program_shape_and_stash():
+    prog = pipe.rank_program("gpipe", 4, 8, rank=0)
+    assert prog[:8] == tuple(("F", i, 0) for i in range(8))
+    assert prog[8:] == tuple(("B", i, 0) for i in reversed(range(8)))
+    # the synchronous flush stashes EVERY microbatch
+    assert pipe.stash_depth(prog) == 8
+
+
+@pytest.mark.parametrize("stages", [2, 4, 8])
+@pytest.mark.parametrize("microbatches", [2, 4, 8, 16])
+def test_1f1b_stash_bound_min_s_m(stages, microbatches):
+    # the PipeDream-flush memory claim: 1F1B's early backwards cap the
+    # worst rank's stash at min(S, M); gpipe pays M regardless
+    plan_g = pipe.compile_phases("gpipe", stages, microbatches)
+    plan_f = pipe.compile_phases("1f1b", stages, microbatches)
+    assert plan_g.max_stash == microbatches
+    assert plan_f.max_stash == min(stages, microbatches)
+    # rank 0 fills the deepest pipe; later ranks never stash more
+    assert plan_f.stash_by_rank[0] == plan_f.max_stash
+    assert all(d <= plan_f.max_stash for d in plan_f.stash_by_rank)
+
+
+def test_1f1b_program_alternates_after_warmup():
+    prog = pipe.rank_program("1f1b", 4, 8, rank=0)
+    # every F matched by a B, F count == M
+    assert sum(1 for op, *_ in prog if op == "F") == 8
+    assert sum(1 for op, *_ in prog if op == "B") == 8
+    # after the warmup prefix the steady state is strict F/B alternation
+    warmup = 3  # s - 1 - rank
+    steady = prog[warmup:warmup + 2 * (8 - warmup)]
+    assert all(op == ("F" if i % 2 == 0 else "B")
+               for i, (op, *_) in enumerate(steady))
+
+
+def test_interleaved_program_chunks_and_phases():
+    plan = pipe.compile_phases("interleaved", 4, 8, virtual=2)
+    # p = S*v virtual stages: fill is p-1 ticks, M+p-1 total
+    assert (plan.ticks, plan.warmup) == (8 + 8 - 1, 7)
+    assert plan.steady == 8 - 7 and plan.cooldown == plan.ticks - 7 - 1
+    prog = pipe.rank_program("interleaved", 4, 8, rank=1, virtual=2)
+    assert {c for _op, _i, c in prog} == {0, 1}
+    assert sum(1 for op, *_ in prog if op == "F") == 16  # M * v
+    # interleaving stashes less than gpipe's M*v, more than flat 1f1b
+    assert pipe.stash_depth(prog) <= 16
+
+
+def test_phase_split_accounting():
+    plan = pipe.compile_phases("1f1b", 4, 8)
+    assert (plan.warmup, plan.steady, plan.cooldown) == (3, 5, 3)
+    assert plan.ticks == plan.warmup + plan.steady + plan.cooldown
+    # M < P: no steady window at all (the 8-stage example's shape)
+    plan = pipe.compile_phases("1f1b", 8, 4)
+    assert plan.steady == 0 and plan.ticks == 11
+
+
+def test_schedule_validation_errors():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        pipe.compile_phases("ladder", 4, 8)
+    with pytest.raises(ValueError, match="virtual >= 2"):
+        pipe.compile_phases("interleaved", 4, 8, virtual=1)
+    with pytest.raises(ValueError, match="only applies"):
+        pipe.compile_phases("gpipe", 4, 8, virtual=2)
+    with pytest.raises(ValueError, match="out of range"):
+        pipe.rank_program("gpipe", 4, 8, rank=4)
+    with pytest.raises(ValueError, match="never stashed"):
+        pipe.stash_depth((("B", 0, 0),))
+
+
+# ---------------------------------------------------------------------------
+# microbatch splitting + the knob plumbing
+# ---------------------------------------------------------------------------
+
+
+class _Arr:
+    def __init__(self, shape):
+        self.shape = tuple(shape)
+        self.dtype = types.SimpleNamespace(itemsize=4)
+
+    def reshape(self, shape):
+        return _Arr(shape)
+
+
+def test_split_microbatches_explicit():
+    out = pipe.split_microbatches(_Arr((32, 8)), 4)
+    assert out.shape == (4, 8, 8)
+    with pytest.raises(ValueError, match="cannot split"):
+        pipe.split_microbatches(_Arr((32, 8)), 5)
+
+
+def test_split_microbatches_env_knob(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_PIPELINE_MICROBATCHES", "8")
+    assert pipe.split_microbatches(_Arr((32, 8))).shape == (8, 4, 8)
+    monkeypatch.delenv("MPI4JAX_TPU_PIPELINE_MICROBATCHES")
+    # unset -> no split
+    assert pipe.split_microbatches(_Arr((32, 8))).shape == (1, 32, 8)
+
+
+def test_pipeline_knobs_declared_and_tuned():
+    # flags declared (the _getenv registry contract) with 0 = unset
+    for flag in ("MPI4JAX_TPU_PIPELINE_MICROBATCHES",
+                 "MPI4JAX_TPU_PIPELINE_VIRTUAL_STAGES"):
+        assert flag in config.FLAGS and config.FLAGS[flag].default == 0
+    assert config.pipeline_microbatches() == 0
+    assert config.pipeline_virtual_stages() == 0
+    # the mpx-tuning/1 knob names map onto exactly those flags
+    assert schema.KNOB_FLAGS["pipeline_microbatches"] == \
+        "MPI4JAX_TPU_PIPELINE_MICROBATCHES"
+    assert schema.KNOB_FLAGS["pipeline_virtual_stages"] == \
+        "MPI4JAX_TPU_PIPELINE_VIRTUAL_STAGES"
+    tf = schema.TuningFile({"schema": "mpx-tuning/1",
+                            "tuned": {"pipeline_microbatches": 16,
+                                      "pipeline_virtual_stages": 2}})
+    assert tf.knob("pipeline_microbatches") == 16
+    assert tf.knob("pipeline_virtual_stages", payload_bytes=4096) == 2
+    # tuned values are >= 1 (0 = unset exists only as the static default)
+    with pytest.raises(ValueError, match="pipeline_microbatches"):
+        schema.TuningFile({"schema": "mpx-tuning/1",
+                           "tuned": {"pipeline_microbatches": 0}})
+
+
+def test_pipeline_virtual_env_knob(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_PIPELINE_VIRTUAL_STAGES", "3")
+    assert config.pipeline_virtual_stages() == 3
+    prog = pipe.PipelineProgram(lambda h, p: h, None, "interleaved",
+                                None, None, True)
+    assert prog._resolve_virtual("interleaved") == 3
+
+
+# ---------------------------------------------------------------------------
+# bubble-time formulas + best_schedule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("microbatches", [4, 8, 16])
+@pytest.mark.parametrize("payload", [1 << 10, 1 << 20])
+def test_wall_time_orderings(microbatches, payload):
+    # the pinned chain: serialized ladder > gpipe > 1f1b, every payload
+    c = MODEL.compute_us(2 * payload)
+    t = {s: cm.pipeline_wall_us(s, 8, microbatches, payload, c, MODEL)
+         for s in ("ladder", "gpipe", "1f1b")}
+    assert t["ladder"] > t["gpipe"] > t["1f1b"] > 0
+
+
+def test_bubble_fraction_bounds_and_ordering():
+    c = MODEL.compute_us(2 << 20)
+    for s in ("ladder", "gpipe", "1f1b"):
+        b = cm.pipeline_bubble_fraction(s, 8, 8, 1 << 20, c, MODEL)
+        assert 0.0 <= b < 1.0
+    b_ladder = cm.pipeline_bubble_fraction("ladder", 8, 8, 1 << 20, c,
+                                           MODEL)
+    b_1f1b = cm.pipeline_bubble_fraction("1f1b", 8, 8, 1 << 20, c, MODEL)
+    assert b_ladder > b_1f1b
+    # more microbatches amortize the fill: the bubble shrinks
+    b_more = cm.pipeline_bubble_fraction("1f1b", 8, 32, 1 << 20, c, MODEL)
+    assert b_more < b_1f1b
+
+
+def test_interleaved_shrinks_the_fill():
+    # transfer-light regime: the v-times-shallower fill wins
+    payload = 1 << 10
+    c = 500.0  # compute-dominated stage
+    flat = cm.pipeline_wall_us("1f1b", 8, 8, payload, c, MODEL)
+    inter = cm.pipeline_wall_us("interleaved", 8, 8, payload, c, MODEL,
+                                virtual=4)
+    assert inter < flat
+
+
+def test_best_schedule_candidates_and_argmin():
+    c = MODEL.compute_us(2 << 20)
+    best, times = cm.best_schedule(8, 8, 1 << 20, c, MODEL, virtual=1)
+    # the ladder is never a candidate; flat programs never interleave
+    assert set(times) == {"gpipe", "1f1b"}
+    assert best == "1f1b"
+    best_v, times_v = cm.best_schedule(8, 8, 1 << 10, 500.0, MODEL,
+                                       virtual=4)
+    assert set(times_v) == {"gpipe", "1f1b", "interleaved"}
+    assert best_v == "interleaved"
+    with pytest.raises(ValueError):
+        cm.pipeline_wall_us("wavefront", 8, 8, 1 << 20, c, MODEL)
+    with pytest.raises(ValueError):
+        cm.pipeline_wall_us("gpipe", 0, 8, 1 << 20, c, MODEL)
+
+
+# ---------------------------------------------------------------------------
+# the schedule builder's async p2p extension
+# ---------------------------------------------------------------------------
+
+
+def test_send_start_recv_start_wait_roles():
+    events = [
+        E(0, "send_start", comm_uid=1, tag=0, pairs=((0, 1),), span=10,
+          shape=(4,), dtype="f32"),
+        E(1, "recv_start", comm_uid=1, tag=0, pairs=((0, 1),), span=11,
+          shape=(4,), dtype="f32"),
+        E(2, "p2p_wait", comm_uid=1, span=11, tag=0),
+        E(3, "p2p_wait", comm_uid=1, span=10, tag=0),
+    ]
+    s0 = schedule.build_schedule(events, rank=0, world=2)
+    s1 = schedule.build_schedule(events, rank=1, world=2)
+    # sender: the transfer is issued AT the start (buffered — never
+    # blocks); its wait emits nothing
+    assert [o.kind for o in s0] == ["send"]
+    assert (s0[0].dst, s0[0].span) == (1, 10)
+    # receiver: the block point is the WAIT, so the recv SchedOp lands
+    # at the wait's position — the overlap window is everything between
+    assert [o.kind for o in s1] == ["recv"]
+    assert (s1[0].src, s1[0].tag, s1[0].span) == (0, 0, 11)
+    assert s1[0].event_index == 2
+    assert verify({0: s0, 1: s1}) == []
+
+
+def test_recv_start_wildcard_adopts_send_routing():
+    # recv_start(source=None) adopts the queued send_start's routing
+    # FIFO per (comm, tag) — the PR 7 adoption rule, now on spans
+    fan_in = ((1, 0), (2, 0), (3, 0))
+    events = [
+        E(0, "send_start", comm_uid=1, tag=0, pairs=fan_in, span=5,
+          shape=(4,), dtype="f32"),
+        E(1, "recv_start", comm_uid=1, tag=0, pairs=None, span=6,
+          shape=(4,), dtype="f32"),
+        E(2, "p2p_wait", comm_uid=1, span=6, tag=0),
+        E(3, "p2p_wait", comm_uid=1, span=5, tag=0),
+    ]
+    scheds = {r: schedule.build_schedule(events, rank=r, world=4)
+              for r in range(4)}
+    assert [o.kind for o in scheds[0]] == ["recv"] * 3
+    assert {o.src for o in scheds[0]} == {1, 2, 3}
+    for r in (1, 2, 3):
+        assert [o.kind for o in scheds[r]] == ["send"]
+    assert verify(scheds) == []
+
+
+def test_p2p_span_matching_inside_megastep_loops():
+    # the 1F1B steady state: start/wait pairs INSIDE a megastep loop
+    # body (loop/unroll stamped), two spans in flight per iteration —
+    # the builder must match spans, not positions, and the pipeline
+    # stamp on the wait event must land on the emitted recv SchedOp
+    # the traced boundary shape: send_start over the ring, wildcard
+    # recv_start adopting its routing, recv-side wait, send-side wait
+    stamp = ("1f1b", 2, 8, 1, 4096)
+    ring = ((0, 1), (1, 0))
+    events = [
+        E(0, "send_start", comm_uid=1, tag=0, pairs=ring, span=20,
+          loop=3, unroll=5, shape=(4,), dtype="f32"),
+        E(1, "recv_start", comm_uid=1, tag=0, pairs=None, span=21,
+          loop=3, unroll=5, shape=(4,), dtype="f32"),
+        E(2, "p2p_wait", comm_uid=1, span=21, tag=0, loop=3, unroll=5,
+          extra={"pipeline": stamp}),
+        E(3, "p2p_wait", comm_uid=1, span=20, tag=0, loop=3, unroll=5),
+    ]
+    s0 = schedule.build_schedule(events, rank=0, world=2)
+    s1 = schedule.build_schedule(events, rank=1, world=2)
+    # every rank: buffered send at the start, recv at the WAIT position
+    assert [o.kind for o in s0] == ["send", "recv"]
+    assert [o.kind for o in s1] == ["send", "recv"]
+    assert (s0[1].src, s1[1].src) == (1, 0)  # adopted ring routing
+    # the recv emitted at the wait carries the wait event's stamp
+    recv0 = s0[1]
+    assert recv0.meta.get("pipeline") == stamp
+    assert recv0.span == 21 and recv0.event_index == 2
+    assert verify({0: s0, 1: s1}) == []
+
+
+def test_unpaired_wait_and_wildcard_span():
+    # a wait whose span never started emits nothing (MPX112 owns the
+    # diagnosis at trace time); a wildcard recv_start with no queued
+    # send stays a blocking wildcard at its wait
+    events = [
+        E(0, "p2p_wait", comm_uid=1, span=99, tag=0),
+        E(1, "recv_start", comm_uid=1, tag=4, pairs=None, span=7,
+          shape=(4,), dtype="f32", eager=True),
+        E(2, "p2p_wait", comm_uid=1, span=7, tag=4),
+    ]
+    s0 = schedule.build_schedule(events, rank=0, world=2)
+    assert [o.kind for o in s0] == ["recv"]
+    assert s0[0].src is None and s0[0].tag == 4
+
+
+# ---------------------------------------------------------------------------
+# MPX144 — the schedule-mispick critic
+# ---------------------------------------------------------------------------
+
+
+def _stamped_pair(stamp, nbytes=1 << 20):
+    return {
+        0: [S(rank=0, pos=0, kind="send", op="send_start", comm_key=0,
+              src=0, dst=1, tag=0, payload_bytes=nbytes)],
+        1: [S(rank=1, pos=0, kind="recv", op="p2p_wait", comm_key=0,
+              src=0, dst=1, tag=0, payload_bytes=nbytes,
+              meta={"pipeline": stamp})],
+    }
+
+
+def test_mpx144_fires_on_priced_worse_schedule():
+    # gpipe at a shape where 1f1b is strictly cheaper
+    _, findings = run(_stamped_pair(("gpipe", 8, 8, 1, 1 << 20)))
+    f = [x for x in findings if x.code == "MPX144"]
+    assert len(f) == 1
+    assert "'gpipe'" in f[0].message and "'1f1b'" in f[0].message
+    assert "bubble fraction" in f[0].message
+    assert "schedule='auto'" in f[0].suggestion
+    assert f[0].severity == "advisory"
+
+
+def test_mpx144_negative_when_schedule_is_best():
+    _, findings = run(_stamped_pair(("1f1b", 8, 8, 1, 1 << 20)))
+    assert not [x for x in findings if x.code == "MPX144"]
+
+
+def test_mpx144_dedupes_and_ignores_malformed():
+    # the same stamp on many ops fires once; junk stamps never crash
+    scheds = _stamped_pair(("gpipe", 8, 8, 1, 1 << 20))
+    scheds[1].append(
+        S(rank=1, pos=1, kind="recv", op="p2p_wait", comm_key=0, src=0,
+          dst=1, tag=1, payload_bytes=64,
+          meta={"pipeline": ("gpipe", 8, 8, 1, 1 << 20)}))
+    scheds[0].append(
+        S(rank=0, pos=1, kind="send", op="send_start", comm_key=0, src=0,
+          dst=1, tag=1, payload_bytes=64,
+          meta={"pipeline": ("junk",)}))
+    _, findings = run(scheds)
+    assert len([x for x in findings if x.code == "MPX144"]) == 1
+
+
+def test_mpx144_tuned_provenance():
+    model = cm.CostModel(tuned_stamp="cafe12345678")
+    _, findings = run(_stamped_pair(("gpipe", 8, 8, 1, 1 << 20)),
+                      model=model)
+    f = [x for x in findings if x.code == "MPX144"]
+    assert f and "tuned@cafe12345678" in f[0].message
+
+
+def test_mpx144_in_catalog_and_cost_codes():
+    rep = sys.modules[f"{_ISO_NAME}.analysis.report"]
+    assert rep.CODES["MPX144"].severity == "advisory"
+    assert "MPX144" in cost.COST_CODES
+
+
+# ---------------------------------------------------------------------------
+# MPX135 — the upgraded advisory text
+# ---------------------------------------------------------------------------
+
+
+def _ladder_schedules(ranks=4, nbytes=1 << 16):
+    schedules = {r: [] for r in range(ranks)}
+    for s in range(1, ranks):
+        schedules[s - 1].append(
+            S(rank=s - 1, pos=len(schedules[s - 1]), kind="send",
+              op="send", comm_key=0, src=s - 1, dst=s, tag=s,
+              payload_bytes=nbytes))
+        schedules[s].append(
+            S(rank=s, pos=len(schedules[s]), kind="recv", op="recv",
+              comm_key=0, src=s - 1, dst=s, tag=s, payload_bytes=nbytes))
+    return schedules
+
+
+def test_mpx135_cites_bubble_and_recommends_pipeline():
+    _, findings = run(_ladder_schedules(ranks=4))
+    f = [x for x in findings if x.code == "MPX135"]
+    assert len(f) == 1
+    assert "bubble fraction" in f[0].message
+    assert "mpx.pipeline" in f[0].suggestion
+    assert "1F1B" in f[0].suggestion and "us/round" in f[0].suggestion
+
+
+def test_mpx135_tuned_provenance():
+    model = cm.CostModel(tuned_stamp="beef98765432")
+    _, findings = run(_ladder_schedules(ranks=4), model=model)
+    f = [x for x in findings if x.code == "MPX135"]
+    assert f and "tuned@beef98765432" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# the program's pure planning half
+# ---------------------------------------------------------------------------
+
+
+def test_program_plan_auto_resolves_via_cost_model():
+    prog = pipe.pipeline(lambda h, p: h, 8)
+    plan = prog.plan(8, 8, 1 << 20)
+    assert plan.schedule == "1f1b"  # the model's pick at this shape
+    assert plan.virtual == 1
+    stamp = prog._stamp(plan, 1 << 20)
+    assert stamp == ("1f1b", 8, 8, 1, 1 << 20)
+
+
+def test_program_explicit_schedule_and_chunked_fns():
+    prog = pipe.pipeline([lambda h, p: h, lambda h, p: h], 8,
+                         schedule="interleaved")
+    plan = prog.plan(4, 8, 4096)
+    assert plan.schedule == "interleaved" and plan.virtual == 2
+    with pytest.raises(ValueError, match="disagrees"):
+        pipe.pipeline([lambda h, p: h], 8, schedule="interleaved",
+                      virtual=3)
+    with pytest.raises(ValueError, match="unknown schedule"):
+        pipe.pipeline(lambda h, p: h, 8, schedule="ladder")
+    with pytest.raises(TypeError, match="stage_fns"):
+        pipe.pipeline([], 8)
