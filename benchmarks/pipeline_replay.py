@@ -115,8 +115,13 @@ def main():
         rows = {s: grid_row(cm, pl, model, s, m, payload, c)
                 for s in cm.PIPELINE_SCHEDULES}
         grid.extend(rows[s] for s in cm.PIPELINE_SCHEDULES)
-        best, times = cm.best_schedule(STAGES, m, payload, c, model,
-                                       virtual=VIRTUAL)
+        # the cross-shape argmin over ALL grid rows: explicit
+        # candidates, because best_schedule's defaults only price what
+        # one program shape can express (flat -> gpipe/1f1b, chunked ->
+        # interleaved alone) while this artifact compares across shapes
+        best, times = cm.best_schedule(
+            STAGES, m, payload, c, model, virtual=VIRTUAL,
+            candidates=("gpipe", "1f1b", "interleaved"))
         auto_picks.append({
             "count": m,
             "pick": best,

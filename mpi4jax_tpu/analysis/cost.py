@@ -868,10 +868,12 @@ def _check_pipeline_mispick(sim: _TimedSimulation,
     payload_bytes)`` tuple (SchedOp.meta["pipeline"], via
     hook.mark_last_event); when the cost model prices an expressible
     alternative schedule measurably better at that point, say so.  The
-    candidate set matches the compiler's own ``schedule='auto'`` search:
-    gpipe and 1f1b always, interleaved only when the program already
-    carries virtual stage-chunks (v >= 2) — an alternative that needs
-    restructuring is not 'expressible'."""
+    candidate set matches the compiler's own ``schedule='auto'`` search
+    (``costmodel.best_schedule``): gpipe vs 1f1b for a flat program
+    (v == 1); a program already chunked into v >= 2 stage-chunks can
+    only express interleaved, so it has no alternative and the advisory
+    never fires on it — an alternative that needs restructuring is not
+    'expressible'."""
     findings: List[Finding] = []
     seen = set()
     for r in matched.ranks:

@@ -734,13 +734,21 @@ def best_schedule(stages: int, microbatches: int, payload_bytes: int,
     """Model-predicted schedule pick, mirroring :func:`best_algo`:
     evaluates every expressible candidate and returns ``(best, {schedule:
     wall_us})`` — ``mpx.pipeline(schedule='auto')``'s argmin and the
-    MPX144 mispick discriminator.  The ladder is never a candidate (it is
-    the shape :func:`pipeline` exists to replace); interleaved only
-    qualifies when ``virtual >= 2`` divides the stage count's chunking."""
+    MPX144 mispick discriminator.  The default candidate set is what the
+    PROGRAM at ``virtual`` can express — an alternative that needs
+    restructuring is not a candidate: the ladder never (it is the shape
+    :func:`pipeline` exists to replace); a flat program (``virtual ==
+    1``) prices gpipe vs 1f1b; a program already chunked into ``virtual
+    >= 2`` stage-chunks per rank can only run interleaved, because
+    gpipe/1f1b apply one stage fn per rank and would need the chunks
+    composed back into a single fn.  Pass ``candidates`` explicitly to
+    price across program shapes (benchmarks/pipeline_replay.py's
+    cross-shape argmin does)."""
     if candidates is None:
-        candidates = ["gpipe", "1f1b"]
         if virtual >= 2:
-            candidates.append("interleaved")
+            candidates = ["interleaved"]
+        else:
+            candidates = ["gpipe", "1f1b"]
     times = {
         sched: pipeline_wall_us(sched, stages, microbatches, payload_bytes,
                                 stage_compute_us, model,

@@ -16,9 +16,11 @@ the shape; this module makes it a *compiled schedule* the library owns:
 - :class:`PipelineProgram` / :func:`pipeline` — the runnable program.
   Boundary transfers go through the async point-to-point primitives
   (``send_start``/``recv_start``/``p2p_wait``, ops/_async.py) under the
-  ``1f1b`` and ``interleaved`` schedules, so the wire overlaps the
-  compute issued inside the span; ``gpipe`` keeps the blocking
-  ``sendrecv`` boundary (the baseline the BENCH grid prices).  The
+  ``1f1b`` and ``interleaved`` schedules: wire-independent work issues
+  inside the recv span and the send-side wait closes only after the
+  stage compute, so neither the wire nor the downstream rank's progress
+  gates a tick's compute; ``gpipe`` keeps the blocking ``sendrecv``
+  boundary (the baseline the BENCH grid prices).  The
   steady-state ticks — the 1F1B core — compose with the megastep
   compiler (parallel/megastep.py): one device-resident ``fori_loop``
   dispatch executes the whole steady window, and the MPX130 span rule
@@ -275,6 +277,15 @@ class PipelineProgram:
                 f"pipeline: unknown schedule {schedule!r} "
                 f"(expressible: {SCHEDULES}, plus 'auto')"
             )
+        if schedule in ("gpipe", "1f1b") and virtual is not None and \
+                int(virtual) >= 2:
+            raise ValueError(
+                f"pipeline: schedule={schedule!r} cannot run a program "
+                f"carrying {virtual} stage-chunks per rank — only the "
+                "interleaved schedule applies per-chunk stage fns; "
+                "compose the chunks into one stage fn per rank, or use "
+                "schedule='interleaved' (or 'auto')"
+            )
         self._requested = schedule
         self._n_microbatches = n_microbatches
         self._virtual = virtual
@@ -296,6 +307,17 @@ class PipelineProgram:
             return max(1, int(v))
         return 1
 
+    def _carries_chunks(self) -> bool:
+        """Whether this program is built from ``v >= 2`` stage-chunks
+        per rank (a multi-fn stage list, or a single fn whose params
+        carry the chunk axis an explicit ``virtual`` promises).  Such a
+        program can only express the interleaved schedule: gpipe/1f1b
+        apply one stage fn per rank, so running them would silently
+        drop chunks ``1..v-1``."""
+        if self._fns is not None and len(self._fns) >= 2:
+            return True
+        return self._virtual is not None and int(self._virtual) >= 2
+
     def plan(self, stages: int, microbatches: int, payload_bytes: int
              ) -> PhasePlan:
         """Resolve ``schedule='auto'`` through the cost model and compile
@@ -309,14 +331,28 @@ class PipelineProgram:
             model = costmodel.load_model()
             # roofline floor for the per-microbatch stage compute: a
             # stage at minimum streams its boundary activation in and
-            # out (docs/pipeline.md "Choosing a schedule")
+            # out (docs/pipeline.md "Choosing a schedule").  The
+            # candidate set is virtual-aware: best_schedule prices
+            # gpipe vs 1f1b for a flat program and interleaved alone
+            # for a chunked one (virtual >= 2) — a schedule the
+            # program cannot express without restructuring is never
+            # the argmin.
             compute_us = model.compute_us(2 * payload_bytes)
             schedule, _times = costmodel.best_schedule(
                 stages, microbatches, payload_bytes, compute_us, model,
                 virtual=virtual)
-        return compile_phases(
-            schedule, stages, microbatches,
-            virtual if schedule == "interleaved" else 1)
+        if schedule != "interleaved":
+            if self._carries_chunks():
+                raise ValueError(
+                    f"pipeline: this program carries "
+                    f"{self._virtual} stage-chunks per rank but "
+                    f"resolved schedule {schedule!r}; only "
+                    "'interleaved' can run chunked stage fns — "
+                    "gpipe/1f1b would silently drop every chunk but "
+                    "the first"
+                )
+            virtual = 1
+        return compile_phases(schedule, stages, microbatches, virtual)
 
     def _stamp(self, plan: PhasePlan, payload_bytes: int) -> tuple:
         return (plan.schedule, plan.stages, plan.microbatches,
@@ -392,11 +428,14 @@ class PipelineProgram:
         out = jnp.zeros(mbs.shape, mbs.dtype)
         with _phase_bracket(comm, plan, "bubble_wait", nbytes):
             h, out = warm(mbs, h, out, params)
+            h, out = _block_for_timing(h, out)
         if steady is not None:
             with _phase_bracket(comm, plan, "stage", nbytes):
                 h, out = steady(mbs, h, out, params)
+                h, out = _block_for_timing(h, out)
         with _phase_bracket(comm, plan, "bubble_wait", nbytes):
             h, out = cool(mbs, h, out, params)
+            h, out = _block_for_timing(h, out)
         return out
 
     def _phase_progs(self, comm, plan: PhasePlan):
@@ -459,8 +498,23 @@ class _TickDriver:
         stamp = self.prog._stamp(self.plan, _nbytes_of(self.mbs[0]))
         mark_last_event("pipeline", stamp, current_context())
 
-    def _boundary(self, h, tok):
-        from ..ops._async import p2p_wait, recv_start, send_start
+    def _boundary_starts(self, h, tok):
+        """Issue the tick's boundary transfer.  gpipe: the blocking
+        ``sendrecv`` — the transfer sits on the tick edge by design
+        (the baseline the BENCH grid prices), so it completes here and
+        the returned "handle" is already the received stack.  Async
+        schedules (1f1b/interleaved): open both spans and return
+        WITHOUT blocking — the recv wait lands in :meth:`_boundary_recv`
+        (after the wire-independent work the tick issues into the
+        span) and the send wait in :meth:`_boundary_send_finish`
+        (after the stage compute), so neither the wire nor the
+        downstream rank's progress gates this rank's compute.  That
+        one-tick send decoupling is what lets the ranks skew instead
+        of running the lockstep the gpipe boundary enforces; the
+        residual per-tick exposure is the ``max(0, x - c)`` term
+        ``costmodel.pipeline_wall_us`` prices (docs/pipeline.md
+        "Phases, async p2p")."""
+        from ..ops._async import recv_start, send_start
         from ..ops.sendrecv import sendrecv
         from .rankspec import shift
 
@@ -471,16 +525,27 @@ class _TickDriver:
         if self.plan.schedule == "gpipe":
             got, tok = sendrecv(h, h, dest=dest, token=tok)
             self._mark()
-            return got, tok
-        # async boundary: the transfer is emitted at recv_start and
-        # first used at the wait, so the input gather and the stash
-        # bookkeeping between them overlap the wire
+            return None, got, tok
         sh, tok = send_start(h, dest, token=tok)
         rh, tok = recv_start(h, token=tok)
+        return sh, rh, tok
+
+    def _boundary_recv(self, rh, tok):
+        if self.plan.schedule == "gpipe":
+            return rh, tok  # the blocking boundary already delivered
+        from ..ops._async import p2p_wait
+
         got, tok = p2p_wait(rh, token=tok)
         self._mark()
-        _, tok = p2p_wait(sh, token=tok)
         return got, tok
+
+    def _boundary_send_finish(self, sh, tok):
+        if sh is None:
+            return tok
+        from ..ops._async import p2p_wait
+
+        _, tok = p2p_wait(sh, token=tok)
+        return tok
 
     def _advance(self, h, got, feed):
         import jax.numpy as jnp
@@ -500,10 +565,14 @@ class _TickDriver:
 
         plan = self.plan
         p = plan.stages * plan.virtual
-        got, tok = self._boundary(h, tok)
+        sh, rh, tok = self._boundary_starts(h, tok)
+        # inside the recv span: the fresh-microbatch gather never
+        # touches the wire, so it overlaps the boundary transfer
         feed = self.mbs[t] if t < plan.microbatches \
             else jnp.zeros_like(self.mbs[0])
+        got, tok = self._boundary_recv(rh, tok)
         h = self._advance(h, got, feed)
+        tok = self._boundary_send_finish(sh, tok)
         if t >= p - 1:
             out = out.at[t - (p - 1)].set(h[plan.virtual - 1])
         return h, out, tok
@@ -513,9 +582,11 @@ class _TickDriver:
 
         plan = self.plan
         p = plan.stages * plan.virtual
-        got, tok = self._boundary(h, tok)
+        sh, rh, tok = self._boundary_starts(h, tok)
         feed = lax.dynamic_index_in_dim(self.mbs, t, 0, keepdims=False)
+        got, tok = self._boundary_recv(rh, tok)
         h = self._advance(h, got, feed)
+        tok = self._boundary_send_finish(sh, tok)
         out = lax.dynamic_update_index_in_dim(out, h[plan.virtual - 1],
                                               t - (p - 1), 0)
         return h, out, tok
@@ -538,6 +609,21 @@ class _TickDriver:
         for t in range(lo, hi):
             h, out, tok = self._tick_py(t, h, out, tok)
         return h, out, tok
+
+
+def _block_for_timing(*outs):
+    """Sync the phase outputs before the bracket's end timestamp: JAX
+    dispatch is async, so without a device sync the bracket would time
+    the dispatch, not the execution, and the measured bubble fraction
+    in ``telemetry.report()`` would be fiction.  Blocks only while
+    telemetry is collecting — 'off' keeps the phases fully async."""
+    from ..telemetry import core as tcore
+
+    if tcore.effective_mode() == "off":
+        return outs
+    import jax
+
+    return jax.block_until_ready(outs)
 
 
 def _phase_bracket(comm, plan: PhasePlan, phase: str, nbytes: int):
@@ -581,9 +667,12 @@ def pipeline(stage_fns: StageFns, n_microbatches: Optional[int] = None,
     1`` every params leaf carries a leading chunk axis) or a sequence of
     per-chunk callables.  ``schedule`` is ``'auto'`` (the cost model
     picks — tuned parameters when a tuning file is active), ``'gpipe'``,
-    ``'1f1b'``, or ``'interleaved'``.  ``megastep=False`` keeps the
-    steady state Python-unrolled (debugging; the compiled program is the
-    point).
+    ``'1f1b'``, or ``'interleaved'``.  A program carrying ``v >= 2``
+    stage-chunks per rank can only express the interleaved schedule:
+    gpipe/1f1b apply one stage fn per rank, so requesting them raises
+    (and ``'auto'`` only prices interleaved) rather than silently
+    dropping chunks.  ``megastep=False`` keeps the steady state
+    Python-unrolled (debugging; the compiled program is the point).
     """
     return PipelineProgram(stage_fns, n_microbatches, schedule, virtual,
                            comm, megastep)

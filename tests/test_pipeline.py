@@ -156,6 +156,36 @@ def test_auto_resolves_through_cost_model_and_matches_reference():
     _check(prog, mbs, ws, want, "auto")
 
 
+def test_multi_fn_chunked_stages_interleaved_and_auto():
+    # a per-chunk stage_fns LIST (not a single fn over chunk-axis
+    # params): only interleaved can express it, and every chunk must
+    # actually run — the truncation guard's positive twin
+    comm = _world_comm()
+    mbs, ws, want = _problem(comm, virtual=2)
+    fns = [lambda h, p: _substage(h, p[0]),
+           lambda h, p: _substage(h, p[1])]
+    prog = mpx.pipeline(fns, MICRO, schedule="interleaved", comm=comm)
+    _check(prog, mbs, ws, want, "interleaved[fns]")
+    # schedule='auto' restricts the candidate set to what the chunked
+    # program expresses, so it can only resolve to interleaved
+    auto_prog = mpx.pipeline(fns, MICRO, comm=comm)
+    plan = auto_prog.plan(comm.Get_size(), MICRO, DIM * 4)
+    assert plan.schedule == "interleaved" and plan.virtual == 2
+    _check(auto_prog, mbs, ws, want, "auto[fns]")
+
+
+def test_multi_fn_non_interleaved_schedule_rejected():
+    # gpipe/1f1b over a chunked program would silently compute a
+    # truncated model (only chunk 0 applied); the builder refuses
+    fns = [lambda h, p: _substage(h, p[0]),
+           lambda h, p: _substage(h, p[1])]
+    for schedule in ("gpipe", "1f1b"):
+        with pytest.raises(ValueError, match="stage-chunks"):
+            mpx.pipeline(fns, MICRO, schedule=schedule)
+        with pytest.raises(ValueError, match="stage-chunks"):
+            mpx.pipeline(_substage, MICRO, schedule=schedule, virtual=2)
+
+
 def test_trace_composes_inside_region():
     comm = _world_comm()
     mbs, ws, want = _problem(comm)

@@ -254,10 +254,19 @@ def test_best_schedule_candidates_and_argmin():
     # the ladder is never a candidate; flat programs never interleave
     assert set(times) == {"gpipe", "1f1b"}
     assert best == "1f1b"
+    # a chunked program (virtual >= 2) can only express interleaved:
+    # gpipe/1f1b would need its chunks composed back into one stage fn
+    # per rank, so they are never default candidates there
     best_v, times_v = cm.best_schedule(8, 8, 1 << 10, 500.0, MODEL,
                                        virtual=4)
-    assert set(times_v) == {"gpipe", "1f1b", "interleaved"}
+    assert set(times_v) == {"interleaved"}
     assert best_v == "interleaved"
+    # the cross-shape comparison stays available via explicit candidates
+    best_x, times_x = cm.best_schedule(
+        8, 8, 1 << 10, 500.0, MODEL, virtual=4,
+        candidates=("gpipe", "1f1b", "interleaved"))
+    assert set(times_x) == {"gpipe", "1f1b", "interleaved"}
+    assert best_x == "interleaved"  # transfer-light: the fill win
     with pytest.raises(ValueError):
         cm.pipeline_wall_us("wavefront", 8, 8, 1 << 20, c, MODEL)
     with pytest.raises(ValueError):
@@ -479,3 +488,26 @@ def test_program_explicit_schedule_and_chunked_fns():
         pipe.pipeline(lambda h, p: h, 8, schedule="ladder")
     with pytest.raises(TypeError, match="stage_fns"):
         pipe.pipeline([], 8)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_chunked_program_rejects_non_interleaved_schedules(schedule):
+    # gpipe/1f1b apply ONE stage fn per rank; running them over a
+    # chunked program would silently drop chunks 1..v-1 (the plan
+    # compiles with virtual=1, so _chunk_fn only ever applies chunk 0)
+    two = [lambda h, p: h, lambda h, p: h]
+    with pytest.raises(ValueError, match="stage-chunks"):
+        pipe.pipeline(two, 8, schedule=schedule)
+    with pytest.raises(ValueError, match="stage-chunks"):
+        pipe.pipeline(lambda h, p: h, 8, schedule=schedule, virtual=2)
+
+
+def test_chunked_program_auto_restricts_candidates_to_interleaved():
+    # schedule='auto' on a chunked program only prices what the program
+    # can express: interleaved wins by default at EVERY regime, even
+    # transfer-heavy shapes where a flat 1f1b would price cheaper
+    prog = pipe.pipeline([lambda h, p: h, lambda h, p: h], 8)
+    for payload in (1 << 10, 1 << 20):
+        plan = prog.plan(8, 8, payload)
+        assert plan.schedule == "interleaved"
+        assert plan.virtual == 2
